@@ -1,0 +1,12 @@
+from nanodiloco_tpu.models.config import LARGE_LLAMA, LLAMA3_8B, TINY_LLAMA, LlamaConfig
+from nanodiloco_tpu.models.llama import causal_lm_loss, forward, init_params
+
+__all__ = [
+    "LlamaConfig",
+    "TINY_LLAMA",
+    "LARGE_LLAMA",
+    "LLAMA3_8B",
+    "init_params",
+    "forward",
+    "causal_lm_loss",
+]

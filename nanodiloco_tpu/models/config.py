@@ -1,0 +1,108 @@
+"""Model configuration.
+
+Mirrors the knobs of the reference's JSON model configs
+(ref configs/llama_default.json:1-10 and nanodiloco/main.py:16-27): a
+HF-style Llama config with hidden/intermediate sizes, heads, layers,
+rms_norm_eps. Extended with the fields a real Llama family needs
+(GQA, rope theta, vocab, tying) so the same dataclass scales from the
+tiny 128-hidden model to Llama-3-8B-class configs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 128
+    intermediate_size: int = 512
+    num_hidden_layers: int = 6
+    num_attention_heads: int = 4
+    num_key_value_heads: int | None = None  # None -> MHA (== num_attention_heads)
+    max_position_embeddings: int = 2048
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    # TPU knobs (no reference analog — compute policy, not architecture):
+    dtype: str = "float32"          # activation/compute dtype ("bfloat16" on TPU)
+    param_dtype: str = "float32"    # master parameter dtype
+    remat: bool = False             # jax.checkpoint each decoder layer
+    attention_impl: str = "dense"   # "dense" | "flash" | "ring"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def kv_heads(self) -> int:
+        if self.num_key_value_heads is None:
+            return self.num_attention_heads
+        return self.num_key_value_heads
+
+    def __post_init__(self) -> None:
+        if self.hidden_size % self.num_attention_heads:
+            raise ValueError("hidden_size must divide evenly by num_attention_heads")
+        if self.num_key_value_heads is not None and self.num_key_value_heads < 1:
+            raise ValueError("num_key_value_heads must be >= 1 (or None for MHA)")
+        if self.num_attention_heads % self.kv_heads:
+            raise ValueError("num_attention_heads must divide evenly by num_key_value_heads")
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "LlamaConfig":
+        """Build from an HF-style config dict, ignoring unknown keys.
+
+        The reference feeds its JSON straight into ``LlamaConfig(**cfg)``
+        (ref nanodiloco/main.py:97); we accept the same files, including
+        keys we don't model (``architectures``, ``use_cache``).
+        """
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})
+
+    @classmethod
+    def from_json(cls, path: str) -> "LlamaConfig":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def num_params(self) -> int:
+        """Exact parameter count (embedding + layers + final norm + head)."""
+        d, f, v, l = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_hidden_layers
+        hd, nh, nkv = self.head_dim, self.num_attention_heads, self.kv_heads
+        per_layer = (
+            d * nh * hd + 2 * d * nkv * hd + nh * hd * d  # q, k, v, o
+            + 3 * d * f  # gate, up, down
+            + 2 * d      # two rmsnorm scales
+        )
+        head = 0 if self.tie_word_embeddings else d * v
+        return v * d + l * per_layer + d + head
+
+
+# The reference's inline default config (ref nanodiloco/main.py:16-27).
+TINY_LLAMA = LlamaConfig()
+
+# The "large" variant from the reference's prepare_configs
+# (ref scripts/train_modal.py:215-225): hidden 256 x 12 layers.
+LARGE_LLAMA = LlamaConfig(
+    hidden_size=256, intermediate_size=1024, num_attention_heads=8, num_hidden_layers=12
+)
+
+# New capability target (BASELINE.json config 3): Llama-3-8B-class.
+LLAMA3_8B = LlamaConfig(
+    vocab_size=128256,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_hidden_layers=32,
+    num_attention_heads=32,
+    num_key_value_heads=8,
+    max_position_embeddings=8192,
+    rope_theta=500000.0,
+    dtype="bfloat16",
+    remat=True,
+)

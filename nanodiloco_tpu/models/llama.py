@@ -1,0 +1,277 @@
+"""Llama-family decoder as pure functions over a parameter pytree.
+
+TPU-first design notes:
+- Parameters are a nested dict of ``jnp`` arrays; per-layer weights are
+  STACKED on a leading layer axis and the decoder runs as one
+  ``lax.scan`` over layers. One layer gets traced/compiled, whatever the
+  depth — compile time stays flat from the 6-layer tiny config
+  (ref configs/llama_default.json) to 32-layer 8B. The stacked layout also
+  gives every layer an identical shape, so a single PartitionSpec per
+  weight name shards the whole depth (see parallel/sharding.py).
+- All matmuls keep the [batch*seq, feature] shapes large and contiguous so
+  XLA tiles them onto the MXU; compute dtype is a config knob (bfloat16 on
+  TPU), while norms and softmax run in float32 for stability.
+- No data-dependent Python control flow: causal masking is an explicit
+  mask computed from broadcasted iotas, static shapes throughout.
+
+Numerics match HF ``LlamaForCausalLM`` (the reference's model, ref
+nanodiloco/main.py:9,97-99): rotate-half RoPE, RMSNorm with float32
+accumulation, SwiGLU MLP, pre-norm residuals, untied LM head by default.
+Weights here are stored [in_features, out_features] (x @ W); the HF/torch
+layout is the transpose.
+
+Loss fixes two reference quirks on purpose (SURVEY §2): labels are
+shifted inside the loss (HF did it internally for the reference,
+ref nanodiloco/main.py:87 cloned input_ids unshifted), and pad positions
+are masked out of the loss instead of being trained on.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from nanodiloco_tpu.models.config import LlamaConfig
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
+    """Random init matching HF Llama: N(0, initializer_range) everywhere,
+    RMSNorm scales at 1. DiLoCo's init-broadcast (ref
+    nanodiloco/diloco/diloco.py:21-22) is replaced by construction: every
+    worker derives params from the same PRNG key, so replicas are
+    bit-identical with zero communication.
+    """
+    std = cfg.initializer_range
+    pdt = jnp.dtype(cfg.param_dtype)
+    d, f, v, l = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_hidden_layers
+    nh, nkv, hd = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+
+    keys = jax.random.split(rng, 10)
+
+    def normal(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * std).astype(pdt)
+
+    layers = {
+        "attn_norm": jnp.ones((l, d), pdt),
+        "wq": normal(keys[0], (l, d, nh * hd)),
+        "wk": normal(keys[1], (l, d, nkv * hd)),
+        "wv": normal(keys[2], (l, d, nkv * hd)),
+        "wo": normal(keys[3], (l, nh * hd, d)),
+        "mlp_norm": jnp.ones((l, d), pdt),
+        "w_gate": normal(keys[4], (l, d, f)),
+        "w_up": normal(keys[5], (l, d, f)),
+        "w_down": normal(keys[6], (l, f, d)),
+    }
+    params: Params = {
+        "embed": normal(keys[7], (v, d)),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), pdt),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = normal(keys[8], (d, v))
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    """RMSNorm with float32 accumulation (HF casts to fp32 for the variance)."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * scale.astype(jnp.float32)).astype(dtype)
+
+
+def rope_tables(
+    cfg: LlamaConfig, seq_len: int, offset: int | jax.Array = 0
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables in the HF rotate-half convention: frequencies are
+    computed for the half head-dim then concatenated with themselves.
+    Shapes [seq_len, head_dim], float32. ``offset`` may be a traced scalar
+    (e.g. ``axis_index`` under shard_map for sequence parallelism)."""
+    hd = cfg.head_dim
+    inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    pos = jnp.arange(seq_len, dtype=jnp.float32) + offset
+    freqs = jnp.outer(pos, inv_freq)                     # [S, hd/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)       # [S, hd]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [..., S, H, hd]; cos/sin: [S, hd]. HF rotate_half convention."""
+    cos = cos[:, None, :].astype(x.dtype)  # [S, 1, hd]
+    sin = sin[:, None, :].astype(x.dtype)
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return x * cos + rotated * sin
+
+
+# Large-but-finite mask value (HF uses finfo.min similarly): a fully-masked
+# score row softmaxes to uniform instead of NaN, so loss-masked padding rows
+# can never poison the batch loss via NaN * 0.
+MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def causal_mask(s: int, valid: jax.Array | None = None) -> jax.Array:
+    """Additive [B|1, 1, S, S] float32 mask: causal, optionally restricted to
+    ``valid`` [B, S] key positions (1 = real token)."""
+    qi = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+    ok = (qi >= ki)[None]                      # [1, S, S]
+    if valid is not None:
+        ok = ok & (valid[:, None, :] > 0)      # [B, S, S]
+    return jnp.where(ok, 0.0, MASK_VALUE)[:, None]
+
+
+def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array | None) -> jax.Array:
+    """Reference attention: q,k,v [B, S, H, hd] (k/v already GQA-expanded),
+    mask [B?, 1, S, S] additive or None -> causal. Softmax in float32."""
+    b, s, h, hd = q.shape
+    scale = 1.0 / math.sqrt(hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is None:
+        mask = causal_mask(s)
+    scores = scores + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _attention(cfg: LlamaConfig, q, k, v, mask, axis_name: str | None):
+    """Dispatch on cfg.attention_impl. Ring attention requires being inside
+    a shard_map with the sequence axis bound to ``axis_name``; flash ignores
+    padding masks (packed fixed-length sequences don't need one)."""
+    if cfg.attention_impl not in ("dense", "flash", "ring"):
+        raise ValueError(f"unknown attention_impl: {cfg.attention_impl!r}")
+    if cfg.attention_impl == "flash" and mask is None:
+        from nanodiloco_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    if cfg.attention_impl == "ring" and axis_name is not None:
+        if mask is not None:
+            raise NotImplementedError(
+                "ring attention supports packed (mask-free) sequences only; "
+                "drop the padding mask (pack fixed-length sequences) or use "
+                "attention_impl='dense'"
+            )
+        from nanodiloco_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, axis_name=axis_name)
+    return dense_attention(q, k, v, mask)
+
+
+# ---------------------------------------------------------------------------
+# Decoder
+# ---------------------------------------------------------------------------
+
+def _decoder_layer(cfg: LlamaConfig, x, layer: Params, cos, sin, mask, sp_axis):
+    b, s, d = x.shape
+    nh, nkv, hd = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    cdt = x.dtype
+
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+    q = (h @ layer["wq"].astype(cdt)).reshape(b, s, nh, hd)
+    k = (h @ layer["wk"].astype(cdt)).reshape(b, s, nkv, hd)
+    v = (h @ layer["wv"].astype(cdt)).reshape(b, s, nkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if nkv != nh:  # GQA: expand kv heads to query heads
+        k = jnp.repeat(k, nh // nkv, axis=2)
+        v = jnp.repeat(v, nh // nkv, axis=2)
+    attn = _attention(cfg, q, k, v, mask, sp_axis)
+    x = x + attn.reshape(b, s, nh * hd) @ layer["wo"].astype(cdt)
+
+    h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+    gate = jax.nn.silu(h @ layer["w_gate"].astype(cdt))
+    up = h @ layer["w_up"].astype(cdt)
+    x = x + (gate * up) @ layer["w_down"].astype(cdt)
+    return x
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    attn_mask: jax.Array | None = None,
+    sp_axis: str | None = None,
+    position_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] float32.
+
+    ``attn_mask`` is an optional [B, S] 0/1 validity mask (1 = real token);
+    it is combined with causal masking. ``sp_axis`` names the mesh axis the
+    sequence dim is sharded over when running ring attention inside a
+    shard_map; ``position_offset`` is this shard's global start position.
+    """
+    cdt = jnp.dtype(cfg.dtype)
+    b, s = tokens.shape
+    x = params["embed"].astype(cdt)[tokens]
+    cos, sin = rope_tables(cfg, s, offset=position_offset)
+
+    mask = None
+    if attn_mask is not None:
+        mask = causal_mask(s, valid=attn_mask)  # [B, 1, S, S]
+
+    # Bind all non-array arguments (cfg, sp_axis) BEFORE jax.checkpoint so
+    # only JAX types flow through the remat boundary.
+    def layer_fn(x, layer, cos, sin, mask):
+        return _decoder_layer(cfg, x, layer, cos, sin, mask, sp_axis)
+
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def scan_body(carry, layer):
+        return layer_fn(carry, layer, cos, sin, mask), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head", None)
+    if head is None:
+        head = params["embed"].T
+    logits = x @ head.astype(cdt)
+    return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Loss
+# ---------------------------------------------------------------------------
+
+def causal_lm_loss(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    loss_mask: jax.Array | None = None,
+    sp_axis: str | None = None,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Mean next-token cross-entropy with internal label shift.
+
+    ``loss_mask`` [B, S] marks real (non-pad) tokens; positions whose
+    TARGET is padding are excluded — the reference trained on pad tokens
+    (ref nanodiloco/main.py:87, SURVEY §2 quirks), which we deliberately fix.
+    Returns (loss, aux) with aux = {"n_tokens": ..., "sum_loss": ...} so
+    microbatch losses can be combined exactly under grad accumulation.
+    """
+    logits = forward(params, tokens, cfg, attn_mask=loss_mask, sp_axis=sp_axis)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]  # [B, S-1]
+    if loss_mask is not None:
+        m = loss_mask[:, 1:].astype(nll.dtype)
+    else:
+        m = jnp.ones_like(nll)
+    sum_loss = jnp.sum(nll * m)
+    n = jnp.maximum(jnp.sum(m), 1.0)
+    return sum_loss / n, {"n_tokens": jnp.sum(m), "sum_loss": sum_loss}

@@ -1,0 +1,76 @@
+"""Memory-efficient causal attention.
+
+``flash_attention`` is the framework-facing API. The current
+implementation is blockwise online-softmax attention expressed as
+``lax.scan`` over key/value blocks with per-block rematerialization —
+O(S * block) live memory instead of O(S^2), differentiable through the
+scan (no custom VJP needed), and XLA fuses the inner block into MXU
+matmuls + VPU elementwise. A hand-written Pallas TPU kernel can replace
+the block inner loop behind this same signature (see ops/pallas/).
+
+Causal-only and mask-free by design: the data pipeline packs fixed-length
+sequences (data/), so padding masks are not needed on the hot path. Use
+``dense_attention`` (models/llama.py) when a padding mask is required.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nanodiloco_tpu.ops.online_softmax import block_update, finalize
+
+
+@partial(jax.jit, static_argnames=("causal", "block_size"))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_size: int = 512,
+) -> jax.Array:
+    """q, k, v: [B, S, H, hd] (K/V already GQA-expanded). Returns same shape.
+
+    Online-softmax over K/V blocks of ``block_size`` (clamped to S); the
+    query axis stays whole — queries are cheap, the S^2 score matrix is
+    what must never materialize.
+    """
+    b, s, h, hd = q.shape
+    blk = min(block_size, s)
+    if s % blk:
+        raise ValueError(f"seq_len {s} must be divisible by block_size {blk}")
+    nblk = s // blk
+    scale = 1.0 / math.sqrt(hd)
+
+    qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, S, hd]
+    kb = jnp.transpose(k, (0, 2, 1, 3)).reshape(b, h, nblk, blk, hd)
+    vb = jnp.transpose(v, (0, 2, 1, 3)).reshape(b, h, nblk, blk, hd)
+    kb = jnp.moveaxis(kb, 2, 0)  # [nblk, B, H, blk, hd]
+    vb = jnp.moveaxis(vb, 2, 0)
+
+    q_pos = lax.broadcasted_iota(jnp.int32, (s,), 0)
+
+    def body(carry, blk_in):
+        o, l, m, j = carry
+        k_j, v_j = blk_in
+        scores = (
+            jnp.einsum("bhqd,bhkd->bhqk", qt, k_j).astype(jnp.float32) * scale
+        )
+        if causal:
+            k_pos = j * blk + lax.broadcasted_iota(jnp.int32, (blk,), 0)
+            allowed = q_pos[:, None] >= k_pos[None, :]  # [S, blk]
+            scores = jnp.where(allowed[None, None], scores, -jnp.inf)
+        o, l, m = block_update(o, l, m, scores, v_j)
+        return (o, l, m, j + 1), None
+
+    o0 = jnp.zeros((b, h, s, hd), jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    m0 = jnp.full((b, h, s), -jnp.inf, jnp.float32)
+    (o, l, _, _), _ = lax.scan(
+        jax.checkpoint(body), (o0, l0, m0, jnp.zeros((), jnp.int32)), (kb, vb)
+    )
+    return finalize(o, l, q.dtype)

@@ -1,0 +1,42 @@
+"""Shared online-softmax (flash-attention) block recurrence.
+
+One numerically delicate implementation used by both the blockwise kernel
+(ops/flash_attention.py) and ring attention (ops/ring_attention.py), so
+the -inf handling can never drift between them. All accumulators are
+float32; layouts are [B, H, Sq, ...].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def block_update(
+    o: jax.Array,      # [B, H, Sq, hd] float32 accumulator (un-normalized)
+    l: jax.Array,      # [B, H, Sq] float32 softmax denominator accumulator
+    m: jax.Array,      # [B, H, Sq] float32 running max (may be -inf)
+    scores: jax.Array,  # [B, H, Sq, Sk] float32, masked entries at -inf
+    v: jax.Array,      # [B, H, Sk, hd] value block (any dtype)
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One block of the online-softmax recurrence; returns (o, l, m_new).
+
+    Fully-masked rows (all -inf so far) stay at m=-inf with l=0 and o=0,
+    so the caller's final `o / max(l, eps)` yields zeros, never NaN.
+    """
+    block_max = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m, block_max)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(jnp.where(jnp.isfinite(scores), scores - m_safe[..., None], -jnp.inf))
+    corr = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+    l = l * corr + jnp.sum(p, axis=-1)
+    o = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v
+    ).astype(jnp.float32)
+    return o, l, m_new
+
+
+def finalize(o: jax.Array, l: jax.Array, out_dtype) -> jax.Array:
+    """[B, H, S, hd] accumulators -> [B, S, H, hd] normalized output."""
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(out_dtype)

@@ -1,0 +1,72 @@
+"""Ring attention: causal self-attention with the sequence sharded over a
+mesh axis (``sp``), K/V blocks rotating around the ring via ``ppermute``.
+
+This is the long-context path the reference lacks entirely (SURVEY §5
+"Long-context / sequence parallelism: Absent") but which is first-class
+here: each device holds S/N of the sequence, peak activation memory is
+O(S/N), and the N-1 ring steps overlap each block's (Sq/N x Sk/N) matmul
+with the neighbor-to-neighbor ICI transfer of the next K/V block.
+
+Semantics: GLOBAL causal attention over packed (mask-free) sequences.
+Shard i holds query positions [i*S_loc, (i+1)*S_loc); a K/V block that
+originated on shard j is
+- fully visible if j < i,
+- locally causal if j == i,
+- fully masked if j > i (its contribution is dropped branchlessly so the
+  loop stays compiled control flow).
+
+Numerics: the shared online-softmax recurrence in float32
+(ops/online_softmax.py), bit-comparable to dense attention up to
+reassociation. Must be called inside ``jax.shard_map`` with ``axis_name``
+bound. The ring is unrolled (the axis size is static), so the final
+iteration performs no wasted K/V transfer.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nanodiloco_tpu.ops.online_softmax import block_update, finalize
+
+
+def ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str
+) -> jax.Array:
+    """q, k, v: [B, S_loc, H, hd] (K/V already GQA-expanded to H heads).
+    Returns [B, S_loc, H, hd] in q's dtype."""
+    b, s, h, hd = q.shape
+    n = lax.psum(1, axis_name)  # static: mesh axis size
+    idx = lax.axis_index(axis_name)
+    scale = 1.0 / math.sqrt(hd)
+
+    qi = lax.broadcasted_iota(jnp.int32, (s, s), 0)
+    ki = lax.broadcasted_iota(jnp.int32, (s, s), 1)
+    local_causal = qi >= ki  # [Sq, Sk]
+
+    qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, Sq, hd]
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+
+    # Derive the initial accumulators from q so they carry shard_map's
+    # "varying over sp" type (plain jnp.zeros would be unvarying and
+    # mismatch the incremental-update types under shard_map typing rules).
+    o = qt.astype(jnp.float32) * 0.0
+    l = o[..., 0]
+    m = o[..., 0] - jnp.inf
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    for t in range(n):
+        src = (idx - t) % n  # which shard this K/V block originated on
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt).astype(jnp.float32) * scale
+        allowed = (src < idx) | ((src == idx) & local_causal[None, None])
+        scores = jnp.where(allowed, scores, -jnp.inf)
+        o, l, m = block_update(o, l, m, scores, vt)
+        if t != n - 1:  # final block needs no onward transfer
+            kt = lax.ppermute(kt, axis_name, perm)
+            vt = lax.ppermute(vt, axis_name, perm)
+
+    return finalize(o, l, q.dtype)

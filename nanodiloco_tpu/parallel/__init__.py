@@ -1,0 +1,17 @@
+from nanodiloco_tpu.parallel.diloco import Diloco, DilocoConfig, DilocoState
+from nanodiloco_tpu.parallel.mesh import AXES, MeshConfig, build_mesh, single_device_mesh
+from nanodiloco_tpu.parallel.sharding import batch_spec, constrain, named, param_specs
+
+__all__ = [
+    "Diloco",
+    "DilocoConfig",
+    "DilocoState",
+    "MeshConfig",
+    "build_mesh",
+    "single_device_mesh",
+    "AXES",
+    "param_specs",
+    "batch_spec",
+    "named",
+    "constrain",
+]

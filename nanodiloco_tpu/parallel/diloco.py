@@ -1,0 +1,276 @@
+"""DiLoCo core: jitted inner/outer steps over a ``diloco`` mesh axis.
+
+Re-design of the reference's ``Diloco`` class
+(ref nanodiloco/diloco/diloco.py:7-74) for the XLA programming model:
+
+- Every worker's parameters live in ONE stacked pytree with a leading
+  worker axis of size W, sharded over the ``diloco`` mesh axis. The inner
+  step is ``vmap`` over that axis — XLA partitions it so each worker's
+  compute lands on its own mesh slice with zero communication, exactly the
+  DiLoCo contract (ref nanodiloco/main.py:106-113 has no collectives in
+  the inner loop either).
+- The outer step is a pure function: pseudo-gradient
+  ``snapshot - mean_over_workers(params)`` — the mean over the stacked
+  axis IS the all-reduce (XLA lowers it to an all-reduce over ``diloco``,
+  riding ICI intra-slice / DCN across slices), replacing
+  ``dist.all_reduce(AVG)`` per tensor (ref diloco.py:49). Nesterov SGD
+  then advances the snapshot (ref diloco.py:52) and every worker resets
+  to it (ref diloco.py:50) — here a broadcast back over the worker axis.
+- The reference's init-time ``dist.broadcast`` per parameter
+  (ref diloco.py:21-22) is replaced by construction: one PRNG-keyed init
+  tiled across the worker axis is bit-identical by definition.
+- The reference's CPU offload of the sync snapshot (ref diloco.py:27-32)
+  is optional here (``offload_snapshot``): on TPU the snapshot moves to
+  pinned host memory between outer steps via async device_put, freeing
+  HBM without blocking dispatch. Default off — on-chip is faster when
+  HBM allows.
+- Unlike the reference, inner/outer stepping cadence is owned by this
+  class (the reference accepted ``inner_steps`` and ignored it,
+  ref diloco.py:8-25 / SURVEY §2 quirks), and grad accumulation divides
+  correctly (the reference backpropped the undivided loss,
+  ref nanodiloco/main.py:110-111).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nanodiloco_tpu.models.config import LlamaConfig
+from nanodiloco_tpu.models.llama import causal_lm_loss, init_params
+from nanodiloco_tpu.parallel.sharding import batch_spec, constrain, param_specs
+from nanodiloco_tpu.training.optim import inner_optimizer, outer_optimizer
+
+
+@dataclasses.dataclass(frozen=True)
+class DilocoConfig:
+    """Knobs mirroring the reference CLI (ref nanodiloco/main.py:42-55)."""
+
+    num_workers: int = 1
+    inner_steps: int = 100          # H: inner steps between outer syncs
+    warmup_steps: int = 100
+    total_steps: int = 10_000
+    lr: float = 4e-4                # inner AdamW lr
+    outer_lr: float = 0.7           # outer SGD lr
+    outer_momentum: float = 0.9
+    nesterov: bool = True
+    weight_decay: float = 0.01
+    clip_norm: float | None = 1.0
+    grad_accum: int = 1             # microbatches per inner step
+    offload_snapshot: bool = False  # keep snapshot in host memory between syncs
+
+
+class DilocoState(struct.PyTreeNode):
+    params: Any          # stacked [W, ...] — each worker's current params
+    inner_opt_state: Any  # stacked [W, ...]
+    snapshot: Any        # unstacked — params at last sync (θ in the paper)
+    outer_opt_state: Any  # unstacked — Nesterov momentum buffer
+    inner_step_count: jax.Array  # completed inner steps (scalar int32)
+
+
+class Diloco:
+    """Builds and owns the jitted inner/outer step functions.
+
+    ``loss_fn(params, tokens, loss_mask) -> (loss, aux)`` defaults to the
+    Llama causal-LM loss; ``inner_tx``/``outer_tx`` default to the
+    reference's AdamW+cosine / Nesterov-SGD but are pluggable (the sync-DP
+    equivalence test swaps plain SGD in).
+    """
+
+    def __init__(
+        self,
+        model_cfg: LlamaConfig,
+        cfg: DilocoConfig,
+        mesh: Mesh,
+        loss_fn: Callable | None = None,
+        inner_tx: optax.GradientTransformation | None = None,
+        outer_tx: optax.GradientTransformation | None = None,
+    ):
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.mesh = mesh
+        self.loss_fn = loss_fn or (
+            lambda p, t, m: causal_lm_loss(p, t, model_cfg, loss_mask=m)
+        )
+        self.inner_tx = inner_tx or inner_optimizer(
+            cfg.lr, cfg.warmup_steps, cfg.total_steps,
+            weight_decay=cfg.weight_decay, clip_norm=cfg.clip_norm,
+        )
+        self.outer_tx = outer_tx or outer_optimizer(
+            cfg.outer_lr, cfg.outer_momentum, cfg.nesterov
+        )
+        self._pspec = param_specs(model_cfg, worker_axis=False)
+        self._wspec = param_specs(model_cfg, worker_axis=True)
+        self._pspec_struct = jax.tree.structure(
+            self._pspec, is_leaf=lambda x: isinstance(x, P)
+        )
+        self._host_shardings = None
+        if cfg.offload_snapshot:
+            try:
+                self._host_shardings = jax.tree.map(
+                    lambda s: NamedSharding(mesh, s, memory_kind="pinned_host"),
+                    self._pspec, is_leaf=lambda x: isinstance(x, P),
+                )
+            except Exception:  # backend without pinned_host support
+                self._host_shardings = None
+
+        self.inner_step = jax.jit(self._inner_step, donate_argnums=(0,))
+        self.outer_step = jax.jit(self._outer_step, donate_argnums=(0,))
+
+    def _constrain(self, tree: Any, worker_axis: bool) -> Any:
+        """Apply sharding constraints when ``tree`` is the model's param
+        tree; pass through unchanged for custom param trees (tests and
+        non-Llama losses plug those in)."""
+        if jax.tree.structure(tree) != self._pspec_struct:
+            return tree
+        return constrain(tree, self.mesh, self._wspec if worker_axis else self._pspec)
+
+    # -- init ---------------------------------------------------------------
+
+    def init_state(self, rng: jax.Array) -> DilocoState:
+        W = self.cfg.num_workers
+
+        def _init():
+            p = init_params(rng, self.model_cfg)
+            p = self._constrain(p, worker_axis=False)
+            stacked = jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), p
+            )
+            stacked = self._constrain(stacked, worker_axis=True)
+            inner_state = jax.vmap(self.inner_tx.init)(stacked)
+            outer_state = self.outer_tx.init(p)
+            return DilocoState(
+                params=stacked,
+                inner_opt_state=inner_state,
+                snapshot=p,
+                outer_opt_state=outer_state,
+                inner_step_count=jnp.zeros((), jnp.int32),
+            )
+
+        with jax.set_mesh(self.mesh):
+            state = jax.jit(_init)()
+        return self._offload(state)
+
+    # -- inner step (H of these between syncs; zero cross-worker comms) -----
+
+    def _inner_step(self, state: DilocoState, tokens: jax.Array, loss_mask: jax.Array):
+        """tokens/loss_mask: [W, accum, B, S]. One optimizer update per
+        worker from ``accum`` accumulated microbatch gradients. Unlike the
+        reference (which backpropped the undivided loss, ref
+        nanodiloco/main.py:110-111), accumulation here is an exact
+        token-weighted mean: microbatch gradients are weighted by their
+        real-token counts when the loss provides ``n_tokens`` aux."""
+        if tokens.ndim != 4:
+            raise ValueError(f"tokens must be [W, accum, B, S]; got shape {tokens.shape}")
+        if tokens.shape[0] != self.cfg.num_workers:
+            raise ValueError(
+                f"batch worker axis is {tokens.shape[0]} but num_workers is "
+                f"{self.cfg.num_workers}"
+            )
+        if tokens.shape[1] != self.cfg.grad_accum:
+            raise ValueError(
+                f"batch accumulation axis is {tokens.shape[1]} but grad_accum is "
+                f"{self.cfg.grad_accum}"
+            )
+        tokens = jax.lax.with_sharding_constraint(
+            tokens, NamedSharding(self.mesh, batch_spec())
+        )
+        loss_mask = jax.lax.with_sharding_constraint(
+            loss_mask, NamedSharding(self.mesh, batch_spec())
+        )
+
+        def worker_update(params, opt_state, w_tokens, w_mask):
+            grad_fn = jax.value_and_grad(self.loss_fn, has_aux=True)
+
+            def micro(carry, batch):
+                g_acc, loss_acc, n_acc = carry
+                (loss, aux), g = grad_fn(params, batch[0], batch[1])
+                # token-weighted accumulation when the loss reports counts
+                # (causal_lm_loss does); plain mean-of-means otherwise.
+                w = (
+                    aux["n_tokens"].astype(jnp.float32)
+                    if isinstance(aux, dict) and "n_tokens" in aux
+                    else jnp.ones((), jnp.float32)
+                )
+                g_acc = jax.tree.map(lambda a, b: a + w * b, g_acc, g)
+                return (g_acc, loss_acc + loss, n_acc + w), None
+
+            zeros = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+            (g_sum, loss_sum, n_sum), _ = jax.lax.scan(
+                micro,
+                (zeros, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+                (w_tokens, w_mask),
+            )
+            accum = w_tokens.shape[0]
+            grads = jax.tree.map(lambda g: g / jnp.maximum(n_sum, 1e-9), g_sum)
+            updates, opt_state = self.inner_tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss_sum / accum
+
+        params, inner_opt_state, loss = jax.vmap(worker_update)(
+            state.params, state.inner_opt_state, tokens, loss_mask
+        )
+        params = self._constrain(params, worker_axis=True)
+        state = state.replace(
+            params=params,
+            inner_opt_state=inner_opt_state,
+            inner_step_count=state.inner_step_count + 1,
+        )
+        return state, loss  # loss: [W] per-worker mean microbatch loss
+
+    # -- outer step (the ONLY recurring communication) -----------------------
+
+    def _outer_step(self, state: DilocoState) -> DilocoState:
+        W = self.cfg.num_workers
+        # mean over the worker axis == all-reduce over the `diloco` mesh axis
+        avg = jax.tree.map(lambda p: jnp.mean(p, axis=0), state.params)
+        avg = self._constrain(avg, worker_axis=False)
+        # pseudo-gradient, pre-averaged (ref diloco.py:48-49)
+        delta = jax.tree.map(jnp.subtract, state.snapshot, avg)
+        updates, outer_opt_state = self.outer_tx.update(
+            delta, state.outer_opt_state, state.snapshot
+        )
+        snapshot = optax.apply_updates(state.snapshot, updates)
+        snapshot = self._constrain(snapshot, worker_axis=False)
+        # every worker resets to the new sync point (ref diloco.py:50)
+        params = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), snapshot
+        )
+        params = self._constrain(params, worker_axis=True)
+        return state.replace(
+            params=params, snapshot=snapshot, outer_opt_state=outer_opt_state
+        )
+
+    # -- snapshot host offload (ref diloco.py:27-32, made async) -------------
+
+    def _offload(self, state: DilocoState) -> DilocoState:
+        if self._host_shardings is None:
+            return state
+        if jax.tree.structure(state.snapshot) != self._pspec_struct:
+            return state
+        snap = jax.device_put(state.snapshot, self._host_shardings)
+        return state.replace(snapshot=snap)
+
+    def run_round(self, state: DilocoState, batches) -> tuple[DilocoState, jax.Array]:
+        """One full DiLoCo round: exactly ``cfg.inner_steps`` inner steps,
+        then the outer sync. ``batches`` is an iterator yielding
+        ([W, accum, B, S] tokens, same-shape mask); cadence is owned here —
+        the reference accepted ``inner_steps`` and ignored it
+        (ref diloco.py:8-25, SURVEY §2 quirks).
+
+        Raises StopIteration if the data runs out mid-round (the caller
+        decides whether a partial round should sync)."""
+        it = iter(batches)
+        losses = []
+        for _ in range(self.cfg.inner_steps):
+            tokens, mask = next(it)
+            state, loss = self.inner_step(state, tokens, mask)
+            losses.append(loss)
+        state = self.outer_step(state)
+        return self._offload(state), jnp.stack(losses)

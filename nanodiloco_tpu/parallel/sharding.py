@@ -1,0 +1,81 @@
+"""Sharding rules: one PartitionSpec per weight name.
+
+Because per-layer weights are stacked on a leading layer axis
+(models/llama.py), a single spec shards every layer; the DiLoCo worker
+axis, when present, is a further leading axis mapped to ``"diloco"``.
+
+Layout (2D "megatron-style" over fsdp x tp):
+- column-parallel producers (wq/wk/wv, w_gate/w_up): input dim on fsdp,
+  output dim on tp — the following reduction over the tp-sharded dim is
+  a single XLA-inserted all-reduce per block, riding ICI;
+- row-parallel consumers (wo, w_down) the transpose;
+- embedding sharded over (tp=vocab, fsdp=features); untied head the
+  transpose; norm scales replicated.
+
+XLA's SPMD partitioner inserts all collectives; nothing here issues one.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nanodiloco_tpu.models.config import LlamaConfig
+
+
+def param_specs(cfg: LlamaConfig, worker_axis: bool = False) -> dict[str, Any]:
+    """PartitionSpec pytree matching models.llama.init_params' tree."""
+    specs = {
+        "embed": P("tp", "fsdp"),
+        "final_norm": P(),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "tp"),
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, "fsdp", "tp"),
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P("fsdp", "tp")
+    if worker_axis:
+        specs = jax.tree.map(
+            lambda s: P("diloco", *s), specs, is_leaf=lambda x: isinstance(x, P)
+        )
+    return specs
+
+
+def batch_spec(worker_axis: bool = True, accum_axis: bool = True, sp: bool = False) -> P:
+    """Token batches are [W, accum, B, S] (or sub-layouts): workers over
+    ``diloco``, per-worker batch over ``fsdp`` (data-parallel inside a
+    worker), optionally sequence over ``sp``."""
+    dims = []
+    if worker_axis:
+        dims.append("diloco")
+    if accum_axis:
+        dims.append(None)
+    dims.append("fsdp")
+    dims.append("sp" if sp else None)
+    return P(*dims)
+
+
+def named(mesh: Mesh, spec_tree: Any) -> Any:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), spec_tree, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def constrain(tree: Any, mesh: Mesh, spec_tree: Any) -> Any:
+    """with_sharding_constraint over a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda x, s: jax.lax.with_sharding_constraint(x, NamedSharding(mesh, s)),
+        tree,
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )

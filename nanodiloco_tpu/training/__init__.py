@@ -1,0 +1,7 @@
+from nanodiloco_tpu.training.optim import (
+    inner_optimizer,
+    outer_optimizer,
+    warmup_cosine_schedule,
+)
+
+__all__ = ["inner_optimizer", "outer_optimizer", "warmup_cosine_schedule"]

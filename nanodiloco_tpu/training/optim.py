@@ -1,0 +1,64 @@
+"""Optimizers and schedules (optax), numerically matching the reference.
+
+- Inner: AdamW(lr, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01) — torch
+  defaults, ref nanodiloco/main.py:100 — under a warmup+cosine schedule
+  equivalent to ``transformers.get_cosine_schedule_with_warmup``
+  (ref nanodiloco/diloco/diloco.py:4,20), preceded by global-norm clipping
+  at 1.0 (ref nanodiloco/diloco/diloco.py:57).
+- Outer: SGD(outer_lr, momentum=0.9, nesterov=True)
+  (ref nanodiloco/main.py:101). optax's nesterov trace is the same
+  recurrence as torch's (dampening=0).
+
+All transforms are pure pytree functions, so they vmap over the stacked
+DiLoCo worker axis unchanged.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def warmup_cosine_schedule(base_lr: float, warmup_steps: int, total_steps: int) -> optax.Schedule:
+    """Exact port of HF get_cosine_schedule_with_warmup (num_cycles=0.5):
+    linear 0 -> base_lr over ``warmup_steps``, then cosine to 0 at
+    ``total_steps``. Step 0 (the first update) uses lr=0, matching torch
+    scheduler semantics where the lambda is evaluated at the count of
+    *completed* steps.
+    """
+
+    def schedule(count):
+        count = jnp.asarray(count, jnp.float32)
+        warm = count / jnp.maximum(1.0, warmup_steps)
+        progress = (count - warmup_steps) / jnp.maximum(1.0, total_steps - warmup_steps)
+        cos = jnp.maximum(0.0, 0.5 * (1.0 + jnp.cos(jnp.pi * progress)))
+        return base_lr * jnp.where(count < warmup_steps, warm, cos)
+
+    return schedule
+
+
+def inner_optimizer(
+    lr: float,
+    warmup_steps: int,
+    total_steps: int,
+    weight_decay: float = 0.01,
+    clip_norm: float | None = 1.0,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+) -> optax.GradientTransformation:
+    """Clip -> AdamW with the warmup-cosine schedule (the reference's
+    inner_step pipeline, ref nanodiloco/diloco/diloco.py:56-60)."""
+    schedule = warmup_cosine_schedule(lr, warmup_steps, total_steps)
+    tx = optax.adamw(schedule, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+    if clip_norm is not None:
+        return optax.chain(optax.clip_by_global_norm(clip_norm), tx)
+    return tx
+
+
+def outer_optimizer(
+    outer_lr: float, momentum: float = 0.9, nesterov: bool = True
+) -> optax.GradientTransformation:
+    """Nesterov-momentum SGD applied to the averaged pseudo-gradient
+    (ref nanodiloco/main.py:101, diloco.py:52)."""
+    return optax.sgd(outer_lr, momentum=momentum, nesterov=nesterov)

@@ -1,0 +1,38 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+This is the reference-free way to test multi-worker DiLoCo semantics
+(SURVEY §4): collectives over a mesh of fake devices exercise the same
+SPMD partitioning XLA uses on a real slice.
+
+Note: this environment preloads jax at interpreter startup
+(sitecustomize), so env-var configuration (JAX_PLATFORMS / XLA_FLAGS)
+is too late by the time conftest runs. ``jax.config.update`` still works
+as long as no backend has been initialized, which is the case here.
+"""
+
+import os
+
+# Harmless if jax is already imported; effective if it is not.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_backend():
+    assert jax.default_backend() == "cpu", (
+        "tests must run on the virtual CPU mesh, not real accelerators; "
+        f"got {jax.default_backend()}"
+    )

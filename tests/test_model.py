@@ -1,0 +1,155 @@
+"""Model unit tests: shapes, causality, loss masking, and bit-level parity
+with the reference's model (HF LlamaForCausalLM, ref nanodiloco/main.py:97-99)
+via torch-CPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanodiloco_tpu.models import LlamaConfig, causal_lm_loss, forward, init_params
+
+CFG = LlamaConfig(vocab_size=256, max_position_embeddings=128)
+
+
+def test_forward_shapes():
+    params = init_params(jax.random.key(0), CFG)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, CFG.vocab_size)
+    logits = forward(params, tokens, CFG)
+    assert logits.shape == (2, 16, CFG.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_param_count_matches_formula():
+    params = init_params(jax.random.key(0), CFG)
+    n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    assert n == CFG.num_params()
+
+
+def test_causality():
+    """Changing token t must not affect logits at positions < t."""
+    params = init_params(jax.random.key(0), CFG)
+    tokens = jax.random.randint(jax.random.key(1), (1, 12), 0, CFG.vocab_size)
+    logits_a = forward(params, tokens, CFG)
+    tokens_b = tokens.at[0, 7].set((tokens[0, 7] + 1) % CFG.vocab_size)
+    logits_b = forward(params, tokens_b, CFG)
+    np.testing.assert_allclose(
+        np.asarray(logits_a[0, :7]), np.asarray(logits_b[0, :7]), rtol=1e-5, atol=1e-5
+    )
+    assert not np.allclose(np.asarray(logits_a[0, 7:]), np.asarray(logits_b[0, 7:]))
+
+
+def test_gqa_forward():
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=64, num_attention_heads=8, num_key_value_heads=2,
+        num_hidden_layers=2, intermediate_size=128,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    logits = forward(params, jnp.zeros((1, 8), jnp.int32), cfg)
+    assert logits.shape == (1, 8, 64)
+
+
+def test_loss_mask_excludes_padding():
+    """Loss must ignore positions whose target is padding — fixing the
+    reference's train-on-pad quirk (ref nanodiloco/main.py:87, SURVEY §2)."""
+    params = init_params(jax.random.key(0), CFG)
+    tokens = jax.random.randint(jax.random.key(1), (1, 16), 1, CFG.vocab_size)
+    mask_full = jnp.ones((1, 16), jnp.int32)
+    # Same prefix, garbage suffix marked as padding:
+    tokens_padded = tokens.at[0, 8:].set(0)
+    mask_padded = mask_full.at[0, 8:].set(0)
+    loss_a, aux_a = causal_lm_loss(params, tokens_padded, CFG, loss_mask=mask_padded)
+    tokens_padded2 = tokens.at[0, 8:].set(5)
+    loss_b, aux_b = causal_lm_loss(params, tokens_padded2, CFG, loss_mask=mask_padded)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+    assert int(aux_a["n_tokens"]) == 7  # 8 valid tokens -> 7 shifted targets
+    loss_c, _ = causal_lm_loss(params, tokens, CFG, loss_mask=mask_full)
+    assert not np.isclose(float(loss_a), float(loss_c))
+
+
+def test_tied_embeddings():
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_attention_heads=4,
+                      num_hidden_layers=2, intermediate_size=64, tie_word_embeddings=True)
+    params = init_params(jax.random.key(0), cfg)
+    assert "lm_head" not in params
+    logits = forward(params, jnp.zeros((1, 4), jnp.int32), cfg)
+    assert logits.shape == (1, 4, 64)
+
+
+# ---------------------------------------------------------------------------
+# HF parity — the credibility anchor for loss-curve comparison (SURVEY §7e)
+# ---------------------------------------------------------------------------
+
+def _hf_to_pytree(hf_model, cfg: LlamaConfig):
+    """Copy HF torch weights into our pytree ([in, out] layout = HF's .T)."""
+    import torch
+
+    sd = {k: v.detach().to(torch.float32).numpy() for k, v in hf_model.state_dict().items()}
+    l = cfg.num_hidden_layers
+
+    def stack(fmt, transpose=True):
+        ws = [sd[fmt.format(i)] for i in range(l)]
+        ws = [w.T if transpose else w for w in ws]
+        return jnp.asarray(np.stack(ws))
+
+    params = {
+        "embed": jnp.asarray(sd["model.embed_tokens.weight"]),
+        "final_norm": jnp.asarray(sd["model.norm.weight"]),
+        "lm_head": jnp.asarray(sd["lm_head.weight"].T),
+        "layers": {
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight", transpose=False),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight", transpose=False),
+            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
+            "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
+        },
+    }
+    return params
+
+
+@pytest.mark.parametrize("kv_heads", [4, 2])
+def test_hf_llama_logit_parity(kv_heads):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=128, intermediate_size=512,
+        num_attention_heads=4, num_key_value_heads=kv_heads, num_hidden_layers=3,
+        max_position_embeddings=64,
+    )
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=kv_heads,
+        num_hidden_layers=cfg.num_hidden_layers,
+        rms_norm_eps=cfg.rms_norm_eps, use_cache=False,
+        max_position_embeddings=cfg.max_position_embeddings,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    params = _hf_to_pytree(hf_model, cfg)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 32))
+    with torch.no_grad():
+        hf_out = hf_model(input_ids=torch.tensor(tokens)).logits.numpy()
+    # This XLA CPU build lowers fp32 matmuls to reduced precision by
+    # default; force true fp32 for the numerics comparison.
+    with jax.default_matmul_precision("highest"):
+        ours = np.asarray(forward(params, jnp.asarray(tokens), cfg))
+        np.testing.assert_allclose(ours, hf_out, rtol=2e-4, atol=2e-4)
+
+        # Loss parity with HF's internal shift (all-ones mask).
+        with torch.no_grad():
+            hf_loss = hf_model(
+                input_ids=torch.tensor(tokens), labels=torch.tensor(tokens)
+            ).loss.item()
+        our_loss, _ = causal_lm_loss(params, jnp.asarray(tokens), cfg)
+        np.testing.assert_allclose(float(our_loss), hf_loss, rtol=1e-4)
