@@ -1,0 +1,110 @@
+"""Benchmark: DiLoCo training throughput on the available hardware.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
+
+Workload = the reference's default training configuration
+(ref /root/reference/nanodiloco/main.py:43-52): tiny Llama
+(hidden 128 x 6 layers, vocab 32000), per-device batch 8, seq 1024,
+grad-accum microbatches, AdamW inner / Nesterov outer. The reference
+publishes no numbers (BASELINE.md), so ``vs_baseline`` compares against
+the last self-recorded run in bench_baseline.json when present
+(ratio > 1.0 means faster than the recorded baseline).
+
+Also reports the outer all-reduce wall-clock share — the metric the
+reference stubbed out but never implemented
+(ref nanodiloco/diloco/diloco.py:23-24,62-64).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    from nanodiloco_tpu.models import LlamaConfig
+    from nanodiloco_tpu.parallel import Diloco, DilocoConfig, MeshConfig, build_mesh
+
+    n_dev = int(os.environ.get("BENCH_DEVICES", "1"))
+    grad_accum = int(os.environ.get("BENCH_GRAD_ACCUM", "4"))
+    inner_steps = int(os.environ.get("BENCH_INNER_STEPS", "10"))
+    rounds = int(os.environ.get("BENCH_ROUNDS", "3"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+
+    model_cfg = LlamaConfig(vocab_size=32000, dtype="bfloat16")
+    mesh = build_mesh(MeshConfig(diloco=n_dev), devices=jax.devices()[:n_dev])
+    cfg = DilocoConfig(
+        num_workers=n_dev, inner_steps=inner_steps, warmup_steps=10,
+        total_steps=10_000, lr=4e-4, grad_accum=grad_accum,
+    )
+    dl = Diloco(model_cfg, cfg, mesh)
+    state = dl.init_state(jax.random.key(0))
+
+    tokens_per_inner_step = n_dev * grad_accum * batch * seq
+    key = jax.random.key(1)
+
+    def make_batch(key):
+        tok = jax.random.randint(key, (n_dev, grad_accum, batch, seq), 0, model_cfg.vocab_size)
+        return tok, jnp.ones_like(tok)
+
+    # warmup: compile inner + outer step
+    key, k = jax.random.split(key)
+    tok, mask = make_batch(k)
+    state, _ = dl.inner_step(state, tok, mask)
+    state = dl.outer_step(state)
+    jax.block_until_ready(state.params)
+
+    inner_time = 0.0
+    outer_time = 0.0
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(inner_steps):
+            key, k = jax.random.split(key)
+            tok, mask = make_batch(k)
+            state, loss = dl.inner_step(state, tok, mask)
+        jax.block_until_ready(loss)
+        t1 = time.perf_counter()
+        state = dl.outer_step(state)
+        jax.block_until_ready(state.params)
+        t2 = time.perf_counter()
+        inner_time += t1 - t0
+        outer_time += t2 - t1
+
+    total_inner_steps = rounds * inner_steps
+    tok_per_sec = total_inner_steps * tokens_per_inner_step / inner_time
+    tok_per_sec_chip = tok_per_sec / n_dev
+    sync_share = outer_time / (inner_time + outer_time)
+    avg_sync_ms = outer_time / rounds * 1e3
+
+    baseline = None
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            baseline = json.load(f).get("tokens_per_sec_per_chip")
+
+    result = {
+        "metric": "tokens_per_sec_per_chip",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tok_per_sec_chip / baseline, 4) if baseline else 1.0,
+        "devices": n_dev,
+        "backend": jax.default_backend(),
+        "model": "llama-tiny-15M (hidden 128 x 6 layers, ref default)",
+        "per_device_batch": batch,
+        "seq_length": seq,
+        "grad_accum": grad_accum,
+        "final_loss": round(float(jnp.mean(loss)), 4),
+        "outer_sync_share": round(sync_share, 5),
+        "avg_outer_sync_ms": round(avg_sync_ms, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,184 @@
+// tokenshard: memory-mapped token storage + deterministic shuffled batch
+// assembly for the data pipeline.
+//
+// The reference's data path is torch DataLoader + HF datasets map-tokenize
+// (ref nanodiloco/training_utils/utils.py:45-55, main.py:79-96) — Python
+// objects per example, per-batch padding, GIL-bound collation. This native
+// layer replaces the hot path with:
+//   - an mmap'd shard file of fixed-length int32 sequences (zero-copy
+//     reads, page-cache friendly for epoch re-reads),
+//   - multithreaded row gather into a caller-provided batch buffer,
+//   - a deterministic in-library shuffle (splitmix64-seeded Fisher-Yates)
+//     so every host computes identical batch order with no coordination.
+//
+// File layout (little-endian):
+//   [0:8)   magic "TSHRD\x01\x00\x00"
+//   [8:16)  uint64 n_seqs
+//   [16:24) uint64 seq_len
+//   [24:..) int32 data, row-major [n_seqs, seq_len]
+//
+// C ABI only (consumed via ctypes from nanodiloco_tpu/data/tokenshard.py).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[8] = {'T', 'S', 'H', 'R', 'D', 1, 0, 0};
+constexpr uint64_t kHeaderBytes = 24;
+
+struct Shard {
+  int fd = -1;
+  const uint8_t* map = nullptr;
+  uint64_t map_bytes = 0;
+  uint64_t n_seqs = 0;
+  uint64_t seq_len = 0;
+  const int32_t* data = nullptr;
+};
+
+// splitmix64: tiny, well-mixed PRNG — stable across platforms/compilers,
+// unlike std::mt19937 usage patterns.
+inline uint64_t splitmix64(uint64_t& s) {
+  uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- writer ---------------------------------------------------------------
+
+int ts_write(const char* path, const int32_t* data, uint64_t n_seqs,
+             uint64_t seq_len) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return -1;
+  uint8_t header[kHeaderBytes];
+  memcpy(header, kMagic, 8);
+  memcpy(header + 8, &n_seqs, 8);
+  memcpy(header + 16, &seq_len, 8);
+  if (fwrite(header, 1, kHeaderBytes, f) != kHeaderBytes) {
+    fclose(f);
+    return -2;
+  }
+  const uint64_t total = n_seqs * seq_len;
+  if (fwrite(data, sizeof(int32_t), total, f) != total) {
+    fclose(f);
+    return -3;
+  }
+  return fclose(f) == 0 ? 0 : -4;
+}
+
+// ---- reader ---------------------------------------------------------------
+
+Shard* ts_open(const char* path) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || static_cast<uint64_t>(st.st_size) < kHeaderBytes) {
+    close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (map == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  const uint8_t* bytes = static_cast<const uint8_t*>(map);
+  if (memcmp(bytes, kMagic, 8) != 0) {
+    munmap(map, st.st_size);
+    close(fd);
+    return nullptr;
+  }
+  auto* s = new Shard;
+  s->fd = fd;
+  s->map = bytes;
+  s->map_bytes = st.st_size;
+  memcpy(&s->n_seqs, bytes + 8, 8);
+  memcpy(&s->seq_len, bytes + 16, 8);
+  if (s->map_bytes < kHeaderBytes + s->n_seqs * s->seq_len * sizeof(int32_t)) {
+    munmap(map, st.st_size);
+    close(fd);
+    delete s;
+    return nullptr;
+  }
+  s->data = reinterpret_cast<const int32_t*>(bytes + kHeaderBytes);
+  // epoch reads sweep the whole file; tell the kernel
+  madvise(map, st.st_size, MADV_WILLNEED);
+  return s;
+}
+
+uint64_t ts_n_seqs(const Shard* s) { return s->n_seqs; }
+uint64_t ts_seq_len(const Shard* s) { return s->seq_len; }
+
+void ts_close(Shard* s) {
+  if (!s) return;
+  munmap(const_cast<uint8_t*>(s->map), s->map_bytes);
+  close(s->fd);
+  delete s;
+}
+
+// Gather rows `indices[0..count)` into `out` ([count, seq_len] int32),
+// split across up to `n_threads` threads (0 -> hardware concurrency).
+int ts_gather(const Shard* s, const uint64_t* indices, uint64_t count,
+              int32_t* out, int n_threads) {
+  const uint64_t row_bytes = s->seq_len * sizeof(int32_t);
+  for (uint64_t i = 0; i < count; ++i) {
+    if (indices[i] >= s->n_seqs) return -1;
+  }
+  unsigned hw = std::thread::hardware_concurrency();
+  unsigned workers = n_threads > 0 ? static_cast<unsigned>(n_threads)
+                                   : (hw ? hw : 1);
+  if (workers > count) workers = static_cast<unsigned>(count ? count : 1);
+  if (workers <= 1) {
+    for (uint64_t i = 0; i < count; ++i) {
+      memcpy(out + i * s->seq_len, s->data + indices[i] * s->seq_len, row_bytes);
+    }
+    return 0;
+  }
+  std::vector<std::thread> threads;
+  std::atomic<uint64_t> next{0};
+  constexpr uint64_t kChunk = 64;
+  for (unsigned t = 0; t < workers; ++t) {
+    threads.emplace_back([&]() {
+      for (;;) {
+        uint64_t begin = next.fetch_add(kChunk);
+        if (begin >= count) break;
+        uint64_t end = begin + kChunk < count ? begin + kChunk : count;
+        for (uint64_t i = begin; i < end; ++i) {
+          memcpy(out + i * s->seq_len, s->data + indices[i] * s->seq_len,
+                 row_bytes);
+        }
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  return 0;
+}
+
+// Deterministic permutation of [0, n) from (seed, epoch, worker):
+// Fisher-Yates driven by splitmix64. Identical output on every host.
+void ts_shuffled_indices(uint64_t n, uint64_t seed, uint64_t epoch,
+                         uint64_t worker, uint64_t* out) {
+  for (uint64_t i = 0; i < n; ++i) out[i] = i;
+  uint64_t s = seed * 0x9e3779b97f4a7c15ULL + epoch * 0xbf58476d1ce4e5b9ULL +
+               worker * 0x94d049bb133111ebULL + 1;
+  for (uint64_t i = n; i > 1; --i) {
+    uint64_t j = splitmix64(s) % i;
+    uint64_t tmp = out[i - 1];
+    out[i - 1] = out[j];
+    out[j] = tmp;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,5 @@
+"""``python -m nanodiloco_tpu`` entry (≡ ref nanodiloco/__main__.py:1-3)."""
+
+from nanodiloco_tpu.cli import main
+
+main()

@@ -1,0 +1,141 @@
+"""CLI — flag-for-flag superset of the reference's cyclopts surface
+(ref nanodiloco/main.py:41-56: seed, batch_size, per_device_batch_size,
+seq_length, warmup_steps, total_steps, inner_steps, lr, outer_lr,
+project, dataset_path, llama_config_file, wandb_config_file), plus the
+TPU-native knobs (workers/mesh axes/dtype/attention/checkpointing).
+
+Usage:
+    python -m nanodiloco_tpu --num-workers 4 --total-steps 1000 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+
+from nanodiloco_tpu.models.config import LlamaConfig
+from nanodiloco_tpu.training.train_loop import TrainConfig, train
+
+
+def load_config_from_file(path: str) -> dict:
+    """≡ ref main.py:37-39."""
+    with open(path) as f:
+        return json.load(f)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nanodiloco_tpu",
+        description="TPU-native DiLoCo training (JAX/XLA).",
+    )
+    # --- the reference's 13 flags (ref main.py:42-55) ---
+    p.add_argument("--seed", type=int, default=1337)
+    p.add_argument("--batch-size", type=int, default=256,
+                   help="per-worker global batch (microbatches x per-device)")
+    p.add_argument("--per-device-batch-size", type=int, default=8)
+    p.add_argument("--seq-length", type=int, default=1024)
+    p.add_argument("--warmup-steps", type=int, default=100)
+    p.add_argument("--total-steps", type=int, default=10_000)
+    p.add_argument("--inner-steps", type=int, default=100)
+    p.add_argument("--lr", type=float, default=4e-4)
+    p.add_argument("--outer-lr", type=float, default=0.7)
+    p.add_argument("--project", type=str, default="nano-diloco")
+    p.add_argument("--dataset-path", type=str, default=None,
+                   help="datasets.save_to_disk dir (ref c4-tiny layout); "
+                        "default: built-in synthetic corpus")
+    p.add_argument("--llama-config-file", type=str, default=None,
+                   help="HF-style model config JSON (ref configs/llama_default.json)")
+    p.add_argument("--wandb-config-file", type=str, default=None)
+    # --- TPU-native knobs ---
+    p.add_argument("--num-workers", type=int, default=1,
+                   help="DiLoCo workers = size of the diloco mesh axis")
+    p.add_argument("--fsdp", type=int, default=1, help="fsdp mesh axis size per worker")
+    p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis size")
+    p.add_argument("--dtype", type=str, default=None,
+                   help="compute dtype override (e.g. bfloat16)")
+    p.add_argument("--attention", type=str, default=None,
+                   choices=["dense", "flash", "ring"])
+    p.add_argument("--tokenizer", type=str, default=None,
+                   help="HF tokenizer name/path; default byte-level fallback")
+    p.add_argument("--offload-snapshot", action="store_true",
+                   help="keep the DiLoCo sync snapshot in host memory")
+    p.add_argument("--checkpoint-dir", type=str, default=None)
+    p.add_argument("--checkpoint-every", type=int, default=1,
+                   help="checkpoint cadence in outer syncs")
+    p.add_argument("--no-resume", action="store_true")
+    p.add_argument("--wandb", action="store_true")
+    p.add_argument("--log-dir", type=str, default="runs")
+    p.add_argument("--quiet", action="store_true")
+    p.add_argument("--run-name", type=str, default=None)
+    p.add_argument("--force-cpu-devices", type=int, default=None, metavar="N",
+                   help="simulate an N-device mesh on CPU (sharding dev/debug; "
+                        "must be the first thing to touch JAX in the process)")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> TrainConfig:
+    model = (
+        LlamaConfig.from_dict(load_config_from_file(args.llama_config_file))
+        if args.llama_config_file
+        else LlamaConfig()
+    )
+    overrides = {}
+    if args.dtype:
+        overrides["dtype"] = args.dtype
+    if args.attention:
+        overrides["attention_impl"] = args.attention
+    if overrides:
+        model = dataclasses.replace(model, **overrides)
+    wandb_config = (
+        load_config_from_file(args.wandb_config_file) if args.wandb_config_file else {}
+    )
+    return TrainConfig(
+        seed=args.seed,
+        batch_size=args.batch_size,
+        per_device_batch_size=args.per_device_batch_size,
+        seq_length=args.seq_length,
+        warmup_steps=args.warmup_steps,
+        total_steps=args.total_steps,
+        inner_steps=args.inner_steps,
+        lr=args.lr,
+        outer_lr=args.outer_lr,
+        project=args.project,
+        dataset_path=args.dataset_path,
+        num_workers=args.num_workers,
+        fsdp=args.fsdp,
+        tp=args.tp,
+        model=model,
+        tokenizer=args.tokenizer,
+        offload_snapshot=args.offload_snapshot,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=not args.no_resume,
+        use_wandb=args.wandb,
+        log_dir=args.log_dir,
+        quiet=args.quiet,
+        run_name=args.run_name,
+        wandb_config=wandb_config,
+    )
+
+
+def main(argv: list[str] | None = None) -> None:
+    print("Training DiLoCo with nanodiloco_tpu...")  # ≡ ref main.py:134
+    args = build_parser().parse_args(argv)
+    if args.force_cpu_devices:
+        # Must precede backend initialization; env vars are NOT enough in
+        # environments that preload jax at interpreter start.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.force_cpu_devices)
+    summary = train(config_from_args(args))
+    print(
+        f"Training completed! final_loss={summary['final_loss']:.4f} "
+        f"avg_sync={summary['avg_sync_time_s'] * 1e3:.1f}ms "
+        f"comm_share={summary['comm_share']:.2%}"
+    )
+
+
+if __name__ == "__main__":
+    main()

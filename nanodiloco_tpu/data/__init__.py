@@ -1,0 +1,19 @@
+from nanodiloco_tpu.data.pipeline import (
+    DilocoBatcher,
+    load_hf_dataset_texts,
+    pack_corpus,
+    pad_corpus,
+    synthetic_corpus,
+)
+from nanodiloco_tpu.data.tokenizer import ByteTokenizer, HFTokenizer, get_tokenizer
+
+__all__ = [
+    "DilocoBatcher",
+    "pack_corpus",
+    "pad_corpus",
+    "synthetic_corpus",
+    "load_hf_dataset_texts",
+    "get_tokenizer",
+    "ByteTokenizer",
+    "HFTokenizer",
+]

@@ -1,0 +1,251 @@
+"""Data pipeline: tokenize -> pack -> deterministic per-worker batches.
+
+TPU-first redesign of the reference's pipeline (ref
+nanodiloco/training_utils/utils.py:45-55 + nanodiloco/main.py:75-96):
+
+- The reference tokenizes with truncation at 1024 and pads each batch to
+  its longest example (dynamic shapes per batch, loss computed on pad,
+  ref main.py:79-88). Here documents are PACKED into fixed-length
+  sequences: static shapes for a single jit cache entry, zero pad waste,
+  no masks on the hot path. A ``padded`` mode reproduces the reference's
+  per-document layout (with correct pad masking) when needed.
+- ``split_dataset_by_node`` (ref main.py:77) becomes a deterministic
+  strided shard per DiLoCo worker; shuffle/drop_last (ref main.py:94-95)
+  become a seeded per-epoch permutation — identical on every host, so
+  multi-host data order needs no communication.
+- Batches come out in the DiLoCo engine's native layout
+  [num_workers, grad_accum, per_device_batch, seq_len].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+from nanodiloco_tpu.data.tokenizer import Tokenizer
+
+
+# ---------------------------------------------------------------------------
+# Corpus sources
+# ---------------------------------------------------------------------------
+
+def synthetic_corpus(n_docs: int = 2000, seed: int = 0) -> list[str]:
+    """Deterministic pseudo-English corpus for offline tests/benches.
+    Structured (zipfian vocabulary, repeated phrases) so models can
+    actually learn from it, unlike uniform noise."""
+    rng = np.random.default_rng(seed)
+    vocab = [
+        "the", "of", "and", "to", "in", "a", "is", "that", "for", "it",
+        "model", "data", "train", "step", "loss", "worker", "sync", "token",
+        "mesh", "shard", "device", "batch", "grad", "outer", "inner", "ring",
+    ]
+    probs = 1.0 / np.arange(1, len(vocab) + 1)
+    probs /= probs.sum()
+    docs = []
+    for _ in range(n_docs):
+        n_words = int(rng.integers(20, 200))
+        words = rng.choice(vocab, size=n_words, p=probs)
+        docs.append(" ".join(words) + ".")
+    return docs
+
+
+def load_hf_dataset_texts(path: str, split: str = "train", column: str = "text") -> list[str]:
+    """Read texts from a ``datasets.save_to_disk`` directory — the
+    reference's on-disk c4-tiny layout (ref
+    scripts/setup_data_volume.py:27-56, utils.py:45-55)."""
+    from datasets import load_from_disk
+
+    ds = load_from_disk(path)
+    if hasattr(ds, "keys") and split in getattr(ds, "keys", lambda: [])():
+        ds = ds[split]
+    return list(ds[column])
+
+
+# ---------------------------------------------------------------------------
+# Tokenize + pack
+# ---------------------------------------------------------------------------
+
+def pack_corpus(
+    texts: list[str], tokenizer: Tokenizer, seq_length: int = 1024
+) -> np.ndarray:
+    """Tokenize all docs (eos-separated) and pack the token stream into
+    [N, seq_length] int32 rows. The trailing partial block is dropped."""
+    stream: list[int] = []
+    for t in texts:
+        stream.extend(tokenizer.encode(t, add_eos=True))
+    n = len(stream) // seq_length
+    if n == 0:
+        raise ValueError(
+            f"corpus too small: {len(stream)} tokens < seq_length {seq_length}"
+        )
+    arr = np.asarray(stream[: n * seq_length], dtype=np.int32)
+    return arr.reshape(n, seq_length)
+
+
+def pad_corpus(
+    texts: list[str], tokenizer: Tokenizer, seq_length: int = 1024
+) -> tuple[np.ndarray, np.ndarray]:
+    """Reference-style layout: one document per row, truncated at
+    seq_length (ref utils.py:50), padded to a multiple of 8 columns
+    (ref main.py:84). Returns (tokens [N, S'], mask [N, S']) with pad
+    positions masked OUT of the loss (fixing ref main.py:87)."""
+    encoded = [tokenizer.encode(t)[:seq_length] for t in texts]
+    encoded = [e for e in encoded if len(e) >= 2]
+    max_len = max(len(e) for e in encoded)
+    max_len = ((max_len + 7) // 8) * 8
+    tokens = np.full((len(encoded), max_len), tokenizer.pad_id, dtype=np.int32)
+    mask = np.zeros((len(encoded), max_len), dtype=np.int32)
+    for i, e in enumerate(encoded):
+        tokens[i, : len(e)] = e
+        mask[i, : len(e)] = 1
+    return tokens, mask
+
+
+# ---------------------------------------------------------------------------
+# Deterministic per-worker batching
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class DilocoBatcher:
+    """Yields ([W, accum, B, S] tokens, same-shape mask) batches.
+
+    Worker w reads the strided shard ``data[w::num_workers]`` (the
+    deterministic analog of split_dataset_by_node, ref main.py:77), with
+    a per-epoch seeded permutation per worker and drop_last semantics
+    (ref main.py:94-95). Fully reproducible from ``seed`` alone; no state
+    lives outside this object.
+    """
+
+    data: np.ndarray                 # [N, S] int32
+    num_workers: int
+    grad_accum: int
+    per_device_batch: int
+    seed: int = 1337
+    mask: np.ndarray | None = None   # [N, S]; None -> all-ones
+
+    def __post_init__(self) -> None:
+        if self.data.ndim != 2:
+            raise ValueError(f"data must be [N, S]; got {self.data.shape}")
+        self._shards = [
+            np.arange(w, len(self.data), self.num_workers)
+            for w in range(self.num_workers)
+        ]
+        per_step = self.grad_accum * self.per_device_batch
+        self.steps_per_epoch = min(len(s) for s in self._shards) // per_step
+        if self.steps_per_epoch == 0:
+            raise ValueError(
+                f"shards of {min(len(s) for s in self._shards)} sequences cannot "
+                f"fill one inner step of {per_step} ({self.grad_accum} microbatches "
+                f"x {self.per_device_batch})"
+            )
+
+    def epoch(self, epoch: int, start_step: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """One pass over every worker's shard, shuffled per (seed, epoch,
+        worker), trailing remainder dropped. ``start_step`` skips forward
+        without materializing the skipped batches (O(1) resume)."""
+        W, A, B = self.num_workers, self.grad_accum, self.per_device_batch
+        S = self.data.shape[1]
+        per_step = A * B
+        orders = [
+            self._shards[w][
+                np.random.default_rng((self.seed, epoch, w)).permutation(len(self._shards[w]))
+            ]
+            for w in range(W)
+        ]
+        for step in range(start_step, self.steps_per_epoch):
+            tokens = np.empty((W, A, B, S), dtype=np.int32)
+            mask = np.empty((W, A, B, S), dtype=np.int32)
+            for w in range(W):
+                idx = orders[w][step * per_step : (step + 1) * per_step]
+                tokens[w] = self.data[idx].reshape(A, B, S)
+                mask[w] = (
+                    self.mask[idx].reshape(A, B, S)
+                    if self.mask is not None
+                    else np.ones((A, B, S), np.int32)
+                )
+            yield tokens, mask
+
+    def iter_from(self, global_step: int) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """Endless stream positioned at ``global_step`` inner steps from
+        the beginning — deterministic resume without replaying data."""
+        epoch, offset = divmod(global_step, self.steps_per_epoch)
+        while True:
+            yield from self.epoch(epoch, start_step=offset)
+            epoch, offset = epoch + 1, 0
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """Endless stream across epochs (the reference iterates its
+        DataLoader once and simply stops at shard exhaustion,
+        ref main.py:106; callers here bound the run by total_steps)."""
+        return self.iter_from(0)
+
+
+@dataclasses.dataclass
+class ShardBatcher:
+    """DilocoBatcher backed by the native tokenshard reader
+    (csrc/tokenshard.cpp): mmap'd rows, threaded gather, and the
+    in-library deterministic shuffle. Same [W, accum, B, S] output
+    contract; batch ORDER differs from DilocoBatcher (different PRNG) but
+    is itself fully deterministic from the seed on every host."""
+
+    path: str
+    num_workers: int
+    grad_accum: int
+    per_device_batch: int
+    seed: int = 1337
+
+    def __post_init__(self) -> None:
+        from nanodiloco_tpu.data.tokenshard import TokenShard
+
+        self._ts = TokenShard(self.path)
+        self.seq_len = self._ts.seq_len
+        n_shard = min(
+            len(range(w, self._ts.n_seqs, self.num_workers))
+            for w in range(self.num_workers)
+        )
+        per_step = self.grad_accum * self.per_device_batch
+        self.steps_per_epoch = n_shard // per_step
+        self._n_shard = n_shard
+        if self.steps_per_epoch == 0:
+            raise ValueError(
+                f"shards of {n_shard} sequences cannot fill one inner step of "
+                f"{per_step} ({self.grad_accum} x {self.per_device_batch})"
+            )
+
+    def epoch(self, epoch: int, start_step: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        W, A, B, S = self.num_workers, self.grad_accum, self.per_device_batch, self.seq_len
+        per_step = A * B
+        from nanodiloco_tpu.data.tokenshard import _py_shuffled_indices
+        orders = []
+        for w in range(W):
+            # permute the worker's strided shard positions, then map to
+            # global row ids (w + W * local)
+            if self._ts._handle is not None:
+                local = np.empty(self._n_shard, dtype=np.uint64)
+                self._ts._lib.ts_shuffled_indices(
+                    self._n_shard, self.seed, epoch, w, local.ctypes.data
+                )
+            else:
+                local = _py_shuffled_indices(self._n_shard, self.seed, epoch, w)
+            orders.append(np.uint64(w) + np.uint64(W) * local)
+        for step in range(start_step, self.steps_per_epoch):
+            tokens = np.empty((W, A, B, S), dtype=np.int32)
+            for w in range(W):
+                idx = orders[w][step * per_step : (step + 1) * per_step]
+                tokens[w] = self._ts.batch(idx).reshape(A, B, S)
+            yield tokens, np.ones_like(tokens)
+
+    def iter_from(self, global_step: int) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """O(1)-skip endless stream (see DilocoBatcher.iter_from)."""
+        epoch, offset = divmod(global_step, self.steps_per_epoch)
+        while True:
+            yield from self.epoch(epoch, start_step=offset)
+            epoch, offset = epoch + 1, 0
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        return self.iter_from(0)
+
+    def close(self) -> None:
+        self._ts.close()

@@ -1,0 +1,86 @@
+"""Tokenizers.
+
+The reference hard-depends on fetching ``huggyllama/llama-7b`` from the
+HF hub (ref nanodiloco/training_utils/utils.py:57-60) — impossible in an
+offline TPU pod. Here the HF tokenizer is used when available (cached or
+local path) with a deterministic, dependency-free byte-level fallback, so
+the training stack is runnable anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    pad_id: int
+    eos_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """Byte-level tokenizer: ids 0..255 are raw bytes; 256=pad, 257=bos,
+    258=eos. Vocab padded to 384 (divisible by 128) so the lm_head matmul
+    tiles cleanly onto the MXU."""
+
+    vocab_size = 384
+    pad_id = 256
+    bos_id = 257
+    eos_id = 258
+
+    def encode(self, text: str, add_bos: bool = False, add_eos: bool = False) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        if add_bos:
+            ids = [self.bos_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Wrapper over a transformers tokenizer, matching the reference's
+    pad-token choice (``</s>``, ref utils.py:59)."""
+
+    def __init__(self, name_or_path: str = "huggyllama/llama-7b"):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(name_or_path)
+        if self._tok.pad_token is None:
+            self._tok.pad_token = self._tok.eos_token or "</s>"
+        self.vocab_size = len(self._tok)
+        self.pad_id = self._tok.pad_token_id
+        self.eos_id = self._tok.eos_token_id
+
+    def encode(self, text: str, add_bos: bool = False, add_eos: bool = False) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=add_bos)
+        if add_eos and self.eos_id is not None:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids) -> str:
+        return self._tok.decode(list(ids))
+
+
+def get_tokenizer(name_or_path: str | None = None) -> Tokenizer:
+    """HF tokenizer when reachable (local cache/path), else ByteTokenizer.
+    Mirrors the reference's get_tokenizer (ref utils.py:57-60) but never
+    requires network access. A failed explicit request falls back WITH a
+    warning — silent vocab switches corrupt runs invisibly."""
+    if name_or_path:
+        try:
+            return HFTokenizer(name_or_path)
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"could not load tokenizer {name_or_path!r} ({type(e).__name__}: {e}); "
+                "falling back to the 384-token byte-level tokenizer",
+                stacklevel=2,
+            )
+    return ByteTokenizer()

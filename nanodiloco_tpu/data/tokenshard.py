@@ -1,0 +1,177 @@
+"""ctypes bindings for the native tokenshard reader (csrc/tokenshard.cpp).
+
+The shared library is built on first use with g++ (cached beside the
+source); every call degrades gracefully to a pure-numpy implementation
+when no compiler is available, so the framework never hard-depends on
+the native layer — it is a throughput upgrade, not a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SRC = os.path.join(_CSRC, "tokenshard.cpp")
+_LIB_PATH = os.path.join(_CSRC, "libtokenshard.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_failed = False
+
+_MAGIC = b"TSHRD\x01\x00\x00"
+_HEADER = 24
+
+
+def _build_and_load() -> ctypes.CDLL | None:
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB_PATH) or (
+                os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+            ):
+                subprocess.run(
+                    ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
+                     "-fPIC", "-pthread", "-o", _LIB_PATH, _SRC],
+                    check=True, capture_output=True,
+                )
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.ts_write.restype = ctypes.c_int
+            lib.ts_write.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                     ctypes.c_uint64, ctypes.c_uint64]
+            lib.ts_open.restype = ctypes.c_void_p
+            lib.ts_open.argtypes = [ctypes.c_char_p]
+            lib.ts_n_seqs.restype = ctypes.c_uint64
+            lib.ts_n_seqs.argtypes = [ctypes.c_void_p]
+            lib.ts_seq_len.restype = ctypes.c_uint64
+            lib.ts_seq_len.argtypes = [ctypes.c_void_p]
+            lib.ts_close.argtypes = [ctypes.c_void_p]
+            lib.ts_gather.restype = ctypes.c_int
+            lib.ts_gather.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_uint64, ctypes.c_void_p, ctypes.c_int]
+            lib.ts_shuffled_indices.argtypes = [
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_void_p,
+            ]
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return _build_and_load() is not None
+
+
+def write_shard(path: str, data: np.ndarray) -> None:
+    """Write [N, S] int32 tokens to a tokenshard file."""
+    data = np.ascontiguousarray(data, dtype=np.int32)
+    if data.ndim != 2:
+        raise ValueError(f"data must be [N, S]; got {data.shape}")
+    lib = _build_and_load()
+    if lib is not None:
+        rc = lib.ts_write(path.encode(), data.ctypes.data, data.shape[0], data.shape[1])
+        if rc != 0:
+            raise OSError(f"ts_write failed with code {rc} for {path}")
+        return
+    with open(path, "wb") as f:  # numpy fallback, same format
+        f.write(_MAGIC)
+        f.write(np.asarray(data.shape, dtype=np.uint64).tobytes())
+        f.write(data.tobytes())
+
+
+class TokenShard:
+    """Reader for one shard file: mmap'd rows + deterministic shuffling.
+
+    ``batch(indices)`` gathers rows into a fresh [len(indices), S] array
+    (threaded memcpy natively); ``shuffled_indices(seed, epoch, worker)``
+    is the C++ Fisher-Yates (or a bit-identical numpy re-implementation
+    in fallback mode — both derive from splitmix64, so mixing native and
+    fallback hosts still yields identical batch order).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lib = _build_and_load()
+        self._handle = None
+        if self._lib is not None:
+            self._handle = self._lib.ts_open(path.encode())
+            if not self._handle:
+                raise OSError(f"cannot open tokenshard {path}")
+            self.n_seqs = int(self._lib.ts_n_seqs(self._handle))
+            self.seq_len = int(self._lib.ts_seq_len(self._handle))
+        else:
+            with open(path, "rb") as f:
+                header = f.read(_HEADER)
+            if header[:8] != _MAGIC:
+                raise OSError(f"bad magic in {path}")
+            n, s = np.frombuffer(header[8:], dtype=np.uint64)
+            self.n_seqs, self.seq_len = int(n), int(s)
+            self._mm = np.memmap(path, dtype=np.int32, mode="r", offset=_HEADER,
+                                 shape=(self.n_seqs, self.seq_len))
+
+    def batch(self, indices: np.ndarray, n_threads: int = 0) -> np.ndarray:
+        indices = np.ascontiguousarray(indices, dtype=np.uint64)
+        if self._handle is not None:
+            out = np.empty((len(indices), self.seq_len), dtype=np.int32)
+            rc = self._lib.ts_gather(
+                self._handle, indices.ctypes.data, len(indices),
+                out.ctypes.data, n_threads,
+            )
+            if rc != 0:
+                raise IndexError(f"tokenshard index out of range (rc={rc})")
+            return out
+        if (indices >= self.n_seqs).any():
+            raise IndexError("tokenshard index out of range")
+        return np.asarray(self._mm[indices.astype(np.int64)])
+
+    def shuffled_indices(self, seed: int, epoch: int, worker: int) -> np.ndarray:
+        out = np.empty(self.n_seqs, dtype=np.uint64)
+        if self._handle is not None:
+            self._lib.ts_shuffled_indices(self.n_seqs, seed, epoch, worker,
+                                          out.ctypes.data)
+            return out
+        return _py_shuffled_indices(self.n_seqs, seed, epoch, worker)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.ts_close(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _splitmix64(state: np.uint64) -> tuple[np.uint64, np.uint64]:
+    with np.errstate(over="ignore"):
+        state = np.uint64(state + np.uint64(0x9E3779B97F4A7C15))
+        z = state
+        z = np.uint64((z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9))
+        z = np.uint64((z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB))
+        return state, np.uint64(z ^ (z >> np.uint64(31)))
+
+
+def _py_shuffled_indices(n: int, seed: int, epoch: int, worker: int) -> np.ndarray:
+    """Bit-identical to ts_shuffled_indices in csrc/tokenshard.cpp."""
+    out = np.arange(n, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        s = np.uint64(
+            np.uint64(seed) * np.uint64(0x9E3779B97F4A7C15)
+            + np.uint64(epoch) * np.uint64(0xBF58476D1CE4E5B9)
+            + np.uint64(worker) * np.uint64(0x94D049BB133111EB)
+            + np.uint64(1)
+        )
+    for i in range(n, 1, -1):
+        s, r = _splitmix64(s)
+        j = int(r % np.uint64(i))
+        out[i - 1], out[j] = out[j], out[i - 1]
+    return out

@@ -9,8 +9,10 @@ Layout (2D "megatron-style" over fsdp x tp):
   output dim on tp — the following reduction over the tp-sharded dim is
   a single XLA-inserted all-reduce per block, riding ICI;
 - row-parallel consumers (wo, w_down) the transpose;
-- embedding sharded over (tp=vocab, fsdp=features); untied head the
-  transpose; norm scales replicated.
+- embedding: vocab axis replicated (a token gather from a vocab-sharded
+  table forces XLA into replicate-then-reshard), features over fsdp;
+  the untied lm_head carries the tp-sharded vocab on its matmul side;
+  norm scales replicated.
 
 XLA's SPMD partitioner inserts all collectives; nothing here issues one.
 """
@@ -28,7 +30,11 @@ from nanodiloco_tpu.models.config import LlamaConfig
 def param_specs(cfg: LlamaConfig, worker_axis: bool = False) -> dict[str, Any]:
     """PartitionSpec pytree matching models.llama.init_params' tree."""
     specs = {
-        "embed": P("tp", "fsdp"),
+        # vocab axis deliberately NOT sharded: a token gather from a
+        # vocab-sharded table forces XLA into full rematerialization
+        # (replicate-then-reshard); features shard over fsdp instead, and
+        # the tp-sharded vocab lives on the matmul-side lm_head only.
+        "embed": P(None, "fsdp"),
         "final_norm": P(),
         "layers": {
             "attn_norm": P(None, None),

@@ -1,7 +1,26 @@
+from nanodiloco_tpu.training.metrics import MetricsLogger, SyncTimer
 from nanodiloco_tpu.training.optim import (
     inner_optimizer,
     outer_optimizer,
     warmup_cosine_schedule,
 )
 
-__all__ = ["inner_optimizer", "outer_optimizer", "warmup_cosine_schedule"]
+__all__ = [
+    "inner_optimizer",
+    "outer_optimizer",
+    "warmup_cosine_schedule",
+    "TrainConfig",
+    "train",
+    "MetricsLogger",
+    "SyncTimer",
+]
+
+
+def __getattr__(name):
+    # Lazy: train_loop imports parallel.diloco, which imports
+    # training.optim — an eager import here would be circular.
+    if name in ("TrainConfig", "train"):
+        from nanodiloco_tpu.training import train_loop
+
+        return getattr(train_loop, name)
+    raise AttributeError(name)

@@ -1,0 +1,59 @@
+"""Checkpoint/resume via Orbax — absent in the reference (its output
+volume was mounted but never written, ref scripts/train_modal.py:43-45 +
+SURVEY §5 "Checkpoint / resume: Absent"); table stakes for multi-hour
+TPU runs.
+
+The full DiLoCo state is saved: every worker's params, inner optimizer
+states, the sync snapshot, outer momentum, and the inner-step counter —
+a restore resumes bit-exactly mid-round.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from nanodiloco_tpu.parallel.diloco import DilocoState
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+        self.directory = os.path.abspath(directory)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: DilocoState, force: bool = False) -> None:
+        self._mngr.save(step, args=ocp.args.StandardSave(state), force=force)
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    @property
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore(self, abstract_state: Any, step: int | None = None) -> DilocoState:
+        """``abstract_state``: a DilocoState of jax.ShapeDtypeStruct leaves
+        (e.g. from ``jax.eval_shape`` of init) carrying target shardings,
+        so arrays restore directly to their mesh placement."""
+        step = self.latest_step if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract_state))
+
+    def close(self) -> None:
+        self._mngr.close()
+
+
+def abstract_state_like(state: DilocoState) -> DilocoState:
+    """Shape/dtype/sharding skeleton of a concrete state, for restore."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding), state
+    )

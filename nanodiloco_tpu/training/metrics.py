@@ -1,0 +1,104 @@
+"""Metrics: the reference's dead comm-measurement scaffolding, made real.
+
+The reference initialized ``_sync_time``/``_sync_calls`` counters and an
+``avg_sync_time`` property but never updated them, and its
+``measure_comms`` flag was never read (ref nanodiloco/diloco/diloco.py:
+23-24,62-64; configs/wandb_default.json:5). Here outer-sync wall-clock,
+inner-step time, and throughput are first-class: every outer step is
+timed with ``block_until_ready`` fences and the comm share is reported —
+the north-star metric in /root/repo/BASELINE.json.
+
+Sinks: JSONL file (always), stdout (rank-0 style), wandb when installed
+and configured — the reference logged via wandb only (ref main.py:118-127)
+and crashed latently on non-zero nodes (SURVEY §2); here the file sink is
+the source of truth.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+
+class SyncTimer:
+    """Accumulates outer-sync wall-clock (the reference's avg_sync_time
+    stub, real)."""
+
+    def __init__(self) -> None:
+        self._sync_time = 0.0
+        self._sync_calls = 0
+        self._t0: float | None = None
+
+    def __enter__(self) -> "SyncTimer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._sync_time += time.perf_counter() - self._t0
+        self._sync_calls += 1
+        self._t0 = None
+
+    @property
+    def avg_sync_time(self) -> float:
+        return self._sync_time / self._sync_calls if self._sync_calls else 0.0
+
+    @property
+    def total(self) -> float:
+        return self._sync_time
+
+    @property
+    def calls(self) -> int:
+        return self._sync_calls
+
+
+class MetricsLogger:
+    def __init__(
+        self,
+        run_name: str,
+        out_dir: str | None = None,
+        use_wandb: bool = False,
+        wandb_project: str = "nano-diloco",
+        config: dict | None = None,
+        quiet: bool = False,
+    ) -> None:
+        self.run_name = run_name
+        self.quiet = quiet
+        self._file = None
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            self.path = os.path.join(out_dir, f"{run_name}.jsonl")
+            self._file = open(self.path, "a")
+        self._wandb = None
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb
+                wandb.init(project=wandb_project, name=run_name, config=config or {})
+            except Exception:
+                self._wandb = None  # wandb missing/offline: JSONL remains
+
+    def log(self, metrics: dict[str, Any], step: int | None = None) -> None:
+        rec = dict(metrics)
+        if step is not None:
+            rec["step"] = step
+        if self._file:
+            self._file.write(json.dumps(rec) + "\n")
+            self._file.flush()
+        if self._wandb:
+            self._wandb.log(rec)
+        if not self.quiet:
+            parts = " ".join(
+                f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in rec.items()
+            )
+            print(f"[{self.run_name}] {parts}", flush=True)
+
+    def finish(self) -> None:
+        if self._file:
+            self._file.close()
+            self._file = None
+        if self._wandb:
+            self._wandb.finish()

@@ -1,0 +1,234 @@
+"""Training driver — the TPU-native analog of the reference's
+``train_model`` (ref nanodiloco/main.py:41-130).
+
+One process drives the whole mesh (single-controller JAX): there is no
+rank discovery, no env-var plumbing, no per-process DataLoader — the
+worker axis lives inside the arrays. Differences from the reference,
+all deliberate:
+
+- cadence: the driver counts REAL steps (optimizer updates), not
+  microbatches; grad accumulation happens inside the jitted inner step
+  (scan), so ``real_step`` is an int, not the float it was in the
+  reference (ref main.py:66,107 — float division then float modulo).
+- loss scaling: exact token-weighted accumulation (ref backpropped the
+  undivided loss, main.py:110-111).
+- logging: per-inner-step metrics including a REAL outer-sync wall-clock
+  share (ref stubs never updated, diloco.py:23-24) and tokens/sec.
+- checkpoint/resume: Orbax, every ``checkpoint_every`` outer syncs
+  (absent in the reference).
+- termination: runs exactly ``total_steps`` inner steps (the reference
+  stopped whenever its single DataLoader pass ran dry, main.py:106).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nanodiloco_tpu.data import DilocoBatcher, get_tokenizer, pack_corpus, synthetic_corpus
+from nanodiloco_tpu.models.config import LlamaConfig
+from nanodiloco_tpu.parallel.diloco import Diloco, DilocoConfig
+from nanodiloco_tpu.parallel.mesh import MeshConfig, build_mesh
+from nanodiloco_tpu.training.metrics import MetricsLogger, SyncTimer
+from nanodiloco_tpu.training.optim import warmup_cosine_schedule
+from nanodiloco_tpu.utils.utils import create_run_name, set_seed_all
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """The reference CLI surface (ref main.py:42-55) plus TPU knobs."""
+
+    # reference flags
+    seed: int = 1337
+    batch_size: int = 256           # per-worker global batch (microbatches x B)
+    per_device_batch_size: int = 8
+    seq_length: int = 1024
+    warmup_steps: int = 100
+    total_steps: int = 10_000
+    inner_steps: int = 100
+    lr: float = 4e-4
+    outer_lr: float = 0.7
+    project: str = "nano-diloco"
+    dataset_path: str | None = None  # HF save_to_disk dir; None -> synthetic
+    # TPU-native knobs
+    num_workers: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    model: LlamaConfig = dataclasses.field(default_factory=LlamaConfig)
+    tokenizer: str | None = None     # HF name/path; None -> byte fallback
+    offload_snapshot: bool = False
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 1        # in outer syncs
+    resume: bool = True
+    use_wandb: bool = False
+    log_dir: str | None = "runs"
+    quiet: bool = False
+    run_name: str | None = None
+    wandb_config: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def grad_accum(self) -> int:
+        if self.batch_size % self.per_device_batch_size:
+            raise ValueError("batch_size must divide evenly by per_device_batch_size")
+        return self.batch_size // self.per_device_batch_size
+
+
+def train(cfg: TrainConfig) -> dict[str, Any]:
+    """Run the full DiLoCo training job; returns a summary dict."""
+    set_seed_all(cfg.seed)
+    if cfg.total_steps % cfg.inner_steps:
+        raise ValueError("total_steps must divide evenly by inner_steps")
+
+    mesh = build_mesh(MeshConfig(diloco=cfg.num_workers, fsdp=cfg.fsdp, tp=cfg.tp))
+    dcfg = DilocoConfig(
+        num_workers=cfg.num_workers,
+        inner_steps=cfg.inner_steps,
+        warmup_steps=cfg.warmup_steps,
+        total_steps=cfg.total_steps,
+        lr=cfg.lr,
+        outer_lr=cfg.outer_lr,
+        grad_accum=cfg.grad_accum,
+        offload_snapshot=cfg.offload_snapshot,
+    )
+
+    tokenizer = get_tokenizer(cfg.tokenizer)
+    model_cfg = cfg.model
+    if model_cfg.vocab_size < tokenizer.vocab_size:
+        model_cfg = dataclasses.replace(model_cfg, vocab_size=tokenizer.vocab_size)
+
+    if cfg.dataset_path and cfg.dataset_path.endswith(".tshrd"):
+        # pre-tokenized native tokenshard file (scripts/prepare_data.py)
+        from nanodiloco_tpu.data.pipeline import ShardBatcher
+
+        batcher = ShardBatcher(
+            cfg.dataset_path,
+            num_workers=cfg.num_workers,
+            grad_accum=cfg.grad_accum,
+            per_device_batch=cfg.per_device_batch_size,
+            seed=cfg.seed,
+        )
+        if batcher.seq_len != cfg.seq_length:
+            raise ValueError(
+                f"--seq-length {cfg.seq_length} does not match the shard's "
+                f"sequence length {batcher.seq_len} ({cfg.dataset_path}); "
+                "shards are pre-packed — re-run scripts/prepare_data.py to "
+                "change sequence length"
+            )
+        # the shard was tokenized at prepare time; size the model's vocab
+        # from its manifest, not from whatever tokenizer loads here
+        manifest_path = cfg.dataset_path + ".manifest.json"
+        if os.path.exists(manifest_path):
+            import json
+
+            with open(manifest_path) as f:
+                shard_vocab = int(json.load(f)["vocab_size"])
+            if model_cfg.vocab_size < shard_vocab:
+                model_cfg = dataclasses.replace(model_cfg, vocab_size=shard_vocab)
+    else:
+        if cfg.dataset_path:
+            from nanodiloco_tpu.data import load_hf_dataset_texts
+
+            texts = load_hf_dataset_texts(cfg.dataset_path)
+        else:
+            texts = synthetic_corpus(seed=cfg.seed)
+        packed = pack_corpus(texts, tokenizer, cfg.seq_length)
+        batcher = DilocoBatcher(
+            packed,
+            num_workers=cfg.num_workers,
+            grad_accum=cfg.grad_accum,
+            per_device_batch=cfg.per_device_batch_size,
+            seed=cfg.seed,
+        )
+
+    dl = Diloco(model_cfg, dcfg, mesh)
+    state = dl.init_state(jax.random.key(cfg.seed))
+    schedule = warmup_cosine_schedule(cfg.lr, cfg.warmup_steps, cfg.total_steps)
+
+    ckpt = None
+    if cfg.checkpoint_dir:
+        from nanodiloco_tpu.training.checkpoint import CheckpointManager, abstract_state_like
+
+        ckpt = CheckpointManager(cfg.checkpoint_dir)
+        if cfg.resume and ckpt.latest_step is not None:
+            state = ckpt.restore(abstract_state_like(state))
+
+    run_name = cfg.run_name or create_run_name(
+        "nanodiloco-tpu",
+        {"nodes": cfg.num_workers, **cfg.wandb_config},
+    )
+    logger = MetricsLogger(
+        run_name,
+        out_dir=cfg.log_dir,
+        use_wandb=cfg.use_wandb,
+        wandb_project=cfg.project,
+        config={**dataclasses.asdict(cfg.model), **cfg.wandb_config},
+        quiet=cfg.quiet,
+    )
+    sync_timer = SyncTimer()
+
+    start_step = int(state.inner_step_count)
+    tokens_per_step = (
+        cfg.num_workers * cfg.grad_accum * cfg.per_device_batch_size * cfg.seq_length
+    )
+    # deterministic O(1) resume positioning (no replayed gathers)
+    batches = batcher.iter_from(start_step)
+
+    compute_time = 0.0
+    last_loss = float("nan")
+    for real_step in range(start_step + 1, cfg.total_steps + 1):
+        tokens, mask = next(batches)
+        t0 = time.perf_counter()
+        state, loss = dl.inner_step(state, jnp.asarray(tokens), jnp.asarray(mask))
+        synced = real_step % cfg.inner_steps == 0
+        if synced:
+            jax.block_until_ready(state.params)
+            compute_time += time.perf_counter() - t0
+            with sync_timer:
+                state = dl.outer_step(state)
+                jax.block_until_ready(state.params)
+            state = dl._offload(state)
+            if ckpt and (real_step // cfg.inner_steps) % cfg.checkpoint_every == 0:
+                ckpt.save(real_step, state)
+        else:
+            jax.block_until_ready(loss)
+            compute_time += time.perf_counter() - t0
+
+        last_loss = float(jnp.mean(loss))
+        total_time = compute_time + sync_timer.total
+        logger.log(
+            {
+                "loss": last_loss,
+                "perplexity": float(np.exp(min(last_loss, 50.0))),
+                "lr": float(schedule(real_step - 1)),
+                "effective_step": real_step * cfg.num_workers,
+                "total_samples": real_step * cfg.batch_size * cfg.num_workers,
+                "tokens_per_sec": (real_step - start_step) * tokens_per_step / total_time,
+                "outer_synced": int(synced),
+                "avg_sync_time_s": sync_timer.avg_sync_time,
+                "comm_share": sync_timer.total / total_time if total_time else 0.0,
+            },
+            step=real_step,
+        )
+
+    if ckpt:
+        if ckpt.latest_step != cfg.total_steps:  # orbax refuses overwrites
+            ckpt.save(cfg.total_steps, state, force=True)
+        ckpt.wait()
+        ckpt.close()
+    logger.finish()
+    total_time = compute_time + sync_timer.total
+    return {
+        "final_loss": last_loss,
+        "steps": cfg.total_steps,
+        "avg_sync_time_s": sync_timer.avg_sync_time,
+        # 0 when the run was already complete at restore time
+        "comm_share": sync_timer.total / total_time if total_time else 0.0,
+        "run_name": run_name,
+        "state": state,
+    }

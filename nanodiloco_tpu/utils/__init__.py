@@ -1,0 +1,3 @@
+from nanodiloco_tpu.utils.utils import create_run_name, set_seed_all
+
+__all__ = ["create_run_name", "set_seed_all"]

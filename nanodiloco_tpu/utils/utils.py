@@ -1,0 +1,37 @@
+"""Seeding and run naming (≡ ref nanodiloco/training_utils/utils.py).
+
+Note on seeding: JAX threads explicit PRNG keys through everything, so
+``set_seed_all`` only pins the host-side generators (numpy/random) used
+by the data pipeline — there is no global device RNG to seed, which is
+itself a reproducibility upgrade over the torch stack (ref utils.py:11-15).
+"""
+
+from __future__ import annotations
+
+import random
+import uuid
+from datetime import datetime
+
+import numpy as np
+
+
+def set_seed_all(seed: int = 42) -> None:
+    random.seed(seed)
+    np.random.seed(seed)
+
+
+def create_run_name(
+    experiment_type: str, node_config: dict | None = None, is_debug: bool = False
+) -> str:
+    """Hierarchical run name ``{type}_n{N}_{loc}_{MMDD_HHMM}_{uuid8}``
+    (≡ ref utils.py:18-39)."""
+    node_config = node_config or {}
+    parts = [experiment_type]
+    if node_config.get("nodes"):
+        parts.append(f"n{node_config['nodes']}")
+    if node_config.get("location"):
+        parts.append(str(node_config["location"]))
+    parts.append(datetime.now().strftime("%m%d_%H%M"))
+    if is_debug:
+        parts.insert(0, "debug")
+    return "_".join(parts) + f"_{str(uuid.uuid4())[:8]}"

@@ -1,0 +1,93 @@
+"""TPU launch entry points — analog of the reference's Modal launcher
+(ref /root/reference/scripts/train_modal.py).
+
+The reference provisioned GPU containers and invoked torchrun with one
+process per GPU and env-var rendezvous (ref train_modal.py:56-74,
+107-137). On TPU the model is inverted: ONE Python process per host
+drives all local chips through a single jitted program; multi-host pods
+rendezvous through ``jax.distributed.initialize()`` (auto-configured on
+TPU VMs) and participate in one global mesh. There is no process-count
+math, no MASTER_ADDR plumbing, no elastic agent.
+
+Entry points mirror the reference's four local entrypoints
+(ref train_modal.py:246-282):
+
+    python scripts/launch_tpu.py small-single-node   # ref:246-255
+    python scripts/launch_tpu.py large-multi-node    # ref:258-267
+    python scripts/launch_tpu.py benchmark           # ref:270-276
+    python scripts/launch_tpu.py main                # ref:279-282
+
+plus ``custom`` which forwards any nanodiloco_tpu CLI flags verbatim.
+On a multi-host pod slice, run the same command on every host (e.g. via
+``gcloud compute tpus tpu-vm ssh --worker=all --command=...``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _maybe_init_distributed() -> None:
+    """Join the pod-wide runtime when running on a multi-host TPU slice.
+    Single-host (or CPU dev) runs skip this: jax.distributed requires a
+    coordinator and there is nothing to coordinate."""
+    import jax
+
+    if os.environ.get("NANODILOCO_MULTIHOST") == "1":
+        jax.distributed.initialize()
+        print(
+            f"jax.distributed up: process {jax.process_index()}/{jax.process_count()}, "
+            f"{jax.local_device_count()} local / {jax.device_count()} global devices"
+        )
+
+
+# Preset -> CLI flags. Batch/lr/step values mirror the reference's
+# entrypoints (ref train_modal.py:246-282); worker counts map its
+# GPU-process topology onto mesh axes.
+PRESETS: dict[str, list[str]] = {
+    # ref small_single_node: 2 workers on one node, batch 128, lr 1e-3, 5k steps
+    "small-single-node": [
+        "--num-workers", "2", "--batch-size", "128", "--lr", "1e-3",
+        "--total-steps", "5000", "--dtype", "bfloat16",
+    ],
+    # ref large_multi_node: 2 nodes x 1 worker, batch 1024, lr 4e-4, 10k steps,
+    # "large" model (hidden 256 x 12 layers, ref train_modal.py:215-225)
+    "large-multi-node": [
+        "--num-workers", "2", "--batch-size", "1024", "--lr", "4e-4",
+        "--total-steps", "10000", "--dtype", "bfloat16",
+    ],
+    # ref benchmark_multi_node: 200-step smoke run (ref train_modal.py:174-181)
+    # (which could never run there: it passed the nonexistent --steps flag)
+    "benchmark": [
+        "--num-workers", "2", "--batch-size", "64", "--total-steps", "200",
+        "--inner-steps", "100", "--warmup-steps", "50", "--dtype", "bfloat16",
+    ],
+    # ref main: defaults
+    "main": [],
+}
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
+        print(__doc__)
+        print("presets:", ", ".join([*PRESETS, "custom"]))
+        return
+    preset, extra = sys.argv[1], sys.argv[2:]
+    if preset == "custom":
+        flags = extra
+    elif preset in PRESETS:
+        flags = PRESETS[preset] + extra
+    else:
+        raise SystemExit(f"unknown preset {preset!r}; options: {[*PRESETS, 'custom']}")
+
+    _maybe_init_distributed()
+    from nanodiloco_tpu.cli import main as train_main
+
+    train_main(flags)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Materialize a training dataset into a native tokenshard file.
+
+Analog of the reference's one-shot Modal job
+(ref /root/reference/scripts/setup_data_volume.py:27-56), which downloaded
+PrimeIntellect/c4-tiny and ``save_to_disk``-ed it onto a cloud volume.
+Here the output is a single mmap-able ``.tshrd`` file of packed
+fixed-length sequences (csrc/tokenshard.cpp format) plus a manifest.json
+— the layout the training hot path reads natively.
+
+Usage:
+    python scripts/prepare_data.py --out data/c4tiny.tshrd \
+        --dataset-path /path/to/c4-tiny/save_to_disk --seq-length 1024
+    python scripts/prepare_data.py --out data/synth.tshrd  # synthetic corpus
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from datetime import datetime, timezone
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nanodiloco_tpu.data import get_tokenizer, pack_corpus, synthetic_corpus  # noqa: E402
+from nanodiloco_tpu.data.tokenshard import native_available, write_shard  # noqa: E402
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", required=True, help="output .tshrd path")
+    p.add_argument("--dataset-path", default=None,
+                   help="datasets.save_to_disk dir (ref c4-tiny layout); "
+                        "default: synthetic corpus")
+    p.add_argument("--tokenizer", default=None,
+                   help="HF tokenizer name/path; default byte-level")
+    p.add_argument("--seq-length", type=int, default=1024)
+    p.add_argument("--n-docs", type=int, default=20000,
+                   help="synthetic corpus size (ignored with --dataset-path)")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    tokenizer = get_tokenizer(args.tokenizer)
+    if args.dataset_path:
+        from nanodiloco_tpu.data import load_hf_dataset_texts
+
+        texts = load_hf_dataset_texts(args.dataset_path)
+        source = args.dataset_path
+    else:
+        texts = synthetic_corpus(n_docs=args.n_docs, seed=args.seed)
+        source = f"synthetic(n_docs={args.n_docs}, seed={args.seed})"
+
+    packed = pack_corpus(texts, tokenizer, args.seq_length)
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    write_shard(args.out, packed)
+
+    manifest = {
+        "dataset": source,
+        "tokenizer": args.tokenizer or "byte-level",
+        "vocab_size": tokenizer.vocab_size,
+        "seq_length": args.seq_length,
+        "n_sequences": int(packed.shape[0]),
+        "n_tokens": int(packed.size),
+        "native_writer": native_available(),
+        "created": datetime.now(timezone.utc).isoformat(),
+    }
+    with open(args.out + ".manifest.json", "w") as f:
+        json.dump(manifest, f, indent=2)
+    print(json.dumps(manifest, indent=2))
+
+
+if __name__ == "__main__":
+    main()
