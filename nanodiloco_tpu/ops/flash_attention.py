@@ -1,12 +1,16 @@
 """Memory-efficient causal attention.
 
-``flash_attention`` is the framework-facing API. The current
-implementation is blockwise online-softmax attention expressed as
-``lax.scan`` over key/value blocks with per-block rematerialization —
-O(S * block) live memory instead of O(S^2), differentiable through the
-scan (no custom VJP needed), and XLA fuses the inner block into MXU
-matmuls + VPU elementwise. A hand-written Pallas TPU kernel can replace
-the block inner loop behind this same signature (see ops/pallas/).
+``flash_attention`` is the framework-facing API, dispatching on
+hardware:
+
+- On TPU it calls the hand-written Pallas kernel (ops/pallas/
+  flash_attention.py) — Mosaic-compiled blockwise online-softmax with
+  VMEM-resident accumulators and a custom VJP.
+- Elsewhere (and under ``impl="scan"``) it runs the same algorithm as a
+  ``lax.scan`` over key/value blocks with per-block rematerialization —
+  O(S * block) live memory instead of O(S^2), differentiable through
+  the scan, XLA-fused. The scan form doubles as the executable spec the
+  Pallas kernel is tested against.
 
 Causal-only and mask-free by design: the data pipeline packs fixed-length
 sequences (data/), so padding masks are not needed on the hot path. Use
@@ -25,17 +29,47 @@ from jax import lax
 from nanodiloco_tpu.ops.online_softmax import block_update, finalize
 
 
-@partial(jax.jit, static_argnames=("causal", "block_size"))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
     block_size: int = 512,
+    impl: str | None = None,
 ) -> jax.Array:
     """q, k, v: [B, S, H, hd] (K/V already GQA-expanded). Returns same shape.
 
-    Online-softmax over K/V blocks of ``block_size`` (clamped to S); the
+    ``impl``: "pallas" | "scan" | None (auto: pallas on TPU when the
+    sequence divides into its blocks, scan otherwise).
+    """
+    if impl not in (None, "pallas", "scan"):
+        raise ValueError(f"unknown flash attention impl: {impl!r}")
+    if impl is None:
+        s = q.shape[1]
+        blk = min(128, block_size)
+        pallas_ok = (
+            jax.default_backend() == "tpu" and s % min(blk, s) == 0
+        )
+        impl = "pallas" if pallas_ok else "scan"
+    if impl == "pallas":
+        from nanodiloco_tpu.ops.pallas.flash_attention import pallas_flash_attention
+
+        blk = min(128, block_size)
+        return pallas_flash_attention(
+            q, k, v, causal=causal, block_q=blk, block_k=blk
+        )
+    return _flash_attention_scan(q, k, v, causal=causal, block_size=block_size)
+
+
+@partial(jax.jit, static_argnames=("causal", "block_size"))
+def _flash_attention_scan(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_size: int = 512,
+) -> jax.Array:
+    """Online-softmax over K/V blocks of ``block_size`` (clamped to S); the
     query axis stays whole — queries are cheap, the S^2 score matrix is
     what must never materialize.
     """

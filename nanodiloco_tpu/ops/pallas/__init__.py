@@ -1,0 +1,12 @@
+"""Hand-written Pallas TPU kernels for the hot ops.
+
+The reference has no native kernels at all (SURVEY §2: "no bespoke
+kernels to port") — its FLOPs come from cuBLAS via torch. Here the
+compute path is XLA, and these kernels cover the one op XLA's fusion
+cannot express well: blockwise-softmax attention with O(S·block) live
+memory and MXU-shaped tiles.
+"""
+
+from nanodiloco_tpu.ops.pallas.flash_attention import pallas_flash_attention
+
+__all__ = ["pallas_flash_attention"]
