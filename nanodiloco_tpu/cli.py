@@ -56,6 +56,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="compute dtype override (e.g. bfloat16)")
     p.add_argument("--attention", type=str, default=None,
                    choices=["dense", "flash", "ring"])
+    p.add_argument("--streaming-fragments", type=int, default=0,
+                   help="streaming DiLoCo: split params into N layer "
+                        "fragments with staggered, overlapped outer syncs "
+                        "(0 = classic all-at-once sync)")
+    p.add_argument("--streaming-delay", type=int, default=1,
+                   help="inner steps between a fragment's all-reduce launch "
+                        "and its merge into worker params")
+    p.add_argument("--merge-alpha", type=float, default=1.0,
+                   help="fragment merge blend: 1 = hard reset to global, "
+                        "0.5 = half local/global mix")
     p.add_argument("--tokenizer", type=str, default=None,
                    help="HF tokenizer name/path; default byte-level fallback")
     p.add_argument("--offload-snapshot", action="store_true",
@@ -105,6 +115,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         num_workers=args.num_workers,
         fsdp=args.fsdp,
         tp=args.tp,
+        streaming_fragments=args.streaming_fragments,
+        streaming_delay=args.streaming_delay,
+        merge_alpha=args.merge_alpha,
         model=model,
         tokenizer=args.tokenizer,
         offload_snapshot=args.offload_snapshot,

@@ -1,11 +1,19 @@
 from nanodiloco_tpu.parallel.diloco import Diloco, DilocoConfig, DilocoState
 from nanodiloco_tpu.parallel.mesh import AXES, MeshConfig, build_mesh, single_device_mesh
 from nanodiloco_tpu.parallel.sharding import batch_spec, constrain, named, param_specs
+from nanodiloco_tpu.parallel.streaming import (
+    StreamingConfig,
+    StreamingDiloco,
+    StreamingState,
+)
 
 __all__ = [
     "Diloco",
     "DilocoConfig",
     "DilocoState",
+    "StreamingConfig",
+    "StreamingDiloco",
+    "StreamingState",
     "MeshConfig",
     "build_mesh",
     "single_device_mesh",

@@ -60,6 +60,10 @@ class TrainConfig:
     num_workers: int = 1
     fsdp: int = 1
     tp: int = 1
+    # streaming DiLoCo (BASELINE config 4, arXiv:2501.18512); 0 = classic
+    streaming_fragments: int = 0
+    streaming_delay: int = 1
+    merge_alpha: float = 1.0
     model: LlamaConfig = dataclasses.field(default_factory=LlamaConfig)
     tokenizer: str | None = None     # HF name/path; None -> byte fallback
     offload_snapshot: bool = False
@@ -146,7 +150,20 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             seed=cfg.seed,
         )
 
-    dl = Diloco(model_cfg, dcfg, mesh)
+    streaming = cfg.streaming_fragments > 0
+    if streaming:
+        from nanodiloco_tpu.parallel.streaming import StreamingConfig, StreamingDiloco
+
+        dl = StreamingDiloco(
+            model_cfg, dcfg, mesh,
+            StreamingConfig(
+                num_fragments=cfg.streaming_fragments,
+                delay=cfg.streaming_delay,
+                merge_alpha=cfg.merge_alpha,
+            ),
+        )
+    else:
+        dl = Diloco(model_cfg, dcfg, mesh)
     state = dl.init_state(jax.random.key(cfg.seed))
     schedule = warmup_cosine_schedule(cfg.lr, cfg.warmup_steps, cfg.total_steps)
 
@@ -184,20 +201,37 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     for real_step in range(start_step + 1, cfg.total_steps + 1):
         tokens, mask = next(batches)
         t0 = time.perf_counter()
-        state, loss = dl.inner_step(state, jnp.asarray(tokens), jnp.asarray(mask))
-        synced = real_step % cfg.inner_steps == 0
-        if synced:
-            jax.block_until_ready(state.params)
-            compute_time += time.perf_counter() - t0
-            with sync_timer:
-                state = dl.outer_step(state)
-                jax.block_until_ready(state.params)
-            state = dl._offload(state)
-            if ckpt and (real_step // cfg.inner_steps) % cfg.checkpoint_every == 0:
-                ckpt.save(real_step, state)
-        else:
+        if streaming:
+            # fragment launches/applies are fused into the jitted step and
+            # overlap the inner compute — there is no separate sync phase
+            # to time (that's the point, arXiv:2501.18512).
+            state, loss = dl.step(
+                state, jnp.asarray(tokens), jnp.asarray(mask), real_step
+            )
+            synced = real_step % cfg.inner_steps == 0
             jax.block_until_ready(loss)
             compute_time += time.perf_counter() - t0
+            if synced:
+                state = dl._offload(state)
+                if ckpt and (
+                    real_step // cfg.inner_steps
+                ) % cfg.checkpoint_every == 0:
+                    ckpt.save(real_step, state)
+        else:
+            state, loss = dl.inner_step(state, jnp.asarray(tokens), jnp.asarray(mask))
+            synced = real_step % cfg.inner_steps == 0
+            if synced:
+                jax.block_until_ready(state.params)
+                compute_time += time.perf_counter() - t0
+                with sync_timer:
+                    state = dl.outer_step(state)
+                    jax.block_until_ready(state.params)
+                state = dl._offload(state)
+                if ckpt and (real_step // cfg.inner_steps) % cfg.checkpoint_every == 0:
+                    ckpt.save(real_step, state)
+            else:
+                jax.block_until_ready(loss)
+                compute_time += time.perf_counter() - t0
 
         last_loss = float(jnp.mean(loss))
         total_time = compute_time + sync_timer.total
