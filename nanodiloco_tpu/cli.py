@@ -52,10 +52,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="DiLoCo workers = size of the diloco mesh axis")
     p.add_argument("--fsdp", type=int, default=1, help="fsdp mesh axis size per worker")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis size")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel mesh axis size (long context via "
+                        "ring attention; requires --attention ring)")
+    p.add_argument("--dcn-slices", type=int, default=1,
+                   help="multi-slice deployment: spread the diloco axis "
+                        "across this many TPU slices (outer sync over DCN)")
     p.add_argument("--dtype", type=str, default=None,
                    help="compute dtype override (e.g. bfloat16)")
     p.add_argument("--attention", type=str, default=None,
                    choices=["dense", "flash", "ring"])
+    p.add_argument("--loss-chunk", type=int, default=None,
+                   help="rows per chunk of the blockwise cross-entropy "
+                        "(avoids materializing [B,S,vocab] logits; 512 is "
+                        "the tuned TPU default, 0 disables)")
     p.add_argument("--streaming-fragments", type=int, default=0,
                    help="streaming DiLoCo: split params into N layer "
                         "fragments with staggered, overlapped outer syncs "
@@ -66,10 +76,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--merge-alpha", type=float, default=1.0,
                    help="fragment merge blend: 1 = hard reset to global, "
                         "0.5 = half local/global mix")
+    p.add_argument("--outer-comm-dtype", type=str, default=None,
+                   help="wire dtype of the outer all-reduce payload "
+                        "(e.g. bfloat16 halves sync traffic)")
     p.add_argument("--tokenizer", type=str, default=None,
                    help="HF tokenizer name/path; default byte-level fallback")
     p.add_argument("--offload-snapshot", action="store_true",
                    help="keep the DiLoCo sync snapshot in host memory")
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="evaluate the global snapshot on held-out data "
+                        "every N outer syncs (0 = off)")
+    p.add_argument("--eval-batches", type=int, default=8,
+                   help="number of held-out eval batches to reserve")
+    p.add_argument("--profile-dir", type=str, default=None,
+                   help="write a jax.profiler trace of a few steady-state "
+                        "steps to this directory")
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--checkpoint-every", type=int, default=1,
                    help="checkpoint cadence in outer syncs")
@@ -95,6 +116,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         overrides["dtype"] = args.dtype
     if args.attention:
         overrides["attention_impl"] = args.attention
+    if args.loss_chunk is not None:
+        overrides["loss_chunk"] = args.loss_chunk
     if overrides:
         model = dataclasses.replace(model, **overrides)
     wandb_config = (
@@ -115,12 +138,18 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         num_workers=args.num_workers,
         fsdp=args.fsdp,
         tp=args.tp,
+        sp=args.sp,
+        dcn_slices=args.dcn_slices,
         streaming_fragments=args.streaming_fragments,
         streaming_delay=args.streaming_delay,
         merge_alpha=args.merge_alpha,
+        outer_comm_dtype=args.outer_comm_dtype,
         model=model,
         tokenizer=args.tokenizer,
         offload_snapshot=args.offload_snapshot,
+        eval_every=args.eval_every,
+        eval_batches=args.eval_batches,
+        profile_dir=args.profile_dir,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=not args.no_resume,

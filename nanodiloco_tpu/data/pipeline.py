@@ -195,14 +195,21 @@ class ShardBatcher:
     grad_accum: int
     per_device_batch: int
     seed: int = 1337
+    holdout_rows: int = 0            # trailing rows reserved for evaluation
 
     def __post_init__(self) -> None:
         from nanodiloco_tpu.data.tokenshard import TokenShard
 
         self._ts = TokenShard(self.path)
         self.seq_len = self._ts.seq_len
+        self._n_train = self._ts.n_seqs - self.holdout_rows
+        if self._n_train <= 0:
+            raise ValueError(
+                f"holdout_rows={self.holdout_rows} leaves no training rows "
+                f"(shard has {self._ts.n_seqs})"
+            )
         n_shard = min(
-            len(range(w, self._ts.n_seqs, self.num_workers))
+            len(range(w, self._n_train, self.num_workers))
             for w in range(self.num_workers)
         )
         per_step = self.grad_accum * self.per_device_batch
@@ -246,6 +253,13 @@ class ShardBatcher:
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         return self.iter_from(0)
+
+    def holdout_data(self) -> np.ndarray:
+        """The reserved trailing rows [holdout_rows, S] (never trained on)."""
+        if not self.holdout_rows:
+            return np.empty((0, self.seq_len), np.int32)
+        idx = np.arange(self._n_train, self._ts.n_seqs, dtype=np.uint64)
+        return self._ts.batch(idx)
 
     def close(self) -> None:
         self._ts.close()

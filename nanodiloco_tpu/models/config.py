@@ -33,6 +33,9 @@ class LlamaConfig:
     param_dtype: str = "float32"    # master parameter dtype
     remat: bool = False             # jax.checkpoint each decoder layer
     attention_impl: str = "dense"   # "dense" | "flash" | "ring"
+    # rows per chunk of the blockwise cross-entropy (ops/fused_ce.py):
+    # the full [B, S, V] logits tensor is never materialized. 0 = off.
+    loss_chunk: int = 0
 
     @property
     def head_dim(self) -> int:

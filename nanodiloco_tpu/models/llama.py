@@ -155,17 +155,11 @@ def _attention(cfg: LlamaConfig, q, k, v, mask, axis_name: str | None):
     padding masks (packed fixed-length sequences don't need one)."""
     if cfg.attention_impl not in ("dense", "flash", "ring"):
         raise ValueError(f"unknown attention_impl: {cfg.attention_impl!r}")
-    if cfg.attention_impl == "flash" and mask is None:
+    if cfg.attention_impl == "flash":
         from nanodiloco_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=True)
     if cfg.attention_impl == "ring" and axis_name is not None:
-        if mask is not None:
-            raise NotImplementedError(
-                "ring attention supports packed (mask-free) sequences only; "
-                "drop the padding mask (pack fixed-length sequences) or use "
-                "attention_impl='dense'"
-            )
         from nanodiloco_tpu.ops.ring_attention import ring_attention
 
         return ring_attention(q, k, v, axis_name=axis_name)
@@ -207,8 +201,11 @@ def forward(
     attn_mask: jax.Array | None = None,
     sp_axis: str | None = None,
     position_offset: int | jax.Array = 0,
+    return_hidden: bool = False,
 ) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, vocab] float32.
+    """tokens [B, S] int32 -> logits [B, S, vocab] float32 (or the final
+    normed hidden states [B, S, d] in compute dtype if ``return_hidden`` —
+    the blockwise-loss path applies the vocabulary head itself).
 
     ``attn_mask`` is an optional [B, S] 0/1 validity mask (1 = real token);
     it is combined with causal masking. ``sp_axis`` names the mesh axis the
@@ -220,8 +217,11 @@ def forward(
     x = params["embed"].astype(cdt)[tokens]
     cos, sin = rope_tables(cfg, s, offset=position_offset)
 
+    # flash and ring are PACKED-sequence kernels: attn_mask only weights
+    # the loss, it never restricts attention (dense honors it for the
+    # reference's padded-document layout, ref nanodiloco/main.py:79-88).
     mask = None
-    if attn_mask is not None:
+    if attn_mask is not None and cfg.attention_impl == "dense":
         mask = causal_mask(s, valid=attn_mask)  # [B, 1, S, S]
 
     # Bind all non-array arguments (cfg, sp_axis) BEFORE jax.checkpoint so
@@ -237,6 +237,8 @@ def forward(
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if return_hidden:
+        return x
     head = params.get("lm_head", None)
     if head is None:
         head = params["embed"].T
@@ -263,8 +265,33 @@ def causal_lm_loss(
     Returns (loss, aux) with aux = {"n_tokens": ..., "sum_loss": ...} so
     microbatch losses can be combined exactly under grad accumulation.
     """
-    logits = forward(params, tokens, cfg, attn_mask=loss_mask, sp_axis=sp_axis)
     targets = tokens[:, 1:]
+    if cfg.loss_chunk:
+        from nanodiloco_tpu.ops.fused_ce import chunked_softmax_xent
+
+        h = forward(
+            params, tokens, cfg, attn_mask=loss_mask, sp_axis=sp_axis,
+            return_hidden=True,
+        )
+        b, s, d = h.shape
+        head = params.get("lm_head", None)
+        if head is None:
+            head = params["embed"].T
+        m = (
+            loss_mask[:, 1:] if loss_mask is not None
+            else jnp.ones_like(targets)
+        ).astype(jnp.float32)
+        sum_loss, n_tok = chunked_softmax_xent(
+            h[:, :-1].reshape(b * (s - 1), d),
+            head.astype(h.dtype),
+            targets.reshape(-1),
+            m.reshape(-1),
+            chunk=cfg.loss_chunk,
+        )
+        n = jnp.maximum(n_tok, 1.0)
+        return sum_loss / n, {"n_tokens": n_tok, "sum_loss": sum_loss}
+
+    logits = forward(params, tokens, cfg, attn_mask=loss_mask, sp_axis=sp_axis)
     logits = logits[:, :-1]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]  # [B, S-1]
@@ -275,3 +302,107 @@ def causal_lm_loss(
     sum_loss = jnp.sum(nll * m)
     n = jnp.maximum(jnp.sum(m), 1.0)
     return sum_loss / n, {"n_tokens": jnp.sum(m), "sum_loss": sum_loss}
+
+
+def causal_lm_loss_sp(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh,
+    loss_mask: jax.Array | None = None,
+    axis_name: str = "sp",
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """``causal_lm_loss`` with the SEQUENCE dimension sharded over a mesh
+    axis — the long-context training path (the reference caps sequence
+    length at 1024 by truncation, ref nanodiloco/training_utils/utils.py:50;
+    here S scales with the ``sp`` axis at O(S/N) activation memory).
+
+    Runs the forward under ``jax.shard_map`` manual over ``axis_name`` only
+    (ring attention's ppermute needs the axis bound) while fsdp/tp stay
+    auto-partitioned by XLA. Requires ``cfg.attention_impl == 'ring'``
+    (local dense attention would silently drop cross-shard context) and
+    packed sequences (no attention padding mask; ``loss_mask`` still
+    weights the loss). The label shift crosses shard boundaries: each
+    shard's last target is its right neighbor's first token, fetched with
+    one tiny ppermute; the global last position is masked out.
+    """
+    if loss_mask is None:
+        loss_mask = jnp.ones_like(tokens)
+
+    def shard_fn(params, tokens, loss_mask):
+        sum_local, n_local = sp_shard_loss(params, tokens, cfg, loss_mask, axis_name)
+        sum_loss = jax.lax.psum(sum_local, axis_name)
+        n_tok = jax.lax.psum(n_local, axis_name)
+        return sum_loss / jnp.maximum(n_tok, 1.0), {
+            "n_tokens": n_tok, "sum_loss": sum_loss,
+        }
+
+    from jax.sharding import PartitionSpec as P
+
+    pspec = jax.tree.map(lambda _: P(), params)
+    seq_spec = P(None, axis_name)
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(pspec, seq_spec, seq_spec),
+        out_specs=(P(), {"n_tokens": P(), "sum_loss": P()}),
+        axis_names={axis_name},
+    )(params, tokens, loss_mask)
+
+
+def sp_shard_loss(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    loss_mask: jax.Array,
+    axis_name: str,
+) -> tuple[jax.Array, jax.Array]:
+    """Per-shard UNREDUCED loss body for sequence parallelism: must run
+    inside a region manual over ``axis_name``. Returns this shard's
+    (sum_loss, n_tokens) — callers psum both (and psum parameter grads).
+    tokens/loss_mask: [B, S_local]."""
+    if cfg.attention_impl != "ring":
+        raise ValueError(
+            "sequence-parallel loss requires attention_impl='ring'; "
+            f"got {cfg.attention_impl!r}"
+        )
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc = tokens.shape
+    # right neighbor's first token completes this shard's label shift
+    to_left = [(j, (j - 1) % n) for j in range(n)]
+    next_tok = jax.lax.ppermute(tokens[:, :1], axis_name, to_left)
+    next_m = jax.lax.ppermute(loss_mask[:, :1], axis_name, to_left)
+    targets = jnp.concatenate([tokens[:, 1:], next_tok], axis=1)
+    m = jnp.concatenate([loss_mask[:, 1:], next_m], axis=1).astype(jnp.float32)
+    # the global last position's "target" wrapped around the ring
+    is_global_last = (idx == n - 1) & (jnp.arange(s_loc) == s_loc - 1)  # [S_loc]
+    m = m * (1.0 - is_global_last[None].astype(jnp.float32))
+
+    if cfg.loss_chunk:
+        # blockwise CE on this shard's rows — long context is exactly
+        # where materializing [B, S_loc, V] logits hurts most
+        from nanodiloco_tpu.ops.fused_ce import chunked_softmax_xent
+
+        h = forward(
+            params, tokens, cfg, attn_mask=None, sp_axis=axis_name,
+            position_offset=idx * s_loc, return_hidden=True,
+        )
+        head = params.get("lm_head", None)
+        if head is None:
+            head = params["embed"].T
+        return chunked_softmax_xent(
+            h.reshape(b * s_loc, h.shape[-1]),
+            head.astype(h.dtype),
+            targets.reshape(-1),
+            m.reshape(-1),
+            chunk=cfg.loss_chunk,
+        )
+
+    logits = forward(
+        params, tokens, cfg, attn_mask=None, sp_axis=axis_name,
+        position_offset=idx * s_loc,
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * m), jnp.sum(m)

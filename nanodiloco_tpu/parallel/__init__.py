@@ -1,5 +1,11 @@
 from nanodiloco_tpu.parallel.diloco import Diloco, DilocoConfig, DilocoState
-from nanodiloco_tpu.parallel.mesh import AXES, MeshConfig, build_mesh, single_device_mesh
+from nanodiloco_tpu.parallel.mesh import (
+    AXES,
+    MeshConfig,
+    build_hybrid_mesh,
+    build_mesh,
+    single_device_mesh,
+)
 from nanodiloco_tpu.parallel.sharding import batch_spec, constrain, named, param_specs
 from nanodiloco_tpu.parallel.streaming import (
     StreamingConfig,
@@ -15,6 +21,7 @@ __all__ = [
     "StreamingDiloco",
     "StreamingState",
     "MeshConfig",
+    "build_hybrid_mesh",
     "build_mesh",
     "single_device_mesh",
     "AXES",

@@ -146,9 +146,9 @@ class StreamingDiloco(Diloco):
         # t % H == 0, matching classic DiLoCo's sync point. Offsets are
         # distinct whenever P <= H (spacing H/P >= 1).
         self._launch_offsets = [round((p + 1) * H / P) % H for p in range(P)]
-        self._step = jax.jit(
+        self._step = self._with_mesh(jax.jit(
             self._fused_step, static_argnums=(3, 4), donate_argnums=(0,)
-        )
+        ))
 
     # -- cadence -------------------------------------------------------------
 
@@ -225,9 +225,8 @@ class StreamingDiloco(Diloco):
         over ``diloco`` (as in Diloco._outer_step, ref diloco.py:48-49),
         but over 1/P of the parameters."""
         frag_w = fragment_slice(state.params, p, self.bounds, stacked=True)
-        avg = jax.tree.map(lambda x: jnp.mean(x, axis=0), frag_w)
         snap = fragment_slice(state.snapshot, p, self.bounds, stacked=False)
-        delta = jax.tree.map(jnp.subtract, snap, avg)
+        delta = self._pseudograd(snap, frag_w)
         updates, new_opt = self.outer_tx.update(
             delta, state.outer_opt_states[p], snap
         )

@@ -60,13 +60,19 @@ class TrainConfig:
     num_workers: int = 1
     fsdp: int = 1
     tp: int = 1
+    sp: int = 1   # sequence-parallel shards (ring attention long-context path)
+    dcn_slices: int = 1  # multi-slice: diloco axis spans slices over DCN
     # streaming DiLoCo (BASELINE config 4, arXiv:2501.18512); 0 = classic
     streaming_fragments: int = 0
     streaming_delay: int = 1
     merge_alpha: float = 1.0
+    outer_comm_dtype: str | None = None  # e.g. "bfloat16": halve sync traffic
     model: LlamaConfig = dataclasses.field(default_factory=LlamaConfig)
     tokenizer: str | None = None     # HF name/path; None -> byte fallback
     offload_snapshot: bool = False
+    eval_every: int = 0       # evaluate the snapshot every N outer syncs (0=off)
+    eval_batches: int = 8     # held-out batches (never trained on)
+    profile_dir: str | None = None  # write a jax.profiler trace of a few steps
     checkpoint_dir: str | None = None
     checkpoint_every: int = 1        # in outer syncs
     resume: bool = True
@@ -89,7 +95,20 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     if cfg.total_steps % cfg.inner_steps:
         raise ValueError("total_steps must divide evenly by inner_steps")
 
-    mesh = build_mesh(MeshConfig(diloco=cfg.num_workers, fsdp=cfg.fsdp, tp=cfg.tp))
+    if cfg.sp > 1:
+        if cfg.model.attention_impl != "ring":
+            raise ValueError("--sp > 1 requires --attention ring")
+        if cfg.seq_length % cfg.sp:
+            raise ValueError("seq_length must divide evenly by sp")
+    if cfg.eval_every and cfg.eval_batches < 1:
+        raise ValueError("--eval-every requires --eval-batches >= 1")
+    mesh_cfg = MeshConfig(diloco=cfg.num_workers, fsdp=cfg.fsdp, tp=cfg.tp, sp=cfg.sp)
+    if cfg.dcn_slices > 1:
+        from nanodiloco_tpu.parallel.mesh import build_hybrid_mesh
+
+        mesh = build_hybrid_mesh(mesh_cfg, cfg.dcn_slices)
+    else:
+        mesh = build_mesh(mesh_cfg)
     dcfg = DilocoConfig(
         num_workers=cfg.num_workers,
         inner_steps=cfg.inner_steps,
@@ -99,6 +118,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         outer_lr=cfg.outer_lr,
         grad_accum=cfg.grad_accum,
         offload_snapshot=cfg.offload_snapshot,
+        outer_comm_dtype=cfg.outer_comm_dtype,
     )
 
     tokenizer = get_tokenizer(cfg.tokenizer)
@@ -106,6 +126,8 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     if model_cfg.vocab_size < tokenizer.vocab_size:
         model_cfg = dataclasses.replace(model_cfg, vocab_size=tokenizer.vocab_size)
 
+    eval_needed = cfg.eval_batches * cfg.per_device_batch_size if cfg.eval_every else 0
+    eval_rows = None
     if cfg.dataset_path and cfg.dataset_path.endswith(".tshrd"):
         # pre-tokenized native tokenshard file (scripts/prepare_data.py)
         from nanodiloco_tpu.data.pipeline import ShardBatcher
@@ -116,7 +138,10 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             grad_accum=cfg.grad_accum,
             per_device_batch=cfg.per_device_batch_size,
             seed=cfg.seed,
+            holdout_rows=eval_needed,
         )
+        if eval_needed:
+            eval_rows = batcher.holdout_data()
         if batcher.seq_len != cfg.seq_length:
             raise ValueError(
                 f"--seq-length {cfg.seq_length} does not match the shard's "
@@ -142,6 +167,13 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         else:
             texts = synthetic_corpus(seed=cfg.seed)
         packed = pack_corpus(texts, tokenizer, cfg.seq_length)
+        if eval_needed:
+            if eval_needed >= len(packed):
+                raise ValueError(
+                    f"eval holdout of {eval_needed} rows leaves no training "
+                    f"data ({len(packed)} packed rows total)"
+                )
+            eval_rows, packed = packed[-eval_needed:], packed[:-eval_needed]
         batcher = DilocoBatcher(
             packed,
             num_workers=cfg.num_workers,
@@ -189,6 +221,13 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     )
     sync_timer = SyncTimer()
 
+    evaluator = None
+    if cfg.eval_every:
+        from nanodiloco_tpu.training.evaluate import Evaluator, holdout_batches
+
+        evaluator = Evaluator(model_cfg, mesh)
+        eval_set = holdout_batches(eval_rows, cfg.per_device_batch_size)
+
     start_step = int(state.inner_step_count)
     tokens_per_step = (
         cfg.num_workers * cfg.grad_accum * cfg.per_device_batch_size * cfg.seq_length
@@ -198,7 +237,18 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
 
     compute_time = 0.0
     last_loss = float("nan")
+    # jax.profiler trace of a few steady-state steps (the subsystem the
+    # reference stubbed but never built, SURVEY §5 "Tracing / profiling").
+    # Clamped so a resume close to total_steps still produces a trace.
+    profile_start = min(start_step + 3, cfg.total_steps)
+    profile_stop = min(profile_start + 3, cfg.total_steps)
+    profiling = False
+    last_eval_step = None
+
     for real_step in range(start_step + 1, cfg.total_steps + 1):
+        if cfg.profile_dir and real_step == profile_start:
+            jax.profiler.start_trace(cfg.profile_dir)
+            profiling = True
         tokens, mask = next(batches)
         t0 = time.perf_counter()
         if streaming:
@@ -233,10 +283,25 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 jax.block_until_ready(loss)
                 compute_time += time.perf_counter() - t0
 
+        if profiling and real_step >= profile_stop:
+            jax.profiler.stop_trace()
+            profiling = False
+
+        eval_metrics = {}
+        if (
+            evaluator is not None
+            and synced
+            and (real_step // cfg.inner_steps) % cfg.eval_every == 0
+        ):
+            eval_metrics = evaluator(state.snapshot, eval_set)
+            last_eval_step = real_step
+            last_eval = eval_metrics
+
         last_loss = float(jnp.mean(loss))
         total_time = compute_time + sync_timer.total
         logger.log(
             {
+                **eval_metrics,
                 "loss": last_loss,
                 "perplexity": float(np.exp(min(last_loss, 50.0))),
                 "lr": float(schedule(real_step - 1)),
@@ -250,14 +315,25 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             step=real_step,
         )
 
+    if profiling:
+        jax.profiler.stop_trace()
     if ckpt:
         if ckpt.latest_step != cfg.total_steps:  # orbax refuses overwrites
             ckpt.save(cfg.total_steps, state, force=True)
         ckpt.wait()
         ckpt.close()
+    final_eval = {}
+    if evaluator is not None:
+        # reuse the in-loop result when the last sync already evaluated
+        # this exact snapshot
+        final_eval = (
+            last_eval if last_eval_step == cfg.total_steps
+            else evaluator(state.snapshot, eval_set)
+        )
     logger.finish()
     total_time = compute_time + sync_timer.total
     return {
+        **final_eval,
         "final_loss": last_loss,
         "steps": cfg.total_steps,
         "avg_sync_time_s": sync_timer.avg_sync_time,

@@ -140,6 +140,38 @@ def test_h1_sgd_equals_sync_dp():
     np.testing.assert_allclose(np.asarray(state.snapshot["w"]), expect, rtol=1e-5, atol=1e-6)
 
 
+def test_outer_comm_dtype_bf16():
+    """outer_comm_dtype='bfloat16' reduces the pseudo-gradient in bf16:
+    the outer update must match hand-math computed on the bf16-rounded
+    delta (proving the cast happens on the wire side of the mean), and a
+    value below bf16 resolution must vanish."""
+    mesh = build_mesh(MeshConfig(diloco=2))
+    outer_lr, mu = 0.7, 0.9
+    cfg = DilocoConfig(num_workers=2, outer_lr=outer_lr, outer_momentum=mu,
+                       outer_comm_dtype="bfloat16")
+    dl = Diloco(TINY, cfg, mesh, loss_fn=lambda p, t, m: (jnp.sum(p["w"] ** 2), {}))
+    from nanodiloco_tpu.parallel.diloco import DilocoState
+
+    # per-worker deltas: [1 + 2^-10, 2^-10] and [1 - 2^-10, -2^-10]
+    # bf16 (8 mantissa bits) rounds 1 ± 2^-10 to exactly 1.0, keeps ±2^-10
+    eps = 2.0 ** -10
+    snapshot = {"w": jnp.asarray([2.0, 1.0])}
+    params = {"w": jnp.asarray([[1.0 - eps, 1.0 - eps], [1.0 + eps, 1.0 + eps]])}
+    state = DilocoState(
+        params=params,
+        inner_opt_state=dl.inner_tx.init(snapshot),
+        snapshot=snapshot,
+        outer_opt_state=dl.outer_tx.init(snapshot),
+        inner_step_count=jnp.zeros((), jnp.int32),
+    )
+    new = dl.outer_step(state)
+    # bf16(delta_w) = [1.0, 1.0] for both workers in dim 0 -> mean 1.0;
+    # dim 1: bf16(±eps) = ±eps -> mean 0.0 exactly
+    delta = np.asarray([1.0, 0.0])
+    expect = np.asarray([2.0, 1.0]) - outer_lr * (1 + mu) * delta
+    np.testing.assert_allclose(np.asarray(new.snapshot["w"]), expect, rtol=1e-6)
+
+
 def test_mesh_sharded_matches_single_device():
     """The same training round on a (diloco=4, fsdp=2) mesh and on a
     1-device mesh must agree — sharding is a layout choice, not math."""

@@ -68,6 +68,35 @@ def test_loss_mask_excludes_padding():
     assert not np.isclose(float(loss_a), float(loss_c))
 
 
+def test_chunked_ce_matches_full_logits_loss():
+    """loss_chunk > 0 (blockwise CE, ops/fused_ce.py) must match the
+    full-logits loss in value AND gradients, including with pad masking
+    and a row count that is not a chunk multiple."""
+    import jax.numpy as jnp
+
+    base = LlamaConfig(vocab_size=96, hidden_size=32, intermediate_size=64,
+                       num_attention_heads=4, num_hidden_layers=2,
+                       max_position_embeddings=32)
+    chunked = LlamaConfig(**{**base.to_dict(), "loss_chunk": 5})
+    params = init_params(jax.random.key(0), base)
+    tokens = jax.random.randint(jax.random.key(1), (3, 9), 0, 96)
+    mask = jnp.ones_like(tokens).at[0, 5:].set(0)
+
+    with jax.default_matmul_precision("highest"):
+        (l_full, aux_full), g_full = jax.value_and_grad(
+            lambda p: causal_lm_loss(p, tokens, base, loss_mask=mask),
+            has_aux=True,
+        )(params)
+        (l_chunk, aux_chunk), g_chunk = jax.value_and_grad(
+            lambda p: causal_lm_loss(p, tokens, chunked, loss_mask=mask),
+            has_aux=True,
+        )(params)
+    np.testing.assert_allclose(float(l_chunk), float(l_full), rtol=1e-6)
+    assert float(aux_chunk["n_tokens"]) == float(aux_full["n_tokens"])
+    for a, b in zip(jax.tree.leaves(g_chunk), jax.tree.leaves(g_full)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
+
+
 def test_tied_embeddings():
     cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_attention_heads=4,
                       num_hidden_layers=2, intermediate_size=64, tie_word_embeddings=True)

@@ -133,3 +133,49 @@ def test_train_loop_streaming(tmp_path):
 def test_train_rejects_uneven_outer_steps(tmp_path):
     with pytest.raises(ValueError, match="divide evenly"):
         train(small_cfg(tmp_path, total_steps=7, inner_steps=3))
+
+
+def test_train_loop_eval_and_profile(tmp_path):
+    """--eval-every evaluates the snapshot on held-out rows (logged at sync
+    steps + returned in the summary); --profile-dir writes a trace."""
+    summary = train(small_cfg(
+        tmp_path, eval_every=1, eval_batches=2,
+        profile_dir=str(tmp_path / "prof"),
+    ))
+    assert np.isfinite(summary["eval_loss"])
+    assert summary["eval_perplexity"] > 1.0
+    assert summary["eval_tokens"] > 0
+    runs = os.listdir(tmp_path / "runs")
+    lines = [json.loads(l) for l in open(tmp_path / "runs" / runs[0])]
+    sync_lines = [l for l in lines if l["outer_synced"]]
+    assert all("eval_loss" in l for l in sync_lines)
+    assert not any("eval_loss" in l for l in lines if not l["outer_synced"])
+    # profiler artifacts exist
+    assert any((tmp_path / "prof").rglob("*.xplane.pb"))
+
+
+def test_evaluator_matches_direct_loss(tmp_path):
+    """Evaluator == token-weighted mean of causal_lm_loss over the batches."""
+    import jax.numpy as jnp
+
+    from nanodiloco_tpu.models.llama import causal_lm_loss, init_params
+    from nanodiloco_tpu.parallel import MeshConfig, build_mesh
+    from nanodiloco_tpu.training.evaluate import Evaluator, holdout_batches
+
+    params = init_params(jax.random.key(0), SMALL_MODEL)
+    rows = np.asarray(
+        jax.random.randint(jax.random.key(1), (5, 16), 0, SMALL_MODEL.vocab_size)
+    )
+    batches = holdout_batches(rows, batch_size=2)
+    assert len(batches) == 2  # 5 rows -> 2 full batches of 2
+    ev = Evaluator(SMALL_MODEL, build_mesh(MeshConfig()))
+    got = ev(params, batches)
+
+    sl = n = 0.0
+    for tok, m in batches:
+        _, aux = causal_lm_loss(
+            params, jnp.asarray(tok), SMALL_MODEL, loss_mask=jnp.asarray(m)
+        )
+        sl += float(aux["sum_loss"]); n += float(aux["n_tokens"])
+    assert got["eval_loss"] == pytest.approx(sl / n, rel=1e-6)
+    assert got["eval_tokens"] == n
