@@ -81,6 +81,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(e.g. bfloat16 halves sync traffic)")
     p.add_argument("--tokenizer", type=str, default=None,
                    help="HF tokenizer name/path; default byte-level fallback")
+    p.add_argument("--fused-rounds", action="store_true",
+                   help="dispatch each DiLoCo round (inner steps + sync) as "
+                        "one fused XLA program (faster; per-step losses "
+                        "still logged)")
     p.add_argument("--offload-snapshot", action="store_true",
                    help="keep the DiLoCo sync snapshot in host memory")
     p.add_argument("--eval-every", type=int, default=0,
@@ -147,6 +151,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         model=model,
         tokenizer=args.tokenizer,
         offload_snapshot=args.offload_snapshot,
+        fused_rounds=args.fused_rounds,
         eval_every=args.eval_every,
         eval_batches=args.eval_batches,
         profile_dir=args.profile_dir,

@@ -194,6 +194,30 @@ def test_mesh_sharded_matches_single_device():
     assert tree_max_diff(snap_a, snap_b) < 1e-4
 
 
+def test_fused_round_matches_stepwise():
+    """round_step (H inner steps + outer sync in ONE executable) must equal
+    the stepwise inner_step x H + outer_step sequence."""
+    W, H = 4, 3
+    cfg = DilocoConfig(num_workers=W, inner_steps=H, warmup_steps=2,
+                       total_steps=20, lr=1e-3, grad_accum=2)
+    mesh = build_mesh(MeshConfig(diloco=W))
+    batches = [make_batch(jax.random.key(30 + t), TINY, W=W, accum=2) for t in range(H)]
+
+    dl = Diloco(TINY, cfg, mesh)
+    s1 = dl.init_state(jax.random.key(0))
+    step_losses = []
+    for tok, m in batches:
+        s1, loss = dl.inner_step(s1, tok, m)
+        step_losses.append(np.asarray(loss))
+    s1 = dl.outer_step(s1)
+
+    s2 = dl.init_state(jax.random.key(0))
+    s2, losses = dl.run_round(s2, iter(batches))
+    np.testing.assert_allclose(np.asarray(losses), np.stack(step_losses), rtol=1e-6)
+    assert tree_max_diff(s1.snapshot, s2.snapshot) < 1e-7
+    assert tree_max_diff(s1.params, s2.params) < 1e-7
+
+
 def test_grad_accum_scaling():
     """accum=4 with the same microbatch repeated must equal accum=1 with
     that microbatch (correct mean scaling — fixing ref main.py:110-111)."""

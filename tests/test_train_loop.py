@@ -135,6 +135,20 @@ def test_train_rejects_uneven_outer_steps(tmp_path):
         train(small_cfg(tmp_path, total_steps=7, inner_steps=3))
 
 
+def test_train_loop_fused_rounds_matches_stepwise(tmp_path):
+    """--fused-rounds dispatches whole rounds as one program; final state
+    must be bit-identical to the stepwise loop, with the same per-step
+    metric lines."""
+    a = train(small_cfg(tmp_path / "a"))
+    b = train(small_cfg(tmp_path / "b", fused_rounds=True))
+    for x, y in zip(jax.tree.leaves(a["state"].params), jax.tree.leaves(b["state"].params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=0, atol=0)
+    runs = os.listdir(tmp_path / "b" / "runs")
+    lines = [json.loads(l) for l in open(tmp_path / "b" / "runs" / runs[0])]
+    assert len(lines) == 6
+    assert [l["outer_synced"] for l in lines] == [0, 0, 1, 0, 0, 1]
+
+
 def test_train_loop_eval_and_profile(tmp_path):
     """--eval-every evaluates the snapshot on held-out rows (logged at sync
     steps + returned in the summary); --profile-dir writes a trace."""
