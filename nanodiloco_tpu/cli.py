@@ -170,12 +170,9 @@ def main(argv: list[str] | None = None) -> None:
     print("Training DiLoCo with nanodiloco_tpu...")  # ≡ ref main.py:134
     args = build_parser().parse_args(argv)
     if args.force_cpu_devices:
-        # Must precede backend initialization; env vars are NOT enough in
-        # environments that preload jax at interpreter start.
-        import jax
+        from nanodiloco_tpu.utils import force_virtual_cpu_devices
 
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.force_cpu_devices)
+        force_virtual_cpu_devices(args.force_cpu_devices)
     summary = train(config_from_args(args))
     print(
         f"Training completed! final_loss={summary['final_loss']:.4f} "

@@ -1,3 +1,7 @@
-from nanodiloco_tpu.utils.utils import create_run_name, set_seed_all
+from nanodiloco_tpu.utils.utils import (
+    create_run_name,
+    force_virtual_cpu_devices,
+    set_seed_all,
+)
 
-__all__ = ["create_run_name", "set_seed_all"]
+__all__ = ["create_run_name", "force_virtual_cpu_devices", "set_seed_all"]

@@ -20,6 +20,31 @@ def set_seed_all(seed: int = 42) -> None:
     np.random.seed(seed)
 
 
+def force_virtual_cpu_devices(n: int, strict: bool = True) -> bool:
+    """Reconfigure JAX to expose ``n`` virtual CPU devices for sharding
+    dev/debug. Must run before ANYTHING initializes a backend (even
+    ``jax.devices()``) — env vars are too late in environments that
+    preload jax at interpreter start. Returns True on success; if the
+    backend is already live, raises (strict) or returns False so callers
+    can fall back to whatever devices exist."""
+    import jax
+
+    try:
+        # num_cpu_devices first: it is the update that detects (and
+        # rejects) an already-initialized backend.
+        jax.config.update("jax_num_cpu_devices", n)
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        if strict:
+            raise RuntimeError(
+                f"cannot reconfigure to {n} virtual CPU devices: a JAX "
+                f"backend is already initialized — call this before any "
+                f"jax operation in the process"
+            )
+        return False
+    return True
+
+
 def create_run_name(
     experiment_type: str, node_config: dict | None = None, is_debug: bool = False
 ) -> str:
