@@ -81,10 +81,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "(e.g. bfloat16 halves sync traffic)")
     p.add_argument("--tokenizer", type=str, default=None,
                    help="HF tokenizer name/path; default byte-level fallback")
-    p.add_argument("--fused-rounds", action="store_true",
+    p.add_argument("--fused-rounds", action=argparse.BooleanOptionalAction,
+                   default=True,
                    help="dispatch each DiLoCo round (inner steps + sync) as "
-                        "one fused XLA program (faster; per-step losses "
-                        "still logged)")
+                        "one fused XLA program — the TPU fast path, ON by "
+                        "default (per-step losses still logged; falls back "
+                        "to stepwise for streaming/profiling/mid-round "
+                        "resume with a notice)")
+    p.add_argument("--measure-comm", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="in fused mode, estimate the outer sync's real "
+                        "wall-clock share by differencing a warm round "
+                        "against a warm inner-only round (one-time cost: "
+                        "an extra compile + two throwaway inner-only "
+                        "rounds on a transient state copy)")
     p.add_argument("--offload-snapshot", action="store_true",
                    help="keep the DiLoCo sync snapshot in host memory")
     p.add_argument("--eval-every", type=int, default=0,
@@ -152,6 +162,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         tokenizer=args.tokenizer,
         offload_snapshot=args.offload_snapshot,
         fused_rounds=args.fused_rounds,
+        measure_comm=args.measure_comm,
         eval_every=args.eval_every,
         eval_batches=args.eval_batches,
         profile_dir=args.profile_dir,
@@ -174,10 +185,11 @@ def main(argv: list[str] | None = None) -> None:
 
         force_virtual_cpu_devices(args.force_cpu_devices)
     summary = train(config_from_args(args))
+    sync_s, share = summary["avg_sync_time_s"], summary["comm_share"]
     print(
         f"Training completed! final_loss={summary['final_loss']:.4f} "
-        f"avg_sync={summary['avg_sync_time_s'] * 1e3:.1f}ms "
-        f"comm_share={summary['comm_share']:.2%}"
+        f"avg_sync={'n/a' if sync_s is None else f'{sync_s * 1e3:.1f}ms'} "
+        f"comm_share={'n/a' if share is None else f'{share:.2%}'}"
     )
 
 

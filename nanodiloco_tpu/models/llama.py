@@ -152,7 +152,9 @@ def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array | 
 def _attention(cfg: LlamaConfig, q, k, v, mask, axis_name: str | None):
     """Dispatch on cfg.attention_impl. Ring attention requires being inside
     a shard_map with the sequence axis bound to ``axis_name``; flash ignores
-    padding masks (packed fixed-length sequences don't need one)."""
+    padding masks (packed fixed-length sequences don't need one). flash and
+    ring take k/v at Hkv heads (GQA un-expanded); dense gets them
+    pre-expanded by the caller."""
     if cfg.attention_impl not in ("dense", "flash", "ring"):
         raise ValueError(f"unknown attention_impl: {cfg.attention_impl!r}")
     if cfg.attention_impl == "flash":
@@ -163,6 +165,12 @@ def _attention(cfg: LlamaConfig, q, k, v, mask, axis_name: str | None):
         from nanodiloco_tpu.ops.ring_attention import ring_attention
 
         return ring_attention(q, k, v, axis_name=axis_name)
+    # dense (and the ring-without-axis fallback, e.g. sp=1): expand GQA
+    # K/V to the query heads — dense scores are computed per query head
+    if k.shape[2] != q.shape[2]:
+        g = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
     return dense_attention(q, k, v, mask)
 
 
@@ -181,9 +189,9 @@ def _decoder_layer(cfg: LlamaConfig, x, layer: Params, cos, sin, mask, sp_axis):
     v = (h @ layer["wv"].astype(cdt)).reshape(b, s, nkv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    if nkv != nh:  # GQA: expand kv heads to query heads
-        k = jnp.repeat(k, nh // nkv, axis=2)
-        v = jnp.repeat(v, nh // nkv, axis=2)
+    # GQA K/V stay at Hkv heads here; flash/ring are GQA-native (K/V are
+    # never expanded in HBM/ICI — the bandwidth GQA exists to save) and
+    # _attention expands only for its dense paths.
     attn = _attention(cfg, q, k, v, mask, sp_axis)
     x = x + attn.reshape(b, s, nh * hd) @ layer["wo"].astype(cdt)
 

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from nanodiloco_tpu.ops.online_softmax import block_update, finalize
+from nanodiloco_tpu.ops.online_softmax import block_update, finalize_grouped
 
 
 def flash_attention(
@@ -37,11 +37,18 @@ def flash_attention(
     block_size: int = 512,
     impl: str | None = None,
 ) -> jax.Array:
-    """q, k, v: [B, S, H, hd] (K/V already GQA-expanded). Returns same shape.
+    """q: [B, S, H, hd]; k, v: [B, S, Hkv, hd] with H % Hkv == 0 (GQA —
+    K/V are NOT pre-expanded; each KV head serves its group of H/Hkv
+    query heads in-kernel, so K/V HBM traffic stays at Hkv heads).
+    Returns [B, S, H, hd].
 
     ``impl``: "pallas" | "scan" | None (auto: pallas on TPU when the
     sequence divides into its blocks, scan otherwise).
     """
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"query heads {q.shape[2]} must divide by kv heads {k.shape[2]}"
+        )
     if impl not in (None, "pallas", "scan"):
         raise ValueError(f"unknown flash attention impl: {impl!r}")
     if impl is None:
@@ -71,22 +78,27 @@ def _flash_attention_scan(
 ) -> jax.Array:
     """Online-softmax over K/V blocks of ``block_size`` (clamped to S); the
     query axis stays whole — queries are cheap, the S^2 score matrix is
-    what must never materialize.
+    what must never materialize. GQA runs at Hkv "heads" with each KV
+    group's G query heads folded into the query-row axis ([B, Hkv, G*S]
+    rows, position-fastest) — K/V are never expanded.
     """
     b, s, h, hd = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
     blk = min(block_size, s)
     if s % blk:
         raise ValueError(f"seq_len {s} must be divisible by block_size {blk}")
     nblk = s // blk
     scale = 1.0 / math.sqrt(hd)
 
-    qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, S, hd]
-    kb = jnp.transpose(k, (0, 2, 1, 3)).reshape(b, h, nblk, blk, hd)
-    vb = jnp.transpose(v, (0, 2, 1, 3)).reshape(b, h, nblk, blk, hd)
-    kb = jnp.moveaxis(kb, 2, 0)  # [nblk, B, H, blk, hd]
+    # [B, H, S, hd] -> [B, Hkv, G*S, hd]; row r has position r % S
+    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(b, hkv, g * s, hd)
+    kb = jnp.transpose(k, (0, 2, 1, 3)).reshape(b, hkv, nblk, blk, hd)
+    vb = jnp.transpose(v, (0, 2, 1, 3)).reshape(b, hkv, nblk, blk, hd)
+    kb = jnp.moveaxis(kb, 2, 0)  # [nblk, B, Hkv, blk, hd]
     vb = jnp.moveaxis(vb, 2, 0)
 
-    q_pos = lax.broadcasted_iota(jnp.int32, (s,), 0)
+    q_pos = jnp.tile(lax.broadcasted_iota(jnp.int32, (s,), 0), g)  # [G*S]
 
     def body(carry, blk_in):
         o, l, m, j = carry
@@ -96,15 +108,15 @@ def _flash_attention_scan(
         )
         if causal:
             k_pos = j * blk + lax.broadcasted_iota(jnp.int32, (blk,), 0)
-            allowed = q_pos[:, None] >= k_pos[None, :]  # [S, blk]
+            allowed = q_pos[:, None] >= k_pos[None, :]  # [G*S, blk]
             scores = jnp.where(allowed[None, None], scores, -jnp.inf)
         o, l, m = block_update(o, l, m, scores, v_j)
         return (o, l, m, j + 1), None
 
-    o0 = jnp.zeros((b, h, s, hd), jnp.float32)
-    l0 = jnp.zeros((b, h, s), jnp.float32)
-    m0 = jnp.full((b, h, s), -jnp.inf, jnp.float32)
+    o0 = jnp.zeros((b, hkv, g * s, hd), jnp.float32)
+    l0 = jnp.zeros((b, hkv, g * s), jnp.float32)
+    m0 = jnp.full((b, hkv, g * s), -jnp.inf, jnp.float32)
     (o, l, _, _), _ = lax.scan(
         jax.checkpoint(body), (o0, l0, m0, jnp.zeros((), jnp.int32)), (kb, vb)
     )
-    return finalize(o, l, q.dtype)
+    return finalize_grouped(o, l, g, q.dtype)

@@ -40,3 +40,18 @@ def finalize(o: jax.Array, l: jax.Array, out_dtype) -> jax.Array:
     """[B, H, S, hd] accumulators -> [B, S, H, hd] normalized output."""
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(out_dtype)
+
+
+def finalize_grouped(o: jax.Array, l: jax.Array, g: int, out_dtype) -> jax.Array:
+    """GQA variant: [B, Hkv, G*S, hd] accumulators (the G query heads of a
+    KV group folded into the query rows, position-fastest) -> [B, S, H, hd]
+    with the HF head order H = hkv * G + g."""
+    bsz, hkv, gs, hd = o.shape
+    s = gs // g
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    out = out.reshape(bsz, hkv, g, s, hd)
+    return (
+        jnp.transpose(out, (0, 3, 1, 2, 4))
+        .reshape(bsz, s, hkv * g, hd)
+        .astype(out_dtype)
+    )

@@ -176,12 +176,18 @@ def _bwd_dq_kernel(
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
-    *, sm_scale, causal, block_q, block_k, nq,
+    *, sm_scale, causal, block_q, block_k, nq, group, grid_ids,
 ):
-    ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    """``grid_ids`` = grid positions of (ki, bh, qi). MHA (group == 1)
+    runs the fully parallel (BH, k_blocks, q_blocks) grid; GQA runs
+    (k_blocks, BH, q_blocks) with BH sequential so the VMEM accumulators
+    can sum a KV head's gradient over BOTH its q blocks and the ``group``
+    query heads sharing it before one write-out per KV head."""
+    ki = pl.program_id(grid_ids[0])
+    bh = pl.program_id(grid_ids[1])
+    qi = pl.program_id(grid_ids[2])
 
-    @pl.when(qi == 0)
+    @pl.when((qi == 0) & (bh % group == 0))
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -209,11 +215,10 @@ def _bwd_dkv_kernel(
         ds = p * (dp - delta)
         dk_acc[...] += _dot(ds, q.astype(jnp.float32), trans_a=True)
 
-    @pl.when(qi == pl.num_programs(2) - 1)
+    @pl.when((qi == nq - 1) & (bh % group == group - 1))
     def _finalize():
         dk_ref[0] = (dk_acc[...] * sm_scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
-    del nq
 
 
 # ---------------------------------------------------------------------------
@@ -234,7 +239,8 @@ def _flash_fwd(causal, block_q, block_k, interpret, q, k, v):
 def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
     q, k, v, out, lse = res
     bh, s, hd = q.shape
-    sk = k.shape[1]
+    bkv, sk, _ = k.shape
+    group = bh // bkv
     block_q = min(block_q, s)
     block_k = min(block_k, sk)
     nq, nk = s // block_q, sk // block_k
@@ -254,8 +260,8 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b // group, j, 0)),
             pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -267,24 +273,41 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
         interpret=interpret,
     )(q, k, v, dout, lse, delta)
 
+    # dk/dv grid: MHA keeps BH fully parallel (Megacore-partitionable);
+    # GQA puts K blocks parallel-outermost and iterates BH sequentially
+    # so the VMEM accumulators carry across the `group` query heads of
+    # each KV head (consecutive in BH) before the single write to dk/dv.
+    if group == 1:
+        grid = (bh, nk, nq)
+        grid_ids = (1, 0, 2)
+        semantics = ("parallel", "parallel", "arbitrary")
+        bq_spec = lambda b, j, i: (b, i, 0)      # noqa: E731
+        bk_spec = lambda b, j, i: (b, j, 0)      # noqa: E731
+    else:
+        grid = (nk, bh, nq)
+        grid_ids = (0, 1, 2)
+        semantics = ("parallel", "arbitrary", "arbitrary")
+        bq_spec = lambda j, b, i: (b, i, 0)      # noqa: E731
+        bk_spec = lambda j, b, i: (b // group, j, 0)  # noqa: E731
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel,
             sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, nq=nq,
+            block_q=block_q, block_k=block_k, nq=nq, group=group,
+            grid_ids=grid_ids,
         ),
-        grid=(bh, nk, nq),
+        grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, hd), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, hd), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, hd), bq_spec),
+            pl.BlockSpec((1, block_k, hd), bk_spec),
+            pl.BlockSpec((1, block_k, hd), bk_spec),
+            pl.BlockSpec((1, block_q, hd), bq_spec),
+            pl.BlockSpec((1, block_q, 1), bq_spec),
+            pl.BlockSpec((1, block_q, 1), bq_spec),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, hd), bk_spec),
+            pl.BlockSpec((1, block_k, hd), bk_spec),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -294,7 +317,7 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
             pltpu.VMEM((block_k, hd), jnp.float32),
             pltpu.VMEM((block_k, hd), jnp.float32),
         ],
-        compiler_params=_GRID_SEMANTICS,
+        compiler_params=pltpu.CompilerParams(dimension_semantics=semantics),
         interpret=interpret,
     )(q, k, v, dout, lse, delta)
     return dq, dk, dv
@@ -305,7 +328,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def _fwd_call(causal, block_q, block_k, interpret, q, k, v):
     bh, s, hd = q.shape
-    sk = k.shape[1]
+    bkv, sk, _ = k.shape
+    group = bh // bkv  # GQA: query heads per KV head (1 = MHA)
     block_q = min(block_q, s)
     block_k = min(block_k, sk)
     if s % block_q or sk % block_k:
@@ -325,8 +349,8 @@ def _fwd_call(causal, block_q, block_k, interpret, q, k, v):
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b // group, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
@@ -361,7 +385,10 @@ def pallas_flash_attention(
     block_k: int = 128,
     interpret: bool | None = None,
 ) -> jax.Array:
-    """q, k, v: [B, S, H, hd] (K/V already GQA-expanded). Differentiable.
+    """q: [B, S, H, hd]; k, v: [B, S, Hkv, hd] with H % Hkv == 0 (GQA —
+    never expanded: the kernel grid maps each query head's K/V block
+    fetch to its KV head via ``bh // group``, so K/V HBM traffic and
+    VMEM residency stay at Hkv heads). Differentiable.
 
     ``interpret`` defaults to True off-TPU so the same kernels run (and
     are tested) on the CPU mesh.
@@ -369,12 +396,15 @@ def pallas_flash_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, s, h, hd = q.shape
-    sk = k.shape[1]
+    sk, hkv = k.shape[1], k.shape[2]
+    if h % hkv:
+        raise ValueError(f"query heads {h} must divide by kv heads {hkv}")
 
-    def flat(x, sl):
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, sl, hd)
+    def flat(x, sl, nh):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * nh, sl, hd)
 
     out = _flash(
-        causal, block_q, block_k, interpret, flat(q, s), flat(k, sk), flat(v, sk)
+        causal, block_q, block_k, interpret,
+        flat(q, s, h), flat(k, sk, hkv), flat(v, sk, hkv),
     )
     return jnp.transpose(out.reshape(b, h, s, hd), (0, 2, 1, 3))

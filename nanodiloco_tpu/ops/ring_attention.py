@@ -30,25 +30,34 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from nanodiloco_tpu.ops.online_softmax import block_update, finalize
+from nanodiloco_tpu.ops.online_softmax import block_update, finalize_grouped
 
 
 def ring_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str
 ) -> jax.Array:
-    """q, k, v: [B, S_loc, H, hd] (K/V already GQA-expanded to H heads).
-    Returns [B, S_loc, H, hd] in q's dtype."""
+    """q: [B, S_loc, H, hd]; k, v: [B, S_loc, Hkv, hd] with H % Hkv == 0
+    (GQA — K/V are NOT pre-expanded, so each ring ``ppermute`` moves only
+    the Hkv-head K/V block: at Llama-3-8B's 32q/8kv that is 4x less ICI
+    payload than expanding first). Returns [B, S_loc, H, hd] in q's dtype.
+    """
     b, s, h, hd = q.shape
+    hkv = k.shape[2]
+    if h % hkv:
+        raise ValueError(f"query heads {h} must divide by kv heads {hkv}")
+    g = h // hkv
     n = lax.psum(1, axis_name)  # static: mesh axis size
     idx = lax.axis_index(axis_name)
     scale = 1.0 / math.sqrt(hd)
 
-    qi = lax.broadcasted_iota(jnp.int32, (s, s), 0)
-    ki = lax.broadcasted_iota(jnp.int32, (s, s), 1)
-    local_causal = qi >= ki  # [Sq, Sk]
+    # fold each KV group's G query heads into the row axis; row r of the
+    # [G*S_loc] query axis is local position r % S_loc
+    q_pos = jnp.tile(lax.broadcasted_iota(jnp.int32, (s,), 0), g)  # [G*S]
+    k_pos = lax.broadcasted_iota(jnp.int32, (s,), 0)
+    local_causal = q_pos[:, None] >= k_pos[None, :]  # [G*Sq, Sk]
 
-    qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, Sq, hd]
-    kt = jnp.transpose(k, (0, 2, 1, 3))
+    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(b, hkv, g * s, hd)
+    kt = jnp.transpose(k, (0, 2, 1, 3))  # [B, Hkv, Sk, hd]
     vt = jnp.transpose(v, (0, 2, 1, 3))
 
     # Derive the initial accumulators from q so they carry shard_map's
@@ -69,4 +78,4 @@ def ring_attention(
             kt = lax.ppermute(kt, axis_name, perm)
             vt = lax.ppermute(vt, axis_name, perm)
 
-    return finalize(o, l, q.dtype)
+    return finalize_grouped(o, l, g, q.dtype)

@@ -140,6 +140,14 @@ class Diloco:
         self.inner_step = self._with_mesh(jax.jit(self._inner_step, donate_argnums=(0,)))
         self.outer_step = self._with_mesh(jax.jit(self._outer_step, donate_argnums=(0,)))
         self.round_step = self._with_mesh(jax.jit(self._round_step, donate_argnums=(0,)))
+        # H inner steps with NO outer sync: same dispatch count as
+        # round_step, so differencing the two isolates the outer
+        # all-reduce's true wall clock even in fused mode (the metric the
+        # reference stubbed, ref diloco.py:23-24,62-64). Used by bench.py
+        # and the train loop's fused-mode comm_share estimate.
+        self.inner_round_step = self._with_mesh(
+            jax.jit(self._inner_round_step, donate_argnums=(0,))
+        )
 
     def _with_mesh(self, fn):
         """Run ``fn`` with this mesh as the ambient mesh — the partial-manual
@@ -352,8 +360,10 @@ class Diloco:
         """Worker-averaged pseudo-gradient ``mean_w(snapshot - params_w)``.
         The mean over the stacked worker axis is the all-reduce over the
         ``diloco`` mesh axis (ref diloco.py:48-49); with ``outer_comm_dtype``
-        set, each worker's delta is cast down FIRST so the reduced payload
-        (the bytes on ICI/DCN) shrinks accordingly."""
+        set, each worker's delta is quantized to the wire dtype FIRST (the
+        lossy step happens per worker, before any cross-worker traffic),
+        then the mean accumulates in float32 so rounding error does not
+        grow with worker count beyond the intended quantization."""
         cdt = self.cfg.outer_comm_dtype
         if cdt is None:
             return jax.tree.map(
@@ -361,7 +371,9 @@ class Diloco:
             )
         dt = jnp.dtype(cdt)
         return jax.tree.map(
-            lambda s, p: jnp.mean((s[None] - p).astype(dt), axis=0).astype(s.dtype),
+            lambda s, p: jnp.mean(
+                (s[None] - p).astype(dt).astype(jnp.float32), axis=0
+            ).astype(s.dtype),
             snapshot, params_w,
         )
 
@@ -409,6 +421,38 @@ class Diloco:
         state = self._outer_step(state)
         return state, losses
 
+    def _inner_round_step(self, state: DilocoState, tokens, loss_mask):
+        """``_round_step`` minus the outer sync — the differencing baseline
+        for measuring the fused outer step's marginal cost."""
+
+        def one(s, batch):
+            s, loss = self._inner_step(s, batch[0], batch[1])
+            return s, loss
+
+        return jax.lax.scan(one, state, (tokens, loss_mask))
+
+    def measure_inner_round_time(
+        self, state: DilocoState, tokens, loss_mask, repeats: int = 1
+    ) -> float:
+        """Seconds for one WARM inner-only round (min over ``repeats``
+        timed calls after one untimed compile call), measured on throwaway
+        copies of ``state`` (one alive at a time — transient 2x state
+        HBM). Subtracting this from a warm full round isolates the outer
+        sync's marginal cost. Training state is untouched — the copies
+        feed the donating jit."""
+        import time
+
+        best = float("inf")
+        for i in range(repeats + 1):  # +1 warmup/compile call
+            probe = jax.tree.map(jnp.copy, state)
+            t0 = time.perf_counter()
+            probe, loss = self.inner_round_step(probe, tokens, loss_mask)
+            jax.block_until_ready(loss)
+            if i > 0:
+                best = min(best, time.perf_counter() - t0)
+        del probe
+        return best
+
     # -- snapshot host offload (ref diloco.py:27-32, made async) -------------
 
     def _offload(self, state: DilocoState) -> DilocoState:
@@ -419,21 +463,26 @@ class Diloco:
         snap = jax.device_put(state.snapshot, self._host_shardings)
         return state.replace(snapshot=snap)
 
-    def run_round(self, state: DilocoState, batches) -> tuple[DilocoState, jax.Array]:
-        """One full DiLoCo round: exactly ``cfg.inner_steps`` inner steps,
-        then the outer sync, dispatched as ONE fused executable
-        (``round_step``). ``batches`` is an iterator yielding
-        ([W, accum, B, S] tokens, same-shape mask); cadence is owned here —
-        the reference accepted ``inner_steps`` and ignored it
-        (ref diloco.py:8-25, SURVEY §2 quirks).
-
-        Raises StopIteration if the data runs out mid-round (the caller
-        decides whether a partial round should sync)."""
+    def stack_round_batches(self, batches) -> tuple[jax.Array, jax.Array]:
+        """Draw ``cfg.inner_steps`` batches and stack them into the
+        [H, W, accum, B, S] arrays ``round_step`` consumes. Raises
+        StopIteration if the data runs out mid-round (the caller decides
+        whether a partial round should sync)."""
         it = iter(batches)
         toks, masks = [], []
         for _ in range(self.cfg.inner_steps):
             tokens, mask = next(it)
             toks.append(jnp.asarray(tokens))
             masks.append(jnp.asarray(mask))
-        state, losses = self.round_step(state, jnp.stack(toks), jnp.stack(masks))
+        return jnp.stack(toks), jnp.stack(masks)
+
+    def run_round(self, state: DilocoState, batches) -> tuple[DilocoState, jax.Array]:
+        """One full DiLoCo round: exactly ``cfg.inner_steps`` inner steps,
+        then the outer sync, dispatched as ONE fused executable
+        (``round_step``). ``batches`` is an iterator yielding
+        ([W, accum, B, S] tokens, same-shape mask); cadence is owned here —
+        the reference accepted ``inner_steps`` and ignored it
+        (ref diloco.py:8-25, SURVEY §2 quirks)."""
+        toks, masks = self.stack_round_batches(batches)
+        state, losses = self.round_step(state, toks, masks)
         return self._offload(state), losses

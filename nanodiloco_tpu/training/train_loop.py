@@ -64,8 +64,16 @@ class TrainConfig:
     dcn_slices: int = 1  # multi-slice: diloco axis spans slices over DCN
     # dispatch whole DiLoCo rounds (H inner steps + sync) as ONE fused
     # executable — no host round-trips between steps (~8% faster end to
-    # end on a v5e chip); per-step losses are still logged
-    fused_rounds: bool = False
+    # end on a v5e chip); per-step losses are still logged. Default ON:
+    # this is the fast path a TPU user should get without asking; it
+    # falls back to stepwise dispatch (with a printed notice) for
+    # streaming, profiling, and mid-round resume.
+    fused_rounds: bool = True
+    # estimate the outer sync's real wall-clock share in fused mode by
+    # differencing a warm full round against a warm inner-only round.
+    # One-time cost: one extra compile + two throwaway inner-only rounds
+    # on a state copy (transient 2x state HBM — disable when HBM is tight)
+    measure_comm: bool = True
     # streaming DiLoCo (BASELINE config 4, arXiv:2501.18512); 0 = classic
     streaming_fragments: int = 0
     streaming_delay: int = 1
@@ -255,13 +263,66 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         and start_step % cfg.inner_steps == 0  # mid-round resume -> stepwise
         and not cfg.profile_dir  # per-step tracing needs stepwise dispatch
     )
+    if cfg.fused_rounds and not fused and not cfg.quiet:
+        reasons = []
+        if streaming:
+            reasons.append("streaming DiLoCo overlaps syncs per step")
+        if start_step % cfg.inner_steps:
+            reasons.append(f"resume at step {start_step} is mid-round")
+        if cfg.profile_dir:
+            reasons.append("per-step profiler traces need stepwise dispatch")
+        print(f"[nanodiloco] fused rounds disabled: {'; '.join(reasons)}")
+    # fused-mode comm estimate (the sync is compiled into the round
+    # program, so its cost is measured by differencing against an
+    # inner-only round — not reported as a fake 0.0)
+    est_inner_s: float | None = None
+    fused_sync_metrics: dict[str, float] = {}
     if fused:
-        for rnd in range(start_step // cfg.inner_steps + 1,
-                         cfg.total_steps // cfg.inner_steps + 1):
+        # explicit nulls until (unless) the differenced estimate lands —
+        # a stable JSONL schema, and never a fake 0.0 (the sync cost is
+        # fused into the round program, not zero)
+        fused_sync_metrics = {"avg_sync_time_s": None, "comm_share": None}
+        first_round = start_step // cfg.inner_steps + 1
+        last_round = cfg.total_steps // cfg.inner_steps
+        for rnd in range(first_round, last_round + 1):
+            # stacking is shared with Diloco.run_round but timed
+            # separately here so host-side batch assembly never pollutes
+            # the differenced sync estimate
+            toks, masks = dl.stack_round_batches(batches)
             t0 = time.perf_counter()
-            state, losses = dl.run_round(state, batches)
+            state, losses = dl.round_step(state, toks, masks)
             jax.block_until_ready(losses)
-            compute_time += time.perf_counter() - t0
+            round_s = time.perf_counter() - t0
+            compute_time += round_s
+            state = dl._offload(state)
+            if cfg.measure_comm and fused_sync_metrics["comm_share"] is None:
+                # Differenced estimate: warm full round minus warm
+                # inner-only round (neither side carries compile time).
+                # The inner-only side costs two throwaway rounds on state
+                # copies (compile + timed; one copy alive at a time —
+                # transient 2x state HBM). The full-round side is round
+                # 2's own wall clock; only a single-round run pays one
+                # extra probe round for it.
+                if est_inner_s is None:
+                    est_inner_s = dl.measure_inner_round_time(
+                        state, toks, masks, repeats=1
+                    )
+                    full_s = None
+                    if rnd == last_round:  # no warm round 2 will come
+                        probe = jax.tree.map(jnp.copy, state)
+                        t0 = time.perf_counter()
+                        probe, probe_loss = dl.round_step(probe, toks, masks)
+                        jax.block_until_ready(probe_loss)
+                        full_s = time.perf_counter() - t0
+                        del probe
+                else:
+                    full_s = round_s  # warm round 2+
+                if full_s is not None:
+                    sync_s = max(0.0, full_s - est_inner_s)
+                    fused_sync_metrics = {
+                        "avg_sync_time_s": sync_s,
+                        "comm_share": sync_s / full_s if full_s else 0.0,
+                    }
             real_step = rnd * cfg.inner_steps
             if ckpt and rnd % cfg.checkpoint_every == 0:
                 ckpt.save(real_step, state)
@@ -284,11 +345,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         "tokens_per_sec": (real_step - start_step) * tokens_per_step
                         / compute_time,
                         "outer_synced": int(i == cfg.inner_steps - 1),
-                        # the sync is fused into the round program; its
-                        # marginal wall-clock is ~0 (see bench.py's
-                        # differenced measurement)
-                        "avg_sync_time_s": 0.0,
-                        "comm_share": 0.0,
+                        **fused_sync_metrics,
                     },
                     step=step,
                 )
@@ -381,13 +438,19 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         )
     logger.finish()
     total_time = compute_time + sync_timer.total
+    if fused:
+        sync_summary = fused_sync_metrics
+    else:
+        sync_summary = {
+            "avg_sync_time_s": sync_timer.avg_sync_time,
+            # 0 when the run was already complete at restore time
+            "comm_share": sync_timer.total / total_time if total_time else 0.0,
+        }
     return {
         **final_eval,
         "final_loss": last_loss,
         "steps": cfg.total_steps,
-        "avg_sync_time_s": sync_timer.avg_sync_time,
-        # 0 when the run was already complete at restore time
-        "comm_share": sync_timer.total / total_time if total_time else 0.0,
+        **sync_summary,
         "run_name": run_name,
         "state": state,
     }

@@ -224,3 +224,87 @@ def test_flash_dispatcher_impl_override():
         scan = flash_attention(q, k, v, causal=True, block_size=16, impl="scan")
         pallas = flash_attention(q, k, v, causal=True, block_size=16, impl="pallas")
     np.testing.assert_allclose(np.asarray(scan), np.asarray(pallas), rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# GQA: kernels take K/V at Hkv heads, never expanded (VERDICT r1 item 4 —
+# expanding before the kernel cost 4x K/V bandwidth at Llama-3-8B's 32q/8kv)
+# ---------------------------------------------------------------------------
+
+def gqa_qkv(key, b=2, s=32, h=8, hkv=2, hd=8):
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, hd))
+    k = jax.random.normal(kk, (b, s, hkv, hd))
+    v = jax.random.normal(kv_, (b, s, hkv, hd))
+    return q, k, v
+
+
+def _dense_gqa(q, k, v):
+    g = q.shape[2] // k.shape[2]
+    return dense_attention(
+        q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2), None
+    )
+
+
+@pytest.mark.parametrize("impl", ["scan", "pallas"])
+def test_flash_gqa_matches_dense(impl):
+    q, k, v = gqa_qkv(jax.random.key(20))
+    with jax.default_matmul_precision("highest"):
+        out = flash_attention(q, k, v, causal=True, block_size=8, impl=impl)
+        dense = _dense_gqa(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["scan", "pallas"])
+def test_flash_gqa_gradients_match_dense(impl):
+    """dk/dv must sum over the query heads sharing each KV head."""
+    q, k, v = gqa_qkv(jax.random.key(21), b=1, s=16, h=4, hkv=2, hd=8)
+    with jax.default_matmul_precision("highest"):
+        gf = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, causal=True, block_size=8, impl=impl) ** 2
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gd = jax.grad(
+            lambda q, k, v: jnp.sum(_dense_gqa(q, k, v) ** 2), argnums=(0, 1, 2)
+        )(q, k, v)
+    for a, b in zip(gf, gd):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_ring_gqa_matches_dense():
+    """Ring attention with un-expanded K/V: the ppermuted block is the
+    small Hkv-head one, and results still match dense GQA."""
+    b, s, h, hkv, hd = 2, 32, 4, 2, 8
+    q, k, v = gqa_qkv(jax.random.key(22), b=b, s=s, h=h, hkv=hkv, hd=hd)
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(4), ("sp",))
+    ring = jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="sp"),
+        mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"),
+    )
+    with jax.default_matmul_precision("highest"):
+        out = ring(q, k, v)
+        dense = _dense_gqa(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), rtol=2e-5, atol=2e-5)
+
+
+def test_gqa_full_model_flash_matches_dense():
+    """Full forward with attention_impl='flash' + GQA (no repeat on the
+    kernel path) must match the dense GQA forward."""
+    from nanodiloco_tpu.models import LlamaConfig, forward, init_params
+
+    base = dict(vocab_size=64, hidden_size=64, num_attention_heads=8,
+                num_key_value_heads=2, num_hidden_layers=2, intermediate_size=128)
+    cfg_f = LlamaConfig(**base, attention_impl="flash")
+    cfg_d = LlamaConfig(**base, attention_impl="dense")
+    params = init_params(jax.random.key(0), cfg_f)
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, 64)
+    with jax.default_matmul_precision("highest"):
+        out_f = forward(params, tokens, cfg_f)
+        out_d = forward(params, tokens, cfg_d)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d),
+                               rtol=2e-5, atol=2e-5)

@@ -141,7 +141,8 @@ def test_h1_sgd_equals_sync_dp():
 
 
 def test_outer_comm_dtype_bf16():
-    """outer_comm_dtype='bfloat16' reduces the pseudo-gradient in bf16:
+    """outer_comm_dtype='bfloat16' quantizes each worker's pseudo-gradient
+    delta to bf16 before the cross-worker mean (which accumulates in f32):
     the outer update must match hand-math computed on the bf16-rounded
     delta (proving the cast happens on the wire side of the mean), and a
     value below bf16 resolution must vanish."""

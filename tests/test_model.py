@@ -76,7 +76,8 @@ def test_chunked_ce_matches_full_logits_loss():
 
     base = LlamaConfig(vocab_size=96, hidden_size=32, intermediate_size=64,
                        num_attention_heads=4, num_hidden_layers=2,
-                       max_position_embeddings=32)
+                       max_position_embeddings=32,
+                       loss_chunk=0)  # true full-logits baseline
     chunked = LlamaConfig(**{**base.to_dict(), "loss_chunk": 5})
     params = init_params(jax.random.key(0), base)
     tokens = jax.random.randint(jax.random.key(1), (3, 9), 0, 96)
