@@ -47,6 +47,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--llama-config-file", type=str, default=None,
                    help="HF-style model config JSON (ref configs/llama_default.json)")
     p.add_argument("--wandb-config-file", type=str, default=None)
+    p.add_argument("--data-layout", type=str, default="packed",
+                   choices=["packed", "padded"],
+                   help="packed (default): eos-joined stream cut into "
+                        "fixed-length rows, zero pad waste. padded: the "
+                        "reference's one-document-per-row layout (ref "
+                        "main.py:79-88) with pad positions masked out of "
+                        "loss and attention; requires --attention dense "
+                        "to honor the attention mask")
     # --- TPU-native knobs ---
     p.add_argument("--num-workers", type=int, default=1,
                    help="DiLoCo workers = size of the diloco mesh axis")
@@ -61,7 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", type=str, default=None,
                    help="compute dtype override (e.g. bfloat16)")
     p.add_argument("--attention", type=str, default=None,
-                   choices=["dense", "flash", "ring"])
+                   choices=["dense", "flash", "ring"],
+                   help="dense honors attention padding masks; flash/ring "
+                        "are packed-sequence kernels that ignore them "
+                        "(fine for packed data and tail-only padding)")
     p.add_argument("--loss-chunk", type=int, default=None,
                    help="rows per chunk of the blockwise cross-entropy "
                         "(avoids materializing [B,S,vocab] logits; 512 is "
@@ -149,6 +160,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         outer_lr=args.outer_lr,
         project=args.project,
         dataset_path=args.dataset_path,
+        data_layout=args.data_layout,
         num_workers=args.num_workers,
         fsdp=args.fsdp,
         tp=args.tp,

@@ -38,6 +38,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -122,8 +123,15 @@ class Diloco:
         self.outer_tx = outer_tx or outer_optimizer(
             cfg.outer_lr, cfg.outer_momentum, cfg.nesterov
         )
+        from nanodiloco_tpu.parallel.feed import BatchFeeder
+
         self._pspec = param_specs(model_cfg, worker_axis=False)
         self._wspec = param_specs(model_cfg, worker_axis=True)
+        bspec = batch_spec(sp=self.sp > 1)
+        # multi-host-safe batch placement: [W, A, B, S] steps and
+        # [H, W, A, B, S] stacked rounds
+        self.feed = BatchFeeder(mesh, bspec)
+        self.feed_round = BatchFeeder(mesh, P(None, *bspec))
         self._pspec_struct = jax.tree.structure(
             self._pspec, is_leaf=lambda x: isinstance(x, P)
         )
@@ -465,16 +473,17 @@ class Diloco:
 
     def stack_round_batches(self, batches) -> tuple[jax.Array, jax.Array]:
         """Draw ``cfg.inner_steps`` batches and stack them into the
-        [H, W, accum, B, S] arrays ``round_step`` consumes. Raises
-        StopIteration if the data runs out mid-round (the caller decides
-        whether a partial round should sync)."""
+        [H, W, accum, B, S] arrays ``round_step`` consumes, placed via the
+        multi-host-safe feeder. Raises StopIteration if the data runs out
+        mid-round (the caller decides whether a partial round should
+        sync)."""
         it = iter(batches)
         toks, masks = [], []
         for _ in range(self.cfg.inner_steps):
             tokens, mask = next(it)
-            toks.append(jnp.asarray(tokens))
-            masks.append(jnp.asarray(mask))
-        return jnp.stack(toks), jnp.stack(masks)
+            toks.append(np.asarray(tokens))
+            masks.append(np.asarray(mask))
+        return self.feed_round(np.stack(toks)), self.feed_round(np.stack(masks))
 
     def run_round(self, state: DilocoState, batches) -> tuple[DilocoState, jax.Array]:
         """One full DiLoCo round: exactly ``cfg.inner_steps`` inner steps,

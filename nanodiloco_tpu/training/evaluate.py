@@ -43,6 +43,14 @@ class Evaluator:
             return aux["sum_loss"], aux["n_tokens"]
 
         self._fn = jax.jit(fn)
+        # multi-host-safe placement of the (replicated) eval batches —
+        # a bare jnp.asarray of host-local data cannot meet globally
+        # sharded params on a pod (see parallel/feed.py)
+        from jax.sharding import PartitionSpec as P
+
+        from nanodiloco_tpu.parallel.feed import BatchFeeder
+
+        self._feed = BatchFeeder(mesh, P())
 
     def __call__(self, params, batches) -> dict[str, float]:
         """``batches``: iterable of ([B, S] tokens, [B, S] mask) pairs.
@@ -50,7 +58,7 @@ class Evaluator:
         total_loss, total_n = 0.0, 0.0
         with jax.set_mesh(self.mesh):
             for tokens, mask in batches:
-                sl, n = self._fn(params, jnp.asarray(tokens), jnp.asarray(mask))
+                sl, n = self._fn(params, self._feed(tokens), self._feed(mask))
                 total_loss += float(sl)
                 total_n += float(n)
         loss = total_loss / max(total_n, 1.0)
@@ -62,11 +70,18 @@ class Evaluator:
 
 
 def holdout_batches(
-    rows: np.ndarray, batch_size: int
+    rows: np.ndarray, batch_size: int, mask_rows: np.ndarray | None = None
 ) -> list[tuple[np.ndarray, np.ndarray]]:
-    """Chunk held-out packed rows [N, S] into full [B, S] eval batches."""
+    """Chunk held-out rows [N, S] into full [B, S] eval batches.
+    ``mask_rows`` carries pad masks for the padded data layout; packed
+    rows default to all-ones."""
     n = (len(rows) // batch_size) * batch_size
     return [
-        (rows[i : i + batch_size], np.ones((batch_size, rows.shape[1]), np.int32))
+        (
+            rows[i : i + batch_size],
+            mask_rows[i : i + batch_size]
+            if mask_rows is not None
+            else np.ones((batch_size, rows.shape[1]), np.int32),
+        )
         for i in range(0, n, batch_size)
     ]

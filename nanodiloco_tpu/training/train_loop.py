@@ -56,6 +56,13 @@ class TrainConfig:
     outer_lr: float = 0.7
     project: str = "nano-diloco"
     dataset_path: str | None = None  # HF save_to_disk dir; None -> synthetic
+    # "packed" (default): eos-joined token stream cut into fixed [N, S]
+    # rows — static shapes, zero pad waste. "padded": the reference's
+    # one-document-per-row layout (ref nanodiloco/main.py:79-88), with
+    # pad positions masked out of loss AND attention (fixing ref
+    # main.py:87's train-on-pad quirk). Padded requires dense attention
+    # to honor the attention mask and is incompatible with .tshrd data.
+    data_layout: str = "packed"
     # TPU-native knobs
     num_workers: int = 1
     fsdp: int = 1
@@ -107,6 +114,25 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     if cfg.total_steps % cfg.inner_steps:
         raise ValueError("total_steps must divide evenly by inner_steps")
 
+    if cfg.data_layout not in ("packed", "padded"):
+        raise ValueError(f"unknown data_layout: {cfg.data_layout!r}")
+    padded = cfg.data_layout == "padded"
+    if padded and cfg.sp > 1:
+        raise ValueError(
+            "--data-layout padded requires equal-length packed sequences; "
+            "sequence parallelism (--sp > 1) is packed-only"
+        )
+    if padded and cfg.model.attention_impl != "dense" and not cfg.quiet:
+        # flash/ring are packed-sequence kernels: they ignore the
+        # attention mask. With causal attention and tail-only padding the
+        # loss-visible outputs still match dense, but hidden states at
+        # pad positions differ (ADVICE r1).
+        print(
+            "[nanodiloco] warning: --data-layout padded with "
+            f"--attention {cfg.model.attention_impl}: the attention "
+            "padding mask is ignored by this kernel (loss is unaffected "
+            "for tail padding; use --attention dense to honor the mask)"
+        )
     if cfg.sp > 1:
         if cfg.model.attention_impl != "ring":
             raise ValueError("--sp > 1 requires --attention ring")
@@ -140,7 +166,14 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
 
     eval_needed = cfg.eval_batches * cfg.per_device_batch_size if cfg.eval_every else 0
     eval_rows = None
+    eval_mask_rows = None
     if cfg.dataset_path and cfg.dataset_path.endswith(".tshrd"):
+        if padded:
+            raise ValueError(
+                "--data-layout padded cannot be used with a .tshrd dataset "
+                "(tokenshards are pre-packed); materialize with "
+                "scripts/prepare_data.py from raw text instead"
+            )
         # pre-tokenized native tokenshard file (scripts/prepare_data.py)
         from nanodiloco_tpu.data.pipeline import ShardBatcher
 
@@ -178,20 +211,28 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             texts = load_hf_dataset_texts(cfg.dataset_path)
         else:
             texts = synthetic_corpus(seed=cfg.seed)
-        packed = pack_corpus(texts, tokenizer, cfg.seq_length)
+        if padded:
+            from nanodiloco_tpu.data.pipeline import pad_corpus
+
+            rows, row_mask = pad_corpus(texts, tokenizer, cfg.seq_length)
+        else:
+            rows, row_mask = pack_corpus(texts, tokenizer, cfg.seq_length), None
         if eval_needed:
-            if eval_needed >= len(packed):
+            if eval_needed >= len(rows):
                 raise ValueError(
                     f"eval holdout of {eval_needed} rows leaves no training "
-                    f"data ({len(packed)} packed rows total)"
+                    f"data ({len(rows)} rows total)"
                 )
-            eval_rows, packed = packed[-eval_needed:], packed[:-eval_needed]
+            eval_rows, rows = rows[-eval_needed:], rows[:-eval_needed]
+            if row_mask is not None:
+                eval_mask_rows, row_mask = row_mask[-eval_needed:], row_mask[:-eval_needed]
         batcher = DilocoBatcher(
-            packed,
+            rows,
             num_workers=cfg.num_workers,
             grad_accum=cfg.grad_accum,
             per_device_batch=cfg.per_device_batch_size,
             seed=cfg.seed,
+            mask=row_mask,
         )
 
     streaming = cfg.streaming_fragments > 0
@@ -238,11 +279,18 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         from nanodiloco_tpu.training.evaluate import Evaluator, holdout_batches
 
         evaluator = Evaluator(model_cfg, mesh)
-        eval_set = holdout_batches(eval_rows, cfg.per_device_batch_size)
+        eval_set = holdout_batches(
+            eval_rows, cfg.per_device_batch_size, mask_rows=eval_mask_rows
+        )
 
     start_step = int(state.inner_step_count)
+    # actual row width (padded layout rounds to a multiple of 8 and can
+    # be shorter than --seq-length; tshrd shards fix their own length)
+    row_len = (
+        batcher.seq_len if hasattr(batcher, "seq_len") else batcher.data.shape[1]
+    )
     tokens_per_step = (
-        cfg.num_workers * cfg.grad_accum * cfg.per_device_batch_size * cfg.seq_length
+        cfg.num_workers * cfg.grad_accum * cfg.per_device_batch_size * row_len
     )
     # deterministic O(1) resume positioning (no replayed gathers)
     batches = batcher.iter_from(start_step)
@@ -362,7 +410,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             # overlap the inner compute — there is no separate sync phase
             # to time (that's the point, arXiv:2501.18512).
             state, loss = dl.step(
-                state, jnp.asarray(tokens), jnp.asarray(mask), real_step
+                state, dl.feed(tokens), dl.feed(mask), real_step
             )
             synced = real_step % cfg.inner_steps == 0
             jax.block_until_ready(loss)
@@ -374,7 +422,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 ) % cfg.checkpoint_every == 0:
                     ckpt.save(real_step, state)
         else:
-            state, loss = dl.inner_step(state, jnp.asarray(tokens), jnp.asarray(mask))
+            state, loss = dl.inner_step(state, dl.feed(tokens), dl.feed(mask))
             synced = real_step % cfg.inner_steps == 0
             if synced:
                 jax.block_until_ready(state.params)

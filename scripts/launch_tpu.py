@@ -20,11 +20,22 @@ Entry points mirror the reference's four local entrypoints
 plus ``custom`` which forwards any nanodiloco_tpu CLI flags verbatim.
 On a multi-host pod slice, run the same command on every host (e.g. via
 ``gcloud compute tpus tpu-vm ssh --worker=all --command=...``).
+
+``provision`` is the cloud half (≡ the reference's Modal image/volume/
+cluster setup, ref train_modal.py:8-45,140-161): create a TPU VM or pod
+slice with gcloud, sync this repo to every host, bootstrap deps, and run
+a preset on all hosts — one command from a clean laptop to a training
+job. ``--dry-run`` prints the exact gcloud commands without executing:
+
+    python scripts/launch_tpu.py provision --name dl0 --zone us-east5-b \
+        --accelerator-type v5litepod-8 --preset small-single-node --dry-run
 """
 
 from __future__ import annotations
 
 import os
+import shlex
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -70,18 +81,89 @@ PRESETS: dict[str, list[str]] = {
 }
 
 
+def provision_commands(args) -> list[list[str]]:
+    """The gcloud command sequence: create -> sync repo -> bootstrap ->
+    run on all hosts. Returned as argv lists so --dry-run can print the
+    byte-exact commands (≡ the reference's Modal app definition,
+    ref train_modal.py:8-45: image build + volumes + clustered placement,
+    re-expressed as TPU-VM operations)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tpu = ["gcloud", "compute", "tpus", "tpu-vm"]
+    loc = ["--zone", args.zone]
+    create = tpu + [
+        "create", args.name, *loc,
+        "--accelerator-type", args.accelerator_type,
+        "--version", args.runtime_version,
+    ]
+    if args.spot:
+        create.append("--spot")
+    sync = tpu + [
+        "scp", "--recurse", repo, f"{args.name}:~/nanodiloco_tpu_repo",
+        *loc, "--worker=all",
+    ]
+    bootstrap = tpu + [
+        "ssh", args.name, *loc, "--worker=all",
+        "--command",
+        "cd ~/nanodiloco_tpu_repo && "
+        "pip install -q -e . 'jax[tpu]' -f "
+        "https://storage.googleapis.com/jax-releases/libtpu_releases.html",
+    ]
+    multihost = "NANODILOCO_MULTIHOST=1 " if args.multihost else ""
+    run = tpu + [
+        "ssh", args.name, *loc, "--worker=all",
+        "--command",
+        f"cd ~/nanodiloco_tpu_repo && {multihost}python scripts/launch_tpu.py "
+        + " ".join([args.preset, *map(shlex.quote, args.extra)]),
+    ]
+    return [create, sync, bootstrap, run]
+
+
+def provision(argv: list[str]) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="launch_tpu.py provision",
+        description="Provision a TPU VM/slice and start a training job.",
+    )
+    p.add_argument("--name", required=True, help="TPU VM name")
+    p.add_argument("--zone", required=True, help="GCP zone, e.g. us-east5-b")
+    p.add_argument("--accelerator-type", default="v5litepod-8",
+                   help="e.g. v5litepod-8 (one host), v5litepod-32 (pod)")
+    p.add_argument("--runtime-version", default="v2-alpha-tpuv5-lite",
+                   help="TPU VM runtime image")
+    p.add_argument("--preset", default="main", choices=[*PRESETS, "custom"])
+    p.add_argument("--spot", action="store_true", help="preemptible capacity")
+    p.add_argument("--multihost", action="store_true",
+                   help="pod slice: set NANODILOCO_MULTIHOST=1 so every "
+                        "host joins jax.distributed")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the gcloud commands without executing")
+    p.add_argument("extra", nargs="*", help="extra nanodiloco_tpu CLI flags")
+    args = p.parse_args(argv)
+
+    for cmd in provision_commands(args):
+        print("+", " ".join(map(shlex.quote, cmd)))
+        if not args.dry_run:
+            subprocess.run(cmd, check=True)
+
+
 def main() -> None:
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
         print(__doc__)
-        print("presets:", ", ".join([*PRESETS, "custom"]))
+        print("presets:", ", ".join([*PRESETS, "custom", "provision"]))
         return
     preset, extra = sys.argv[1], sys.argv[2:]
+    if preset == "provision":
+        provision(extra)
+        return
     if preset == "custom":
         flags = extra
     elif preset in PRESETS:
         flags = PRESETS[preset] + extra
     else:
-        raise SystemExit(f"unknown preset {preset!r}; options: {[*PRESETS, 'custom']}")
+        raise SystemExit(
+            f"unknown preset {preset!r}; options: {[*PRESETS, 'custom', 'provision']}"
+        )
 
     _maybe_init_distributed()
     from nanodiloco_tpu.cli import main as train_main

@@ -8,6 +8,10 @@ fixed-length sequences (csrc/tokenshard.cpp format) plus a manifest.json
 — the layout the training hot path reads natively.
 
 Usage:
+    # one-command path from nothing to a training shard (hub download ->
+    # save_to_disk -> tokenize/pack -> .tshrd), ref setup_data_volume.py:
+    python scripts/prepare_data.py --out data/c4tiny.tshrd --download
+
     python scripts/prepare_data.py --out data/c4tiny.tshrd \
         --dataset-path /path/to/c4-tiny/save_to_disk --seq-length 1024
     python scripts/prepare_data.py --out data/synth.tshrd  # synthetic corpus
@@ -27,6 +31,31 @@ from nanodiloco_tpu.data import get_tokenizer, pack_corpus, synthetic_corpus  # 
 from nanodiloco_tpu.data.tokenshard import native_available, write_shard  # noqa: E402
 
 
+def download_dataset(name: str, config: str, save_dir: str) -> str:
+    """Hub download -> save_to_disk -> manifest (≡ ref
+    setup_data_volume.py:27-56, whose Modal job materialized c4-tiny onto
+    a volume for offline training reads). Skips the download when the
+    target already holds a dataset (ref :37-41 same idempotence)."""
+    if os.path.isdir(save_dir) and os.listdir(save_dir):
+        print(f"dataset already materialized at {save_dir}; skipping download")
+        return save_dir
+    from datasets import load_dataset
+
+    ds = load_dataset(name, config)
+    ds.save_to_disk(save_dir)
+    with open(os.path.join(save_dir, "download_manifest.json"), "w") as f:
+        json.dump(
+            {
+                "dataset": name,
+                "config": config,
+                "splits": {k: len(v) for k, v in ds.items()},
+                "created": datetime.now(timezone.utc).isoformat(),
+            },
+            f, indent=2,
+        )
+    return save_dir
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", required=True, help="output .tshrd path")
@@ -39,7 +68,25 @@ def main() -> None:
     p.add_argument("--n-docs", type=int, default=20000,
                    help="synthetic corpus size (ignored with --dataset-path)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--download", nargs="?", const="PrimeIntellect/c4-tiny",
+                   default=None, metavar="HF_DATASET",
+                   help="download this HF dataset (default "
+                        "PrimeIntellect/c4-tiny, the reference's corpus) "
+                        "via load_dataset and save_to_disk into --save-dir "
+                        "first (ref setup_data_volume.py:27-56), then "
+                        "tokenize from there")
+    p.add_argument("--download-config", default="en",
+                   help="HF dataset config name (ref uses 'en')")
+    p.add_argument("--save-dir", default=None,
+                   help="save_to_disk target for --download "
+                        "(default: <out>.hf)")
     args = p.parse_args()
+
+    if args.download:
+        args.dataset_path = download_dataset(
+            args.download, args.download_config,
+            args.save_dir or args.out + ".hf",
+        )
 
     tokenizer = get_tokenizer(args.tokenizer)
     if args.dataset_path:

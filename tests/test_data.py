@@ -1,6 +1,8 @@
 """Data pipeline: tokenizers, packing, deterministic per-worker batching
 (the TPU analog of ref utils.py:45-60 + main.py:75-96)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -122,3 +124,45 @@ def test_shard_batcher_iter_from(tmp_path):
         t, _ = next(resumed)
         np.testing.assert_array_equal(t, wanted[k][0])
     b.close()
+
+
+def test_prepare_data_download_idempotent(tmp_path):
+    """--download skips the hub fetch when the save_to_disk target is
+    already materialized (≡ ref setup_data_volume.py:37-41) — the offline
+    half of the download path, testable with zero egress."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "prepare_data",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "prepare_data.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    target = tmp_path / "c4"
+    target.mkdir()
+    (target / "dataset_info.json").write_text("{}")
+    out = mod.download_dataset("PrimeIntellect/c4-tiny", "en", str(target))
+    assert out == str(target)  # returned without touching the network
+
+
+def test_launch_tpu_provision_dry_run():
+    """provision --dry-run prints the create/sync/bootstrap/run gcloud
+    commands without executing anything (≡ ref train_modal.py:8-45 Modal
+    app setup, re-expressed as TPU-VM operations)."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "scripts/launch_tpu.py", "provision",
+         "--name", "t", "--zone", "z", "--preset", "benchmark",
+         "--multihost", "--dry-run"],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert out.returncode == 0, out.stderr
+    lines = [l for l in out.stdout.splitlines() if l.startswith("+ gcloud")]
+    assert len(lines) == 4
+    assert "create t" in lines[0] and "--worker=all" in lines[1]
+    assert "pip install" in lines[2]
+    assert "NANODILOCO_MULTIHOST=1" in lines[3] and "benchmark" in lines[3]
