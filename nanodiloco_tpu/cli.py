@@ -92,13 +92,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "(e.g. bfloat16 halves sync traffic)")
     p.add_argument("--tokenizer", type=str, default=None,
                    help="HF tokenizer name/path; default byte-level fallback")
+    p.add_argument("--fit-vocab", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="shrink model vocab_size to the tokenizer's real "
+                        "vocabulary (rounded up to the 128-lane MXU tile) "
+                        "when the config's is larger")
     p.add_argument("--fused-rounds", action=argparse.BooleanOptionalAction,
                    default=True,
-                   help="dispatch each DiLoCo round (inner steps + sync) as "
-                        "one fused XLA program — the TPU fast path, ON by "
+                   help="dispatch each DiLoCo round (inner steps + sync; "
+                        "streaming fragment schedules included) as one "
+                        "fused XLA program — the TPU fast path, ON by "
                         "default (per-step losses still logged; falls back "
-                        "to stepwise for streaming/profiling/mid-round "
-                        "resume with a notice)")
+                        "to stepwise for profiling/mid-round resume with a "
+                        "notice)")
     p.add_argument("--measure-comm", action=argparse.BooleanOptionalAction,
                    default=True,
                    help="in fused mode, estimate the outer sync's real "
@@ -172,6 +178,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         outer_comm_dtype=args.outer_comm_dtype,
         model=model,
         tokenizer=args.tokenizer,
+        fit_vocab=args.fit_vocab,
         offload_snapshot=args.offload_snapshot,
         fused_rounds=args.fused_rounds,
         measure_comm=args.measure_comm,

@@ -99,6 +99,10 @@ LARGE_LLAMA = LlamaConfig(
 )
 
 # New capability target (BASELINE.json config 3): Llama-3-8B-class.
+# Ships with the memory-lean TPU policy: bf16 compute, per-layer remat,
+# blockwise flash attention (dense would materialize [B, H, S, S] scores
+# at S up to 8192), GQA-native kernels (32q/8kv never expanded), and
+# chunked CE over the 128k vocab.
 LLAMA3_8B = LlamaConfig(
     vocab_size=128256,
     hidden_size=4096,
@@ -110,4 +114,5 @@ LLAMA3_8B = LlamaConfig(
     rope_theta=500000.0,
     dtype="bfloat16",
     remat=True,
+    attention_impl="flash",
 )

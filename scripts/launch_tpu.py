@@ -21,6 +21,12 @@ plus ``custom`` which forwards any nanodiloco_tpu CLI flags verbatim.
 On a multi-host pod slice, run the same command on every host (e.g. via
 ``gcloud compute tpus tpu-vm ssh --worker=all --command=...``).
 
+``--supervise N`` (with any preset) runs training as a supervised child
+process restarted up to N times on failure — with ``--checkpoint-dir``
+each restart resumes bit-exactly from the last outer sync (failure
+recovery the reference lacks: SURVEY §5, a crash killed the whole NCCL
+job).
+
 ``provision`` is the cloud half (≡ the reference's Modal image/volume/
 cluster setup, ref train_modal.py:8-45,140-161): create a TPU VM or pod
 slice with gcloud, sync this repo to every host, bootstrap deps, and run
@@ -147,6 +153,38 @@ def provision(argv: list[str]) -> None:
             subprocess.run(cmd, check=True)
 
 
+def supervise(flags: list[str], retries: int, cmd: list[str] | None = None) -> None:
+    """Failure recovery the reference lacks entirely (SURVEY §5 "a worker
+    crash kills the NCCL job"; only Modal's 4 h timeout bounded it, ref
+    train_modal.py:86): run training as a child process and restart it on
+    nonzero exit up to ``retries`` times. With --checkpoint-dir set the
+    restart resumes bit-exactly from the last outer sync, so a TPU
+    preemption or OOM-kill costs at most one round of work."""
+    import time
+
+    if not any(f.startswith("--checkpoint-dir") for f in flags):
+        print(
+            "[supervise] warning: no --checkpoint-dir; restarts will begin "
+            "from step 0"
+        )
+    # route the child back through this launcher (custom preset) so
+    # multi-host pods still get _maybe_init_distributed() on restart
+    cmd = cmd or [sys.executable, os.path.abspath(__file__), "custom", *flags]
+    for attempt in range(retries + 1):
+        print(f"[supervise] attempt {attempt + 1}/{retries + 1}: "
+              + " ".join(map(shlex.quote, cmd)))
+        rc = subprocess.run(cmd).returncode
+        if rc == 0:
+            return
+        print(f"[supervise] training exited rc={rc}")
+        if attempt < retries:
+            backoff = min(60, 5 * (attempt + 1))
+            print(f"[supervise] restarting in {backoff}s (resume from last "
+                  "checkpoint)")
+            time.sleep(backoff)
+    raise SystemExit(rc)
+
+
 def main() -> None:
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
         print(__doc__)
@@ -156,6 +194,22 @@ def main() -> None:
     if preset == "provision":
         provision(extra)
         return
+
+    retries = None
+    kept = []
+    it = iter(extra)
+    for f in it:
+        if f == "--supervise":
+            try:
+                retries = int(next(it))
+            except StopIteration:
+                raise SystemExit("--supervise requires a retry count")
+        elif f.startswith("--supervise="):
+            retries = int(f.split("=", 1)[1])
+        else:
+            kept.append(f)
+    extra = kept
+
     if preset == "custom":
         flags = extra
     elif preset in PRESETS:
@@ -164,6 +218,10 @@ def main() -> None:
         raise SystemExit(
             f"unknown preset {preset!r}; options: {[*PRESETS, 'custom', 'provision']}"
         )
+
+    if retries is not None:
+        supervise(flags, retries)
+        return
 
     _maybe_init_distributed()
     from nanodiloco_tpu.cli import main as train_main

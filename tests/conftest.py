@@ -14,11 +14,22 @@ import os
 
 # Harmless if jax is already imported; effective if it is not.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# No network in CI: fail tokenizer-hub lookups instantly instead of
+# waiting out connect timeouts (~52 s on the offline-fallback test).
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+# Persistent compilation cache: the suite compiles many identical tiny
+# programs (every train() builds fresh jits); cache hits cut minutes off
+# repeat runs. Safe on CPU; keyed by backend+config so the axon TPU
+# path never collides.
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 import pytest  # noqa: E402
 

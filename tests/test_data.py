@@ -166,3 +166,34 @@ def test_launch_tpu_provision_dry_run():
     assert "create t" in lines[0] and "--worker=all" in lines[1]
     assert "pip install" in lines[2]
     assert "NANODILOCO_MULTIHOST=1" in lines[3] and "benchmark" in lines[3]
+
+
+def test_launch_tpu_supervise_restarts_on_failure(tmp_path):
+    """The supervisor restarts a failed child and stops once it exits 0
+    (SURVEY §5: failure recovery absent in the reference)."""
+    import importlib.util
+    import sys
+
+    spec = importlib.util.spec_from_file_location(
+        "launch_tpu",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "launch_tpu.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    marker = tmp_path / "failed_once"
+    child = (
+        "import os, sys; p = sys.argv[1]\n"
+        "sys.exit(0) if os.path.exists(p) else (open(p, 'w'), sys.exit(3))"
+    )
+    cmd = [sys.executable, "-c", child, str(marker)]
+    # fails once (writes marker, rc=3), restarted, then succeeds
+    mod.supervise(["--checkpoint-dir", str(tmp_path)], retries=2, cmd=cmd)
+    assert marker.exists()
+
+    # exhausted retries -> SystemExit with the child's rc
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit):
+        mod.supervise([], retries=0,
+                      cmd=[sys.executable, "-c", "import sys; sys.exit(7)"])

@@ -218,3 +218,45 @@ def test_streaming_on_sharded_mesh():
             assert np.isfinite(np.asarray(loss)).all()
             snaps.append(jax.tree.map(np.asarray, state.snapshot))
     assert tree_max_diff(snaps[0], snaps[1]) < 1e-4
+
+
+def test_streaming_fused_round_matches_stepwise():
+    """round_step (the ONE-executable H-step round whose launch/apply
+    branches derive from the traced step index) must be bit-identical to
+    driving the same round through the per-step fused path, for a
+    multi-fragment staggered schedule with delay."""
+    W, H = 2, 4
+    mesh = build_mesh(MeshConfig(diloco=W))
+    cfg = DilocoConfig(num_workers=W, inner_steps=H, warmup_steps=2,
+                       total_steps=40, lr=1e-3, grad_accum=1)
+    scfg = StreamingConfig(num_fragments=2, delay=1, merge_alpha=0.5)
+
+    batches = []
+    key = jax.random.key(7)
+    for _ in range(2 * H):  # two full rounds (cadence crosses rounds)
+        key, k = jax.random.split(key)
+        batches.append(make_batch(k, W))
+
+    sd_a = StreamingDiloco(TINY, cfg, mesh, scfg)
+    state_a = sd_a.init_state(jax.random.key(0))
+    losses_a = []
+    for t, (tok, m) in enumerate(batches, start=1):
+        state_a, loss = sd_a.step(state_a, tok, m, t)
+        losses_a.append(np.asarray(loss))
+
+    sd_b = StreamingDiloco(TINY, cfg, mesh, scfg)
+    state_b = sd_b.init_state(jax.random.key(0))
+    toks = jnp.stack([b[0] for b in batches[:H]])
+    masks = jnp.stack([b[1] for b in batches[:H]])
+    state_b, loss_r1 = sd_b.round_step(state_b, toks, masks)
+    toks = jnp.stack([b[0] for b in batches[H:]])
+    masks = jnp.stack([b[1] for b in batches[H:]])
+    state_b, loss_r2 = sd_b.round_step(state_b, toks, masks)
+
+    losses_b = np.concatenate([np.asarray(loss_r1), np.asarray(loss_r2)])
+    np.testing.assert_array_equal(np.stack(losses_a), losses_b)
+    for x, y in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for x, y in zip(jax.tree.leaves(state_a.pending), jax.tree.leaves(state_b.pending)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert int(state_b.inner_step_count) == 2 * H
