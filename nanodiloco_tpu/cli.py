@@ -106,12 +106,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "to stepwise for profiling/mid-round resume with a "
                         "notice)")
     p.add_argument("--measure-comm", action=argparse.BooleanOptionalAction,
-                   default=True,
+                   default=None,
                    help="in fused mode, estimate the outer sync's real "
                         "wall-clock share by differencing a warm round "
                         "against a warm inner-only round (one-time cost: "
                         "an extra compile + two throwaway inner-only "
-                        "rounds on a transient state copy)")
+                        "rounds on a transient state copy). Default: the "
+                        "wandb config's measure_comms flag (the knob the "
+                        "reference declared but never read, ref "
+                        "configs/wandb_default.json:5), else on")
     p.add_argument("--offload-snapshot", action="store_true",
                    help="keep the DiLoCo sync snapshot in host memory")
     p.add_argument("--eval-every", type=int, default=0,
@@ -154,6 +157,11 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     wandb_config = (
         load_config_from_file(args.wandb_config_file) if args.wandb_config_file else {}
     )
+    measure_comm = (
+        args.measure_comm
+        if args.measure_comm is not None
+        else bool(wandb_config.get("measure_comms", True))
+    )
     return TrainConfig(
         seed=args.seed,
         batch_size=args.batch_size,
@@ -181,7 +189,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         fit_vocab=args.fit_vocab,
         offload_snapshot=args.offload_snapshot,
         fused_rounds=args.fused_rounds,
-        measure_comm=args.measure_comm,
+        measure_comm=measure_comm,
         eval_every=args.eval_every,
         eval_batches=args.eval_batches,
         profile_dir=args.profile_dir,

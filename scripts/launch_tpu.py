@@ -153,7 +153,10 @@ def provision(argv: list[str]) -> None:
             subprocess.run(cmd, check=True)
 
 
-def supervise(flags: list[str], retries: int, cmd: list[str] | None = None) -> None:
+def supervise(
+    flags: list[str], retries: int, cmd: list[str] | None = None,
+    backoff_base: float = 5.0,
+) -> None:
     """Failure recovery the reference lacks entirely (SURVEY §5 "a worker
     crash kills the NCCL job"; only Modal's 4 h timeout bounded it, ref
     train_modal.py:86): run training as a child process and restart it on
@@ -178,7 +181,7 @@ def supervise(flags: list[str], retries: int, cmd: list[str] | None = None) -> N
             return
         print(f"[supervise] training exited rc={rc}")
         if attempt < retries:
-            backoff = min(60, 5 * (attempt + 1))
+            backoff = min(60, backoff_base * (attempt + 1))
             print(f"[supervise] restarting in {backoff}s (resume from last "
                   "checkpoint)")
             time.sleep(backoff)

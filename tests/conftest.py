@@ -17,6 +17,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # No network in CI: fail tokenizer-hub lookups instantly instead of
 # waiting out connect timeouts (~52 s on the offline-fallback test).
 os.environ.setdefault("HF_HUB_OFFLINE", "1")
+os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
 
 import jax  # noqa: E402
 
@@ -32,6 +33,17 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """Skip ``slow``-marked tests in the default run, but never when the
+    user asked for them — via ``-m`` or an explicit ``::`` node id."""
+    if config.getoption("-m") or any("::" in a for a in config.args):
+        return
+    skip = pytest.mark.skip(reason='slow parity test; run with -m "" or by node id')
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture(scope="session")

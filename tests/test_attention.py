@@ -77,6 +77,8 @@ def test_ring_matches_dense(sp):
     np.testing.assert_allclose(np.asarray(out), np.asarray(dense), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow  # ~28 s of tracing; ring-grad coverage also comes from
+# tests/test_sp_training.py's training-path parity (run all: pytest -m "")
 def test_ring_gradients_match_dense():
     b, s, h, hd = 1, 16, 2, 8
     q, k, v = qkv(jax.random.key(4), b=b, s=s, h=h, hd=hd)

@@ -188,7 +188,8 @@ def test_launch_tpu_supervise_restarts_on_failure(tmp_path):
     )
     cmd = [sys.executable, "-c", child, str(marker)]
     # fails once (writes marker, rc=3), restarted, then succeeds
-    mod.supervise(["--checkpoint-dir", str(tmp_path)], retries=2, cmd=cmd)
+    mod.supervise(["--checkpoint-dir", str(tmp_path)], retries=2, cmd=cmd,
+                  backoff_base=0.01)
     assert marker.exists()
 
     # exhausted retries -> SystemExit with the child's rc

@@ -236,3 +236,18 @@ def test_evaluator_matches_direct_loss(tmp_path):
         sl += float(aux["sum_loss"]); n += float(aux["n_tokens"])
     assert got["eval_loss"] == pytest.approx(sl / n, rel=1e-6)
     assert got["eval_tokens"] == n
+
+
+def test_cli_measure_comms_from_wandb_config(tmp_path):
+    """The wandb config's measure_comms flag — declared but never read by
+    the reference (ref configs/wandb_default.json:5, SURVEY §5) — actually
+    controls the comm measurement here; an explicit CLI flag wins."""
+    cfg_file = tmp_path / "wandb.json"
+    cfg_file.write_text(json.dumps({"nodes": 2, "measure_comms": False}))
+    args = build_parser().parse_args(["--wandb-config-file", str(cfg_file)])
+    assert config_from_args(args).measure_comm is False
+    args = build_parser().parse_args(
+        ["--wandb-config-file", str(cfg_file), "--measure-comm"]
+    )
+    assert config_from_args(args).measure_comm is True
+    assert config_from_args(build_parser().parse_args([])).measure_comm is True
