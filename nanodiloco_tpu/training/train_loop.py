@@ -354,72 +354,98 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         fused_sync_metrics = {"avg_sync_time_s": None, "comm_share": None}
         first_round = start_step // cfg.inner_steps + 1
         last_round = cfg.total_steps // cfg.inner_steps
-        for rnd in range(first_round, last_round + 1):
-            # stacking is shared with Diloco.run_round but timed
-            # separately here so host-side batch assembly never pollutes
-            # the differenced sync estimate
-            toks, masks = dl.stack_round_batches(batches)
-            t0 = time.perf_counter()
-            state, losses = dl.round_step(state, toks, masks)
-            jax.block_until_ready(losses)
-            round_s = time.perf_counter() - t0
-            compute_time += round_s
-            state = dl._offload(state)
-            if cfg.measure_comm:
-                # Differenced estimate: warm full round minus warm
-                # inner-only round (neither side carries compile time).
-                # The inner-only side costs two throwaway rounds on state
-                # copies (compile + timed; one copy alive at a time —
-                # transient 2x state HBM). The full-round side is the
-                # running MIN of warm rounds' own wall clocks (converges
-                # as noise/recompiles wash out); only a single-round run
-                # pays one extra probe round for it.
-                if est_inner_s is None:
-                    est_inner_s = dl.measure_inner_round_time(
-                        state, toks, masks, repeats=1
+        # Host-side round assembly (draw H batches, stack, device_put)
+        # runs one round AHEAD on a background thread, overlapping the
+        # device's current round (numpy stacking releases the GIL; the
+        # generator is only ever touched by this single worker thread,
+        # sequentially). The pipeline deliberately PAUSES around the
+        # one-time comm measurement: no prefetch may be in flight while
+        # the differenced probes run, or host/DMA contention biases the
+        # estimate (and the probe's 2x-state window would also hold an
+        # extra round of batches in HBM).
+        from concurrent.futures import ThreadPoolExecutor
+
+        prefetcher = ThreadPoolExecutor(max_workers=1)
+        pending = (
+            prefetcher.submit(dl.stack_round_batches, batches)
+            if first_round <= last_round
+            else None
+        )
+        try:
+            for rnd in range(first_round, last_round + 1):
+                toks, masks = pending.result()
+                pending = None
+                measuring = cfg.measure_comm and est_inner_s is None
+                if rnd < last_round and not measuring:
+                    pending = prefetcher.submit(dl.stack_round_batches, batches)
+                t0 = time.perf_counter()
+                state, losses = dl.round_step(state, toks, masks)
+                jax.block_until_ready(losses)
+                round_s = time.perf_counter() - t0
+                compute_time += round_s
+                state = dl._offload(state)
+                if cfg.measure_comm:
+                    # Differenced estimate: warm full round minus warm
+                    # inner-only round (neither side carries compile time).
+                    # The inner-only side costs two throwaway rounds on state
+                    # copies (compile + timed; one copy alive at a time —
+                    # transient 2x state HBM). The full-round side is the
+                    # running MIN of warm rounds' own wall clocks (converges
+                    # as noise/recompiles wash out); only a single-round run
+                    # pays one extra probe round for it.
+                    if est_inner_s is None:
+                        est_inner_s = dl.measure_inner_round_time(
+                            state, toks, masks, repeats=1
+                        )
+                        if rnd == last_round:  # no warm round 2 will come
+                            probe = jax.tree.map(jnp.copy, state)
+                            t0 = time.perf_counter()
+                            probe, probe_loss = dl.round_step(probe, toks, masks)
+                            jax.block_until_ready(probe_loss)
+                            best_full_s = time.perf_counter() - t0
+                            del probe
+                    else:
+                        best_full_s = min(best_full_s or round_s, round_s)
+                    if best_full_s is not None:
+                        sync_s = max(0.0, best_full_s - est_inner_s)
+                        fused_sync_metrics = {
+                            "avg_sync_time_s": sync_s,
+                            "comm_share": sync_s / best_full_s,
+                        }
+                if pending is None and rnd < last_round:
+                    # resume the pipeline after the measurement pause
+                    pending = prefetcher.submit(dl.stack_round_batches, batches)
+                real_step = rnd * cfg.inner_steps
+                if ckpt and rnd % cfg.checkpoint_every == 0:
+                    ckpt.save(real_step, state)
+                eval_metrics = {}
+                if evaluator is not None and rnd % cfg.eval_every == 0:
+                    eval_metrics = evaluator(state.snapshot, eval_set)
+                    last_eval_step, last_eval = real_step, eval_metrics
+                losses = np.asarray(losses)  # [H, W]
+                for i in range(cfg.inner_steps):
+                    step = real_step - cfg.inner_steps + 1 + i
+                    step_loss = float(losses[i].mean())
+                    logger.log(
+                        {
+                            **(eval_metrics if i == cfg.inner_steps - 1 else {}),
+                            "loss": step_loss,
+                            "perplexity": float(np.exp(min(step_loss, 50.0))),
+                            "lr": float(schedule(step - 1)),
+                            "effective_step": step * cfg.num_workers,
+                            "total_samples": step * cfg.batch_size * cfg.num_workers,
+                            "tokens_per_sec": (real_step - start_step) * tokens_per_step
+                            / compute_time,
+                            "outer_synced": int(i == cfg.inner_steps - 1),
+                            **fused_sync_metrics,
+                        },
+                        step=step,
                     )
-                    if rnd == last_round:  # no warm round 2 will come
-                        probe = jax.tree.map(jnp.copy, state)
-                        t0 = time.perf_counter()
-                        probe, probe_loss = dl.round_step(probe, toks, masks)
-                        jax.block_until_ready(probe_loss)
-                        best_full_s = time.perf_counter() - t0
-                        del probe
-                else:
-                    best_full_s = min(best_full_s or round_s, round_s)
-                if best_full_s is not None:
-                    sync_s = max(0.0, best_full_s - est_inner_s)
-                    fused_sync_metrics = {
-                        "avg_sync_time_s": sync_s,
-                        "comm_share": sync_s / best_full_s,
-                    }
-            real_step = rnd * cfg.inner_steps
-            if ckpt and rnd % cfg.checkpoint_every == 0:
-                ckpt.save(real_step, state)
-            eval_metrics = {}
-            if evaluator is not None and rnd % cfg.eval_every == 0:
-                eval_metrics = evaluator(state.snapshot, eval_set)
-                last_eval_step, last_eval = real_step, eval_metrics
-            losses = np.asarray(losses)  # [H, W]
-            for i in range(cfg.inner_steps):
-                step = real_step - cfg.inner_steps + 1 + i
-                step_loss = float(losses[i].mean())
-                logger.log(
-                    {
-                        **(eval_metrics if i == cfg.inner_steps - 1 else {}),
-                        "loss": step_loss,
-                        "perplexity": float(np.exp(min(step_loss, 50.0))),
-                        "lr": float(schedule(step - 1)),
-                        "effective_step": step * cfg.num_workers,
-                        "total_samples": step * cfg.batch_size * cfg.num_workers,
-                        "tokens_per_sec": (real_step - start_step) * tokens_per_step
-                        / compute_time,
-                        "outer_synced": int(i == cfg.inner_steps - 1),
-                        **fused_sync_metrics,
-                    },
-                    step=step,
-                )
-            last_loss = float(losses[-1].mean())
+                last_loss = float(losses[-1].mean())
+        finally:
+            if pending is not None:
+                pending.cancel()
+            prefetcher.shutdown(wait=False)
 
     for real_step in ([] if fused else range(start_step + 1, cfg.total_steps + 1)):
         if cfg.profile_dir and real_step == profile_start:

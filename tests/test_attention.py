@@ -1,15 +1,13 @@
 """Attention kernels: blockwise (flash-style) and ring attention must match
 dense attention exactly (up to fp32 reassociation), including under grad."""
 
-import math
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
-from nanodiloco_tpu.models.llama import causal_mask, dense_attention
+from nanodiloco_tpu.models.llama import dense_attention
 from nanodiloco_tpu.ops.flash_attention import flash_attention
 from nanodiloco_tpu.ops.ring_attention import ring_attention
 

@@ -6,7 +6,6 @@ slice->assemble path must reproduce the single-host batch bit-exactly).
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P

@@ -11,7 +11,7 @@ import numpy as np
 import pytest
 
 from nanodiloco_tpu.models import LlamaConfig
-from nanodiloco_tpu.models.llama import causal_lm_loss, causal_lm_loss_sp, init_params
+from nanodiloco_tpu.models.llama import causal_lm_loss_sp, init_params
 from nanodiloco_tpu.parallel import Diloco, DilocoConfig, MeshConfig, build_mesh
 
 RING = LlamaConfig(
