@@ -63,6 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel mesh axis size (long context via "
                         "ring attention; requires --attention ring)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel stages: the layer stack is "
+                        "sharded over this axis and the grad-accumulation "
+                        "microbatches stream through GPipe-style "
+                        "(incompatible with --sp and streaming)")
     p.add_argument("--dcn-slices", type=int, default=1,
                    help="multi-slice deployment: spread the diloco axis "
                         "across this many TPU slices (outer sync over DCN)")
@@ -179,6 +184,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         fsdp=args.fsdp,
         tp=args.tp,
         sp=args.sp,
+        pp=args.pp,
         dcn_slices=args.dcn_slices,
         streaming_fragments=args.streaming_fragments,
         streaming_delay=args.streaming_delay,

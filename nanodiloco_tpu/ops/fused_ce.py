@@ -57,7 +57,9 @@ def chunked_softmax_xent(
 
     # derive the init from the data so it carries the correct varying-axes
     # type when this runs inside a shard_map manual region (a plain
-    # jnp.zeros would be unvarying and fail scan's carry typing)
-    zero = 0.0 * weights[0, 0]
+    # jnp.zeros would be unvarying and fail scan's carry typing); both
+    # inputs contribute — under pipeline parallelism the hidden states
+    # are pp-varying while the weights are not
+    zero = 0.0 * weights[0, 0] + 0.0 * hidden[0, 0, 0].astype(jnp.float32)
     sum_loss, _ = jax.lax.scan(body, zero, (hidden, targets, weights))
     return sum_loss, jnp.sum(weights)

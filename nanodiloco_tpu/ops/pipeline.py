@@ -1,0 +1,149 @@
+"""Pipeline parallelism: the layer stack sharded over a ``pp`` mesh axis,
+microbatches streamed through the stages GPipe-style.
+
+The reference has no pipeline parallelism (SURVEY §2 "Pipeline
+parallelism (PP): NO"); this is a TPU-native capability add. Design:
+
+- **Stages are a sharding of the stacked layer axis.** The model's
+  per-layer weights are already stacked on a leading ``[L, ...]`` axis
+  (models/llama.py); stage p simply holds the contiguous slice
+  ``layers[p*L/P : (p+1)*L/P]`` — the PartitionSpec puts the layer axis
+  on ``pp`` and ``shard_map`` hands each stage its local slice. No
+  parameter surgery, no per-stage module classes.
+- **SPMD schedule, not per-stage programs.** All stages run ONE traced
+  program: a ``lax.scan`` over ``T = M + P - 1`` ticks. At each tick a
+  stage runs its layers on whatever activation sits in its buffer, then
+  ``ppermute``s the result to the next stage. Stage 0 ingests microbatch
+  ``t`` from the (grad-accumulation) microbatch axis; the last stage
+  emits a loss for microbatch ``t - (P-1)`` when valid. The pipeline
+  bubble is the standard GPipe ``(P-1)/(M+P-1)``.
+- **Backward for free.** ``jax.grad`` through the scan+ppermute forward
+  yields the reverse pipeline schedule automatically (the cotangent of a
+  ``ppermute`` is the inverse ``ppermute``), so there is no hand-written
+  backward schedule to maintain.
+- **Head/embed replicated over pp.** Only stage 0's embedding lookup and
+  the last stage's LM head contribute (masked straight-line compute —
+  per-stage divergent ``lax.cond`` deadlocks the transposed collectives,
+  and in lockstep SPMD it would save no wall clock anyway); their
+  gradients are zero on the other stages and get one ``psum`` in the
+  caller.
+
+Must be called inside ``jax.shard_map`` with ``axis_name`` bound (the
+callers: Diloco._pp_inner_update for training, tests for parity).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nanodiloco_tpu.models.config import LlamaConfig
+from nanodiloco_tpu.models.llama import _decoder_layer, rms_norm, rope_tables
+from nanodiloco_tpu.ops.fused_ce import chunked_softmax_xent
+
+
+def _hidden_ce(h, head, targets, weights, chunk: int):
+    """(sum_loss, n_tokens) from final hidden states [B, S-1 rows]."""
+    b, s1, d = h.shape
+    if chunk:
+        return chunked_softmax_xent(
+            h.reshape(b * s1, d), head.astype(h.dtype),
+            targets.reshape(-1), weights.reshape(-1), chunk=chunk,
+        )
+    logits = (h @ head.astype(h.dtype)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * weights), jnp.sum(weights)
+
+
+def pp_shard_loss(
+    params: dict,
+    tokens_mb: jax.Array,     # [M, B, S] — microbatches = pipeline slots
+    cfg: LlamaConfig,
+    loss_mask_mb: jax.Array,  # [M, B, S]
+    axis_name: str = "pp",
+) -> tuple[jax.Array, jax.Array]:
+    """Per-stage UNREDUCED (sum_loss, n_tokens): only the final stage
+    contributes nonzero values — callers ``psum`` both over ``axis_name``
+    (and psum the replicated embed/head/norm grads).
+
+    ``params`` is this stage's view: ``layers`` leaves are the local
+    ``[L/P, ...]`` slice; ``embed``/``final_norm``/``lm_head`` are the
+    full replicated arrays.
+    """
+    p_idx = lax.axis_index(axis_name)
+    n_stages = lax.psum(1, axis_name)
+    M, B, S = tokens_mb.shape
+    cdt = jnp.dtype(cfg.dtype)
+    cos, sin = rope_tables(cfg, S)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+
+    def layer_fn(x, layer, cos, sin):
+        return _decoder_layer(cfg, x, layer, cos, sin, None, None)
+
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def run_stage(x):
+        def body(carry, layer):
+            return layer_fn(carry, layer, cos, sin), None
+
+        x, _ = lax.scan(body, x, params["layers"])
+        return x
+
+    def mb_loss(y, t):
+        """Loss of the microbatch leaving the pipe at tick t (valid only
+        on the final stage for 0 <= t-(P-1) < M)."""
+        m_out = jnp.clip(t - (n_stages - 1), 0, M - 1)
+        tok = lax.dynamic_index_in_dim(tokens_mb, m_out, 0, keepdims=False)
+        msk = lax.dynamic_index_in_dim(loss_mask_mb, m_out, 0, keepdims=False)
+        h = rms_norm(y, params["final_norm"], cfg.rms_norm_eps)
+        return _hidden_ce(
+            h[:, :-1],
+            head,
+            tok[:, 1:],
+            msk[:, 1:].astype(jnp.float32),
+            cfg.loss_chunk,
+        )
+
+    def tick(carry, t):
+        buf, sum_loss, n_tok = carry
+        # stage 0 ingests microbatch t (clamped; drained ticks recompute
+        # the last microbatch and their outputs are never used)
+        m_in = jnp.clip(t, 0, M - 1)
+        tok_in = lax.dynamic_index_in_dim(tokens_mb, m_in, 0, keepdims=False)
+        x0 = params["embed"].astype(cdt)[tok_in]
+        x = jnp.where(p_idx == 0, x0, buf)
+        y = run_stage(x)
+        # straight-line masking, no lax.cond: per-stage divergent control
+        # flow around code whose transpose touches collectives deadlocks
+        # the backward (devices reach collectives in different orders),
+        # and in lockstep SPMD skipping the head matmul on non-final
+        # stages saves no wall clock anyway — every stage waits for the
+        # slowest one each tick.
+        valid = (
+            (p_idx == n_stages - 1) & (t >= n_stages - 1)
+        ).astype(jnp.float32)
+        sl, n = mb_loss(y, t)
+        sl, n = valid * sl, valid * n
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        buf = lax.ppermute(y, axis_name, perm)
+        return (buf, sum_loss + sl, n_tok + n), None
+
+    # carries start typed as varying over the pp axis (their updates
+    # are); data-derived zeros carry any other manual axes' vary-ness
+    first = params["embed"].astype(cdt)[tokens_mb[0]]
+    buf0 = lax.pcast(first * 0.0, (axis_name,), to="varying")
+    z = lax.pcast(
+        jnp.sum(first[..., 0]).astype(jnp.float32) * 0.0,
+        (axis_name,),
+        to="varying",
+    )
+    T = M + n_stages - 1
+    (_, sum_loss, n_tok), _ = lax.scan(
+        tick, (buf0, z, z), jnp.arange(T, dtype=jnp.int32)
+    )
+    return sum_loss, n_tok

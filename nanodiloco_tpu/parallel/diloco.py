@@ -101,32 +101,51 @@ class Diloco:
         self.cfg = cfg
         self.mesh = mesh
         self.sp = int(dict(mesh.shape).get("sp", 1))
-        if self.sp > 1 and loss_fn is not None:
+        self.pp = int(dict(mesh.shape).get("pp", 1))
+        if (self.sp > 1 or self.pp > 1) and loss_fn is not None:
             raise ValueError(
-                "custom loss_fn is not supported with sequence parallelism "
-                "(sp > 1): the inner step runs the loss inside a manual "
-                "(diloco, sp) shard_map region"
+                "custom loss_fn is not supported with sequence or pipeline "
+                "parallelism: the inner step runs the loss inside a manual "
+                "shard_map region"
             )
-        if self.sp > 1 and int(dict(mesh.shape)["diloco"]) != cfg.num_workers:
+        if self.sp > 1 and self.pp > 1:
+            raise ValueError("sp and pp cannot be combined (yet)")
+        if self.pp > 1:
+            if model_cfg.num_hidden_layers % self.pp:
+                raise ValueError(
+                    f"num_hidden_layers {model_cfg.num_hidden_layers} must "
+                    f"divide evenly into {self.pp} pipeline stages"
+                )
+            if model_cfg.attention_impl == "ring":
+                raise ValueError("pp > 1 requires attention dense or flash")
+        if (
+            (self.sp > 1 or self.pp > 1)
+            and int(dict(mesh.shape)["diloco"]) != cfg.num_workers
+        ):
             raise ValueError(
-                "sp > 1 requires one mesh shard per DiLoCo worker "
+                "sp/pp > 1 requires one mesh shard per DiLoCo worker "
                 f"(diloco axis {dict(mesh.shape)['diloco']} != num_workers "
                 f"{cfg.num_workers})"
             )
         self.loss_fn = loss_fn or (
             lambda p, t, m: causal_lm_loss(p, t, model_cfg, loss_mask=m)
         )
+        # Under pipeline parallelism each stage holds only its layer
+        # slice, so optax's clip_by_global_norm would clip by the LOCAL
+        # norm; the chain is built clip-free and _pp_inner_update clips
+        # with a psum'd global norm instead.
         self.inner_tx = inner_tx or inner_optimizer(
             cfg.lr, cfg.warmup_steps, cfg.total_steps,
-            weight_decay=cfg.weight_decay, clip_norm=cfg.clip_norm,
+            weight_decay=cfg.weight_decay,
+            clip_norm=None if self.pp > 1 else cfg.clip_norm,
         )
         self.outer_tx = outer_tx or outer_optimizer(
             cfg.outer_lr, cfg.outer_momentum, cfg.nesterov
         )
         from nanodiloco_tpu.parallel.feed import BatchFeeder
 
-        self._pspec = param_specs(model_cfg, worker_axis=False)
-        self._wspec = param_specs(model_cfg, worker_axis=True)
+        self._pspec = param_specs(model_cfg, worker_axis=False, pp=self.pp > 1)
+        self._wspec = param_specs(model_cfg, worker_axis=True, pp=self.pp > 1)
         bspec = batch_spec(sp=self.sp > 1)
         # multi-host-safe batch placement: [W, A, B, S] steps and
         # [H, W, A, B, S] stacked rounds
@@ -277,6 +296,8 @@ class Diloco:
 
         if self.sp > 1:
             params, inner_opt_state, loss = self._sp_inner_update(state, tokens, loss_mask)
+        elif self.pp > 1:
+            params, inner_opt_state, loss = self._pp_inner_update(state, tokens, loss_mask)
         else:
             params, inner_opt_state, loss = jax.vmap(worker_update)(
                 state.params, state.inner_opt_state, tokens, loss_mask
@@ -359,6 +380,110 @@ class Diloco:
             in_specs=(wspec(state.params), wspec(state.inner_opt_state), bspec, bspec),
             out_specs=(wspec(state.params), wspec(state.inner_opt_state), P("diloco")),
             axis_names={"diloco", "sp"},
+        )(state.params, state.inner_opt_state, tokens, loss_mask)
+        return params, inner_opt_state, loss
+
+    def _pp_param_spec(self, params: Any):
+        """Per-leaf PartitionSpecs for the pp manual region: stacked
+        params' layer leaves are [W, L, ...] -> P('diloco', 'pp');
+        everything else (embed/head/norms) carries only the worker
+        axis."""
+        return {
+            k: (
+                jax.tree.map(lambda _: P("diloco", "pp"), v)
+                if k == "layers"
+                else jax.tree.map(lambda _: P("diloco"), v)
+            )
+            for k, v in params.items()
+        }
+
+    def _pp_state_spec(self, tree: Any, param_spec: Any, pstruct):
+        """Spec tree for an optimizer state: param-structured subtrees
+        (mu/nu) get ``param_spec``; other leaves P('diloco')."""
+
+        def is_param_tree(x):
+            try:
+                return jax.tree.structure(x) == pstruct
+            except Exception:
+                return False
+
+        return jax.tree.map(
+            lambda sub: param_spec if is_param_tree(sub) else P("diloco"),
+            tree,
+            is_leaf=is_param_tree,
+        )
+
+    def _pp_inner_update(self, state: DilocoState, tokens, loss_mask):
+        """Pipeline-parallel inner step: ONE shard_map manual over
+        ``(diloco, pp)`` — each worker's stage group streams the
+        grad-accumulation microbatches through the layer-stage pipeline
+        (ops/pipeline.py), with fsdp/tp left auto-partitioned inside the
+        manual region. Gradient post-processing per stage: replicated
+        (embed/head/norm) grads are psum'd over pp, layer grads stay
+        stage-local, and global-norm clipping uses a psum'd norm (each
+        parameter counted exactly once)."""
+        from nanodiloco_tpu.ops.pipeline import pp_shard_loss
+
+        clip = self.cfg.clip_norm
+
+        def body(params_w, opt_w, tok_w, mask_w):
+            params = jax.tree.map(lambda x: x[0], params_w)
+            opt_state = jax.tree.map(lambda x: x[0], opt_w)
+            w_tokens, w_mask = tok_w[0], mask_w[0]  # [accum(M), B, S]
+
+            def sum_loss_fn(p):
+                sl, n = pp_shard_loss(p, w_tokens, self.model_cfg, w_mask, "pp")
+                sl = jax.lax.psum(sl, "pp")
+                n = jax.lax.psum(n, "pp")
+                return sl, n
+
+            (sl, n), g = jax.value_and_grad(sum_loss_fn, has_aux=True)(params)
+            # replicated leaves: every stage holds a copy, only one
+            # computed a nonzero grad — combine so the copies stay equal
+            g = {
+                k: (v if k == "layers" else jax.tree.map(
+                    lambda x: jax.lax.psum(x, "pp"), v))
+                for k, v in g.items()
+            }
+            grads = jax.tree.map(lambda x: x / jnp.maximum(n, 1e-9), g)
+            if clip is not None:
+                sq_layers = sum(
+                    jnp.sum(jnp.square(x))
+                    for x in jax.tree.leaves(grads["layers"])
+                )
+                sq_rep = sum(
+                    jnp.sum(jnp.square(x))
+                    for k, v in grads.items() if k != "layers"
+                    for x in jax.tree.leaves(v)
+                )
+                g_norm = jnp.sqrt(jax.lax.psum(sq_layers, "pp") + sq_rep)
+                # optax.clip_by_global_norm semantics: untouched below
+                # the threshold, scaled by max_norm/norm above it
+                grads = jax.tree.map(
+                    lambda t: jnp.where(g_norm < clip, t, (t / g_norm) * clip),
+                    grads,
+                )
+            updates, opt_state = self.inner_tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            loss = sl / jnp.maximum(n, 1e-9)
+            return (
+                jax.tree.map(lambda x: x[None], params),
+                jax.tree.map(lambda x: x[None], opt_state),
+                loss[None],
+            )
+
+        pstruct = jax.tree.structure(state.snapshot)
+        param_spec = self._pp_param_spec(state.params)
+        opt_spec = self._pp_state_spec(
+            state.inner_opt_state, param_spec, pstruct
+        )
+        bspec = P("diloco")
+        params, inner_opt_state, loss = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(param_spec, opt_spec, bspec, bspec),
+            out_specs=(param_spec, opt_spec, P("diloco")),
+            axis_names={"diloco", "pp"},
         )(state.params, state.inner_opt_state, tokens, loss_mask)
         return params, inner_opt_state, loss
 

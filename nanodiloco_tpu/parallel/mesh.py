@@ -9,6 +9,8 @@ library. Axis vocabulary:
               all-reduce crosses. On multi-slice deployments this is the
               DCN (slowest) axis — exactly where DiLoCo's communication
               pattern wants the slow links.
+- ``pp``      pipeline parallelism: the stacked layer axis sharded into
+              stages, microbatches streamed GPipe-style (ops/pipeline.py).
 - ``fsdp``    intra-worker parameter/data sharding (ZeRO-style).
 - ``tp``      tensor parallelism over heads / MLP hidden.
 - ``sp``      sequence/context parallelism (ring attention).
@@ -29,7 +31,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXES = ("diloco", "fsdp", "tp", "sp")
+AXES = ("diloco", "pp", "fsdp", "tp", "sp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,10 +40,11 @@ class MeshConfig:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
+    pp: int = 1
 
     @property
     def shape(self) -> tuple[int, ...]:
-        return (self.diloco, self.fsdp, self.tp, self.sp)
+        return (self.diloco, self.pp, self.fsdp, self.tp, self.sp)
 
     @property
     def num_devices(self) -> int:
@@ -98,7 +101,7 @@ def build_hybrid_mesh(
     if n > len(devices):
         raise ValueError(f"mesh needs {n} devices, only {len(devices)} available")
     devices = devices[:n]
-    per_slice = (cfg.diloco // num_slices, cfg.fsdp, cfg.tp, cfg.sp)
+    per_slice = (cfg.diloco // num_slices, cfg.pp, cfg.fsdp, cfg.tp, cfg.sp)
     # Only degrade to the plain mesh when this is demonstrably NOT a
     # multi-slice deployment (virtual/CPU devices have no slice_index).
     # On real multi-slice hardware errors must propagate — a silent
@@ -107,7 +110,7 @@ def build_hybrid_mesh(
     if getattr(devices[0], "slice_index", None) is None:
         return build_mesh(cfg, devices)
     dev_array = mesh_utils.create_hybrid_device_mesh(
-        per_slice, (num_slices, 1, 1, 1), devices=devices
+        per_slice, (num_slices, 1, 1, 1, 1), devices=devices
     )
     return Mesh(dev_array, AXES)
 

@@ -27,8 +27,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from nanodiloco_tpu.models.config import LlamaConfig
 
 
-def param_specs(cfg: LlamaConfig, worker_axis: bool = False) -> dict[str, Any]:
-    """PartitionSpec pytree matching models.llama.init_params' tree."""
+def param_specs(
+    cfg: LlamaConfig, worker_axis: bool = False, pp: bool = False
+) -> dict[str, Any]:
+    """PartitionSpec pytree matching models.llama.init_params' tree.
+    With ``pp`` the stacked LAYER axis shards over the pipeline stages
+    (ops/pipeline.py) — embed/head/norms stay replicated across pp."""
+    lax0 = "pp" if pp else None  # the leading (layer) axis of layer leaves
     specs = {
         # vocab axis deliberately NOT sharded: a token gather from a
         # vocab-sharded table forces XLA into full rematerialization
@@ -37,15 +42,15 @@ def param_specs(cfg: LlamaConfig, worker_axis: bool = False) -> dict[str, Any]:
         "embed": P(None, "fsdp"),
         "final_norm": P(),
         "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, "fsdp", "tp"),
-            "wk": P(None, "fsdp", "tp"),
-            "wv": P(None, "fsdp", "tp"),
-            "wo": P(None, "tp", "fsdp"),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, "fsdp", "tp"),
-            "w_up": P(None, "fsdp", "tp"),
-            "w_down": P(None, "tp", "fsdp"),
+            "attn_norm": P(lax0, None),
+            "wq": P(lax0, "fsdp", "tp"),
+            "wk": P(lax0, "fsdp", "tp"),
+            "wv": P(lax0, "fsdp", "tp"),
+            "wo": P(lax0, "tp", "fsdp"),
+            "mlp_norm": P(lax0, None),
+            "w_gate": P(lax0, "fsdp", "tp"),
+            "w_up": P(lax0, "fsdp", "tp"),
+            "w_down": P(lax0, "tp", "fsdp"),
         },
     }
     if not cfg.tie_word_embeddings:

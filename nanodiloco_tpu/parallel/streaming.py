@@ -132,6 +132,12 @@ class StreamingDiloco(Diloco):
     def __init__(self, model_cfg, cfg: DilocoConfig, mesh, scfg: StreamingConfig,
                  **kwargs):
         super().__init__(model_cfg, cfg, mesh, **kwargs)
+        if self.pp > 1:
+            raise ValueError(
+                "streaming DiLoCo cannot be combined with pipeline "
+                "parallelism: fragment slicing and stage sharding both "
+                "partition the layer axis"
+            )
         self.scfg = scfg
         H, P = cfg.inner_steps, scfg.num_fragments
         if scfg.delay >= H:

@@ -68,6 +68,7 @@ class TrainConfig:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1   # sequence-parallel shards (ring attention long-context path)
+    pp: int = 1   # pipeline stages (layer stack sharded, GPipe microbatching)
     dcn_slices: int = 1  # multi-slice: diloco axis spans slices over DCN
     # dispatch whole DiLoCo rounds (H inner steps + sync) as ONE fused
     # executable — no host round-trips between steps (~8% faster end to
@@ -141,9 +142,25 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             raise ValueError("--sp > 1 requires --attention ring")
         if cfg.seq_length % cfg.sp:
             raise ValueError("seq_length must divide evenly by sp")
+    if cfg.pp > 1:
+        if cfg.streaming_fragments > 0:
+            raise ValueError(
+                "--pp cannot be combined with streaming DiLoCo (fragment "
+                "slicing and stage sharding both partition the layer axis)"
+            )
+        if cfg.grad_accum < 2 * cfg.pp and not cfg.quiet:
+            print(
+                f"[nanodiloco] warning: grad_accum {cfg.grad_accum} < "
+                f"2*pp ({2 * cfg.pp}): the GPipe bubble "
+                f"({cfg.pp - 1}/{cfg.grad_accum + cfg.pp - 1} of each "
+                "step) will dominate; raise --batch-size or lower "
+                "--per-device-batch-size for more microbatches"
+            )
     if cfg.eval_every and cfg.eval_batches < 1:
         raise ValueError("--eval-every requires --eval-batches >= 1")
-    mesh_cfg = MeshConfig(diloco=cfg.num_workers, fsdp=cfg.fsdp, tp=cfg.tp, sp=cfg.sp)
+    mesh_cfg = MeshConfig(
+        diloco=cfg.num_workers, fsdp=cfg.fsdp, tp=cfg.tp, sp=cfg.sp, pp=cfg.pp
+    )
     if cfg.dcn_slices > 1:
         from nanodiloco_tpu.parallel.mesh import build_hybrid_mesh
 

@@ -26,7 +26,9 @@ TINY = LlamaConfig(
 def test_mesh_shape_and_axes():
     mesh = build_mesh(MeshConfig(diloco=4, fsdp=2))
     assert mesh.axis_names == AXES
-    assert dict(mesh.shape) == {"diloco": 4, "fsdp": 2, "tp": 1, "sp": 1}
+    assert dict(mesh.shape) == {
+        "diloco": 4, "pp": 1, "fsdp": 2, "tp": 1, "sp": 1,
+    }
 
 
 def test_mesh_too_many_devices_raises():

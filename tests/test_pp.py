@@ -1,0 +1,166 @@
+"""Pipeline parallelism (ops/pipeline.py + Diloco._pp_inner_update):
+the layer stack sharded over the ``pp`` mesh axis, grad-accumulation
+microbatches streamed GPipe-style through the stages via ppermute.
+
+The reference has no pipeline parallelism (SURVEY §2: "Pipeline
+parallelism (PP): NO") — this is a TPU-native capability add; parity
+against the unsharded path is the correctness contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from nanodiloco_tpu.models import LlamaConfig, causal_lm_loss, init_params
+from nanodiloco_tpu.ops.pipeline import pp_shard_loss
+from nanodiloco_tpu.parallel import Diloco, DilocoConfig, MeshConfig, build_mesh
+
+TINY = LlamaConfig(
+    vocab_size=96, hidden_size=32, intermediate_size=64,
+    num_attention_heads=4, num_hidden_layers=4,
+    max_position_embeddings=32, loss_chunk=16,
+)
+
+
+def tree_max_diff(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return max(float(jnp.max(jnp.abs(x - y))) for x, y in zip(la, lb))
+
+
+def _pp_loss_fn(mesh, cfg, params):
+    pspec = {
+        "embed": P(), "final_norm": P(), "lm_head": P(),
+        "layers": jax.tree.map(lambda _: P("pp"), params["layers"]),
+    }
+
+    def shard_fn(params, toks, mask):
+        sl, n = pp_shard_loss(params, toks, cfg, mask, "pp")
+        return jax.lax.psum(sl, "pp"), jax.lax.psum(n, "pp")
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(pspec, P(), P()), out_specs=(P(), P()),
+        axis_names={"pp"},
+    )
+
+
+@pytest.mark.parametrize("stages", [2, 4])
+def test_pp_loss_matches_unsharded(stages):
+    """Sum-loss and token counts through the P-stage pipeline equal the
+    per-microbatch causal_lm_loss, including loss masking."""
+    params = init_params(jax.random.key(0), TINY)
+    M, B, S = 5, 2, 16
+    toks = jax.random.randint(jax.random.key(1), (M, B, S), 0, TINY.vocab_size)
+    mask = jnp.ones_like(toks).at[0, :, 12:].set(0)
+    mesh = Mesh(np.asarray(jax.devices()[:stages]).reshape(stages), ("pp",))
+    f = _pp_loss_fn(mesh, TINY, params)
+
+    with jax.default_matmul_precision("highest"):
+        sl, n = jax.jit(f)(params, toks, mask)
+        ref_sl = ref_n = 0.0
+        for m in range(M):
+            _, aux = causal_lm_loss(params, toks[m], TINY, loss_mask=mask[m])
+            ref_sl += float(aux["sum_loss"])
+            ref_n += float(aux["n_tokens"])
+    np.testing.assert_allclose(float(sl), ref_sl, rtol=1e-5)
+    assert float(n) == ref_n
+
+
+def test_pp_gradients_match_unsharded():
+    """The transposed pipeline (jax.grad through scan + ppermute) gives
+    the same gradients as the unsharded mean loss — stage-local layer
+    grads and the stage-0/last-stage embed/head grads alike."""
+    params = init_params(jax.random.key(0), TINY)
+    M, B, S = 4, 2, 16
+    toks = jax.random.randint(jax.random.key(2), (M, B, S), 0, TINY.vocab_size)
+    mask = jnp.ones_like(toks)
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("pp",))
+    f = _pp_loss_fn(mesh, TINY, params)
+
+    def pp_mean(p):
+        sl, n = f(p, toks, mask)
+        return sl / jnp.maximum(n, 1.0)
+
+    def ref_mean(p):
+        sl = n = 0.0
+        for m in range(M):
+            _, aux = causal_lm_loss(p, toks[m], TINY, loss_mask=mask[m])
+            sl += aux["sum_loss"]
+            n += aux["n_tokens"]
+        return sl / jnp.maximum(n, 1.0)
+
+    with jax.default_matmul_precision("highest"):
+        g_pp = jax.grad(pp_mean)(params)
+        g_ref = jax.grad(ref_mean)(params)
+    assert tree_max_diff(g_pp, g_ref) < 1e-5
+
+
+def test_pp_diloco_round_matches_unsharded():
+    """Full DiLoCo rounds (inner steps + outer sync) on (diloco=2, pp=2)
+    and (diloco=2, pp=2, tp=2) meshes must agree with the unsharded run
+    — including the psum'd global-norm clip (each parameter counted
+    exactly once across stages)."""
+    cfg = DilocoConfig(num_workers=2, inner_steps=2, warmup_steps=1,
+                       total_steps=10, lr=1e-3, grad_accum=4)
+    tok = jax.random.randint(jax.random.key(7), (2, 4, 2, 16), 0, TINY.vocab_size)
+    mask = jnp.ones_like(tok)
+
+    results = []
+    with jax.default_matmul_precision("highest"):
+        for mc in [MeshConfig(diloco=2, pp=2),
+                   MeshConfig(diloco=2, pp=2, tp=2),
+                   MeshConfig()]:
+            dl = Diloco(TINY, cfg, build_mesh(mc))
+            state = dl.init_state(jax.random.key(0))
+            for _ in range(2):
+                state, loss = dl.inner_step(state, tok, mask)
+            state = dl.outer_step(state)
+            results.append(
+                (jax.tree.map(np.asarray, state.snapshot), np.asarray(loss))
+            )
+    (snap_a, loss_a), (snap_b, loss_b), (snap_c, loss_c) = results
+    np.testing.assert_allclose(loss_a, loss_c, rtol=1e-4)
+    np.testing.assert_allclose(loss_b, loss_c, rtol=1e-4)
+    assert tree_max_diff(snap_a, snap_c) < 1e-4
+    assert tree_max_diff(snap_b, snap_c) < 1e-4
+    # the pp runs really sharded the layer axis
+    dl = Diloco(TINY, cfg, build_mesh(MeshConfig(diloco=2, pp=2)))
+    state = dl.init_state(jax.random.key(0))
+    assert "pp" in str(state.params["layers"]["wq"].sharding.spec)
+
+
+def test_pp_validation():
+    mesh = build_mesh(MeshConfig(diloco=2, pp=2))
+    with pytest.raises(ValueError, match="divide evenly"):
+        Diloco(
+            LlamaConfig(**{**TINY.to_dict(), "num_hidden_layers": 3}),
+            DilocoConfig(num_workers=2), mesh,
+        )
+    with pytest.raises(ValueError, match="dense or flash"):
+        Diloco(
+            LlamaConfig(**{**TINY.to_dict(), "attention_impl": "ring"}),
+            DilocoConfig(num_workers=2), mesh,
+        )
+    with pytest.raises(ValueError, match="custom loss_fn"):
+        Diloco(TINY, DilocoConfig(num_workers=2), mesh,
+               loss_fn=lambda p, t, m: (jnp.zeros(()), {}))
+
+
+def test_pp_cli_flag():
+    from nanodiloco_tpu.cli import build_parser, config_from_args
+
+    args = build_parser().parse_args(["--pp", "2", "--num-workers", "2"])
+    cfg = config_from_args(args)
+    assert cfg.pp == 2
+
+
+def test_pp_rejects_streaming():
+    from nanodiloco_tpu.parallel import StreamingConfig, StreamingDiloco
+
+    mesh = build_mesh(MeshConfig(diloco=2, pp=2))
+    with pytest.raises(ValueError, match="partition the layer axis"):
+        StreamingDiloco(TINY, DilocoConfig(num_workers=2, inner_steps=4),
+                        mesh, StreamingConfig(num_fragments=2))
