@@ -68,6 +68,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "sharded over this axis and the grad-accumulation "
                         "microbatches stream through GPipe-style "
                         "(incompatible with --sp and streaming)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel shards for MoE models "
+                        "(--num-experts via the model config JSON); "
+                        "experts spread over this mesh axis")
     p.add_argument("--dcn-slices", type=int, default=1,
                    help="multi-slice deployment: spread the diloco axis "
                         "across this many TPU slices (outer sync over DCN)")
@@ -185,6 +189,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         tp=args.tp,
         sp=args.sp,
         pp=args.pp,
+        ep=args.ep,
         dcn_slices=args.dcn_slices,
         streaming_fragments=args.streaming_fragments,
         streaming_delay=args.streaming_delay,

@@ -38,6 +38,12 @@ class LlamaConfig:
     # 512 is the tuned TPU default (+38% step throughput on the
     # reference's hidden-128 / vocab-32000 config, bench.py).
     loss_chunk: int = 512
+    # Mixture-of-Experts MLP (models/moe.py); 0 = dense (the reference's
+    # only mode). Experts shard over the ``ep`` mesh axis.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    expert_capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -56,6 +62,11 @@ class LlamaConfig:
             raise ValueError("num_key_value_heads must be >= 1 (or None for MHA)")
         if self.num_attention_heads % self.kv_heads:
             raise ValueError("num_attention_heads must divide evenly by num_key_value_heads")
+        if self.num_experts and self.num_experts_per_tok > self.num_experts:
+            raise ValueError(
+                f"num_experts_per_tok ({self.num_experts_per_tok}) cannot "
+                f"exceed num_experts ({self.num_experts})"
+            )
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "LlamaConfig":
@@ -80,9 +91,13 @@ class LlamaConfig:
         """Exact parameter count (embedding + layers + final norm + head)."""
         d, f, v, l = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_hidden_layers
         hd, nh, nkv = self.head_dim, self.num_attention_heads, self.kv_heads
+        if self.num_experts:
+            mlp = d * self.num_experts + 3 * self.num_experts * d * f  # router + E experts
+        else:
+            mlp = 3 * d * f  # gate, up, down
         per_layer = (
             d * nh * hd + 2 * d * nkv * hd + nh * hd * d  # q, k, v, o
-            + 3 * d * f  # gate, up, down
+            + mlp
             + 2 * d      # two rmsnorm scales
         )
         head = 0 if self.tie_word_embeddings else d * v

@@ -67,10 +67,17 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
         "wv": normal(keys[2], (l, d, nkv * hd)),
         "wo": normal(keys[3], (l, nh * hd, d)),
         "mlp_norm": jnp.ones((l, d), pdt),
-        "w_gate": normal(keys[4], (l, d, f)),
-        "w_up": normal(keys[5], (l, d, f)),
-        "w_down": normal(keys[6], (l, f, d)),
     }
+    if cfg.num_experts:
+        e = cfg.num_experts
+        layers["router"] = normal(keys[9], (l, d, e))
+        layers["w_gate"] = normal(keys[4], (l, e, d, f))
+        layers["w_up"] = normal(keys[5], (l, e, d, f))
+        layers["w_down"] = normal(keys[6], (l, e, f, d))
+    else:
+        layers["w_gate"] = normal(keys[4], (l, d, f))
+        layers["w_up"] = normal(keys[5], (l, d, f))
+        layers["w_down"] = normal(keys[6], (l, f, d))
     params: Params = {
         "embed": normal(keys[7], (v, d)),
         "layers": layers,
@@ -178,7 +185,12 @@ def _attention(cfg: LlamaConfig, q, k, v, mask, axis_name: str | None):
 # Decoder
 # ---------------------------------------------------------------------------
 
-def _decoder_layer(cfg: LlamaConfig, x, layer: Params, cos, sin, mask, sp_axis):
+def _decoder_layer(
+    cfg: LlamaConfig, x, layer: Params, cos, sin, mask, sp_axis, valid=None
+):
+    """Returns (x, aux_loss) — aux is the router load-balance term for
+    MoE layers, 0.0 for dense. ``valid`` [B, S] marks real tokens so MoE
+    routing never spends expert capacity on padding."""
     b, s, d = x.shape
     nh, nkv, hd = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
     cdt = x.dtype
@@ -196,10 +208,15 @@ def _decoder_layer(cfg: LlamaConfig, x, layer: Params, cos, sin, mask, sp_axis):
     x = x + attn.reshape(b, s, nh * hd) @ layer["wo"].astype(cdt)
 
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+    if cfg.num_experts:
+        from nanodiloco_tpu.models.moe import moe_mlp
+
+        mlp_out, aux = moe_mlp(cfg, h, layer, valid=valid)
+        return x + mlp_out, aux
     gate = jax.nn.silu(h @ layer["w_gate"].astype(cdt))
     up = h @ layer["w_up"].astype(cdt)
     x = x + (gate * up) @ layer["w_down"].astype(cdt)
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
 def forward(
@@ -210,10 +227,13 @@ def forward(
     sp_axis: str | None = None,
     position_offset: int | jax.Array = 0,
     return_hidden: bool = False,
+    with_aux: bool = False,
 ) -> jax.Array:
     """tokens [B, S] int32 -> logits [B, S, vocab] float32 (or the final
     normed hidden states [B, S, d] in compute dtype if ``return_hidden`` —
-    the blockwise-loss path applies the vocabulary head itself).
+    the blockwise-loss path applies the vocabulary head itself). With
+    ``with_aux`` returns ``(out, aux)`` where aux is the summed router
+    load-balance loss over MoE layers (0.0 for dense models).
 
     ``attn_mask`` is an optional [B, S] 0/1 validity mask (1 = real token);
     it is combined with causal masking. ``sp_axis`` names the mesh axis the
@@ -234,24 +254,26 @@ def forward(
 
     # Bind all non-array arguments (cfg, sp_axis) BEFORE jax.checkpoint so
     # only JAX types flow through the remat boundary.
-    def layer_fn(x, layer, cos, sin, mask):
-        return _decoder_layer(cfg, x, layer, cos, sin, mask, sp_axis)
+    def layer_fn(x, layer, cos, sin, mask, valid):
+        return _decoder_layer(cfg, x, layer, cos, sin, mask, sp_axis, valid)
 
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
 
     def scan_body(carry, layer):
-        return layer_fn(carry, layer, cos, sin, mask), None
+        x, aux = layer_fn(carry, layer, cos, sin, mask, attn_mask)
+        return x, aux
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x, auxes = jax.lax.scan(scan_body, x, params["layers"])
+    aux = jnp.sum(auxes)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     if return_hidden:
-        return x
+        return (x, aux) if with_aux else x
     head = params.get("lm_head", None)
     if head is None:
         head = params["embed"].T
-    logits = x @ head.astype(cdt)
-    return logits.astype(jnp.float32)
+    logits = (x @ head.astype(cdt)).astype(jnp.float32)
+    return (logits, aux) if with_aux else logits
 
 
 # ---------------------------------------------------------------------------
@@ -277,9 +299,9 @@ def causal_lm_loss(
     if cfg.loss_chunk:
         from nanodiloco_tpu.ops.fused_ce import chunked_softmax_xent
 
-        h = forward(
+        h, aux = forward(
             params, tokens, cfg, attn_mask=loss_mask, sp_axis=sp_axis,
-            return_hidden=True,
+            return_hidden=True, with_aux=True,
         )
         b, s, d = h.shape
         head = params.get("lm_head", None)
@@ -297,9 +319,14 @@ def causal_lm_loss(
             chunk=cfg.loss_chunk,
         )
         n = jnp.maximum(n_tok, 1.0)
-        return sum_loss / n, {"n_tokens": n_tok, "sum_loss": sum_loss}
+        loss = sum_loss / n + cfg.router_aux_coef * aux
+        return loss, {
+            "n_tokens": n_tok, "sum_loss": sum_loss, "router_aux": aux,
+        }
 
-    logits = forward(params, tokens, cfg, attn_mask=loss_mask, sp_axis=sp_axis)
+    logits, aux = forward(
+        params, tokens, cfg, attn_mask=loss_mask, sp_axis=sp_axis, with_aux=True
+    )
     logits = logits[:, :-1]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]  # [B, S-1]
@@ -309,7 +336,10 @@ def causal_lm_loss(
         m = jnp.ones_like(nll)
     sum_loss = jnp.sum(nll * m)
     n = jnp.maximum(jnp.sum(m), 1.0)
-    return sum_loss / n, {"n_tokens": jnp.sum(m), "sum_loss": sum_loss}
+    loss = sum_loss / n + cfg.router_aux_coef * aux
+    return loss, {
+        "n_tokens": jnp.sum(m), "sum_loss": sum_loss, "router_aux": aux,
+    }
 
 
 def causal_lm_loss_sp(
@@ -373,6 +403,11 @@ def sp_shard_loss(
         raise ValueError(
             "sequence-parallel loss requires attention_impl='ring'; "
             f"got {cfg.attention_impl!r}"
+        )
+    if cfg.num_experts:
+        raise ValueError(
+            "MoE is not supported under sequence parallelism (yet): the "
+            "router aux loss is not plumbed through the sp shard loss"
         )
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)

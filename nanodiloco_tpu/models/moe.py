@@ -1,0 +1,97 @@
+"""Mixture-of-Experts MLP with expert parallelism over the ``ep`` axis.
+
+The reference is dense-Llama-only (SURVEY §2: "Expert parallelism
+(EP / MoE): NO"); this is a TPU-native capability add in the classic
+Mesh-TF / Switch-Transformer shape:
+
+- **Dense dispatch, static shapes.** Routing is expressed as einsums
+  against one-hot dispatch/combine tensors ``[T, E, C]`` (tokens ×
+  experts × capacity) — no data-dependent gathers, no dynamic shapes,
+  exactly what XLA tiles well. Tokens beyond an expert's capacity
+  ``C = ceil(k·T/E · capacity_factor)`` are dropped (their combine
+  weight is zero, so the residual path carries them through).
+- **Experts are a sharding.** Expert weights are stacked on a leading
+  ``[E, ...]`` axis with PartitionSpec ``P('ep', ...)``; the dispatch /
+  expert-FFN / combine einsums contract over sharded axes and GSPMD
+  inserts the all-to-alls. No manual collectives here.
+- **Router in float32** with the Switch load-balance auxiliary loss
+  ``E · Σ_e f_e · P_e`` (fraction of tokens routed to e × mean router
+  probability of e), scaled by ``router_aux_coef`` in the LM loss.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from nanodiloco_tpu.models.config import LlamaConfig
+
+
+import math
+
+
+def expert_capacity(cfg: LlamaConfig, n_tokens: int) -> int:
+    """Static per-expert token capacity, ceil(k*T/E * capacity_factor)."""
+    k, e = cfg.num_experts_per_tok, cfg.num_experts
+    return max(1, math.ceil(n_tokens * k / e * cfg.expert_capacity_factor))
+
+
+def moe_mlp(
+    cfg: LlamaConfig, h: jax.Array, layer: dict, valid: jax.Array | None = None
+) -> tuple[jax.Array, jax.Array]:
+    """h: [B, S, d] normed hidden states; layer carries ``router``
+    [d, E] and expert FFN weights ``w_gate``/``w_up`` [E, d, f],
+    ``w_down`` [E, f, d]; ``valid`` [B, S] 0/1 marks real tokens —
+    padding claims no expert capacity and is excluded from the aux-loss
+    statistics. Returns (mlp_out [B, S, d], aux_loss scalar)."""
+    b, s, d = h.shape
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    cdt = h.dtype
+    x = h.reshape(b * s, d)
+    t = b * s
+    cap = expert_capacity(cfg, t)
+
+    logits = (x @ layer["router"].astype(cdt)).astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topk_p, topk_e = jax.lax.top_k(probs, k)                        # [T, k]
+    topk_p = topk_p / jnp.sum(topk_p, axis=-1, keepdims=True)
+
+    # per-(token, slot) position in the chosen expert's queue: a cumsum
+    # over tokens of that expert's one-hots, k slots interleaved in
+    # priority order (slot 0 claims capacity first)
+    onehot = jax.nn.one_hot(topk_e, e, dtype=jnp.float32)           # [T, k, E]
+    if valid is not None:
+        # pad tokens route nowhere: no capacity consumed, zero output
+        # (the residual stream carries them), no aux-statistics weight
+        onehot = onehot * valid.reshape(t).astype(jnp.float32)[:, None, None]
+    slot_major = jnp.swapaxes(onehot, 0, 1).reshape(k * t, e)       # [k*T, E]
+    pos = jnp.cumsum(slot_major, axis=0) - slot_major               # arrival index
+    keep = (pos < cap) * slot_major                                 # [k*T, E]
+    pos = jnp.swapaxes(pos.reshape(k, t, e), 0, 1)                  # [T, k, E]
+    keep = jnp.swapaxes(keep.reshape(k, t, e), 0, 1)                # [T, k, E]
+
+    cap_onehot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+    # dispatch/combine [T, E, C]
+    dispatch = jnp.einsum("tke,tkec->tec", keep, cap_onehot)
+    combine = jnp.einsum("tke,tkec->tec", keep * topk_p[..., None], cap_onehot)
+
+    expert_in = jnp.einsum(
+        "tec,td->ecd", dispatch.astype(cdt), x
+    )                                                                # [E, C, d]
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"].astype(cdt)))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"].astype(cdt))
+    out_e = jnp.einsum("ecf,efd->ecd", gate * up, layer["w_down"].astype(cdt))
+    y = jnp.einsum("tec,ecd->td", combine.astype(cdt), out_e)
+
+    # Switch load-balance loss on the top-1 assignment (pre-capacity),
+    # statistics over REAL tokens only
+    if valid is not None:
+        v = valid.reshape(t).astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(v), 1.0)
+        f_e = jnp.sum(onehot[:, 0, :], axis=0) / denom               # [E]
+        p_e = jnp.sum(probs * v[:, None], axis=0) / denom
+    else:
+        f_e = jnp.mean(onehot[:, 0, :], axis=0)
+        p_e = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(f_e * p_e)
+    return y.reshape(b, s, d), aux

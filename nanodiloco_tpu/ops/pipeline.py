@@ -81,8 +81,15 @@ def pp_shard_loss(
     if head is None:
         head = params["embed"].T
 
+    if cfg.num_experts:
+        raise ValueError(
+            "MoE is not supported under pipeline parallelism (yet): the "
+            "router aux loss is not plumbed through the stage pipeline"
+        )
+
     def layer_fn(x, layer, cos, sin):
-        return _decoder_layer(cfg, x, layer, cos, sin, None, None)
+        out, _aux = _decoder_layer(cfg, x, layer, cos, sin, None, None)
+        return out
 
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)

@@ -118,6 +118,11 @@ class Diloco:
                 )
             if model_cfg.attention_impl == "ring":
                 raise ValueError("pp > 1 requires attention dense or flash")
+        if model_cfg.num_experts and (self.sp > 1 or self.pp > 1):
+            raise ValueError(
+                "MoE is not supported under sp or pp (yet): the router aux "
+                "loss is not plumbed through those manual-axis loss paths"
+            )
         if (
             (self.sp > 1 or self.pp > 1)
             and int(dict(mesh.shape)["diloco"]) != cfg.num_workers

@@ -14,6 +14,8 @@ library. Axis vocabulary:
 - ``fsdp``    intra-worker parameter/data sharding (ZeRO-style).
 - ``tp``      tensor parallelism over heads / MLP hidden.
 - ``sp``      sequence/context parallelism (ring attention).
+- ``ep``      expert parallelism: MoE expert weights sharded over the
+              expert axis (models/moe.py); GSPMD inserts the all-to-alls.
 
 Axis order is slowest-varying first (``diloco`` outermost), so the inner
 axes (``tp``, ``sp``) land on physically adjacent devices where the ICI
@@ -31,7 +33,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXES = ("diloco", "pp", "fsdp", "tp", "sp")
+AXES = ("diloco", "pp", "fsdp", "ep", "tp", "sp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,10 +43,11 @@ class MeshConfig:
     tp: int = 1
     sp: int = 1
     pp: int = 1
+    ep: int = 1
 
     @property
     def shape(self) -> tuple[int, ...]:
-        return (self.diloco, self.pp, self.fsdp, self.tp, self.sp)
+        return (self.diloco, self.pp, self.fsdp, self.ep, self.tp, self.sp)
 
     @property
     def num_devices(self) -> int:
@@ -101,7 +104,9 @@ def build_hybrid_mesh(
     if n > len(devices):
         raise ValueError(f"mesh needs {n} devices, only {len(devices)} available")
     devices = devices[:n]
-    per_slice = (cfg.diloco // num_slices, cfg.pp, cfg.fsdp, cfg.tp, cfg.sp)
+    per_slice = (
+        cfg.diloco // num_slices, cfg.pp, cfg.fsdp, cfg.ep, cfg.tp, cfg.sp
+    )
     # Only degrade to the plain mesh when this is demonstrably NOT a
     # multi-slice deployment (virtual/CPU devices have no slice_index).
     # On real multi-slice hardware errors must propagate — a silent
@@ -110,7 +115,7 @@ def build_hybrid_mesh(
     if getattr(devices[0], "slice_index", None) is None:
         return build_mesh(cfg, devices)
     dev_array = mesh_utils.create_hybrid_device_mesh(
-        per_slice, (num_slices, 1, 1, 1, 1), devices=devices
+        per_slice, (num_slices, 1, 1, 1, 1, 1), devices=devices
     )
     return Mesh(dev_array, AXES)
 

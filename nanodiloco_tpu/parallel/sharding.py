@@ -34,6 +34,20 @@ def param_specs(
     With ``pp`` the stacked LAYER axis shards over the pipeline stages
     (ops/pipeline.py) — embed/head/norms stay replicated across pp."""
     lax0 = "pp" if pp else None  # the leading (layer) axis of layer leaves
+    if cfg.num_experts:
+        # MoE: expert axis over ep; per-expert FFN dims over fsdp/tp
+        mlp_specs = {
+            "router": P(lax0, None, None),
+            "w_gate": P(lax0, "ep", "fsdp", "tp"),
+            "w_up": P(lax0, "ep", "fsdp", "tp"),
+            "w_down": P(lax0, "ep", "tp", "fsdp"),
+        }
+    else:
+        mlp_specs = {
+            "w_gate": P(lax0, "fsdp", "tp"),
+            "w_up": P(lax0, "fsdp", "tp"),
+            "w_down": P(lax0, "tp", "fsdp"),
+        }
     specs = {
         # vocab axis deliberately NOT sharded: a token gather from a
         # vocab-sharded table forces XLA into full rematerialization
@@ -48,9 +62,7 @@ def param_specs(
             "wv": P(lax0, "fsdp", "tp"),
             "wo": P(lax0, "tp", "fsdp"),
             "mlp_norm": P(lax0, None),
-            "w_gate": P(lax0, "fsdp", "tp"),
-            "w_up": P(lax0, "fsdp", "tp"),
-            "w_down": P(lax0, "tp", "fsdp"),
+            **mlp_specs,
         },
     }
     if not cfg.tie_word_embeddings:

@@ -69,6 +69,7 @@ class TrainConfig:
     tp: int = 1
     sp: int = 1   # sequence-parallel shards (ring attention long-context path)
     pp: int = 1   # pipeline stages (layer stack sharded, GPipe microbatching)
+    ep: int = 1   # expert-parallel shards (MoE experts, models/moe.py)
     dcn_slices: int = 1  # multi-slice: diloco axis spans slices over DCN
     # dispatch whole DiLoCo rounds (H inner steps + sync) as ONE fused
     # executable — no host round-trips between steps (~8% faster end to
@@ -158,8 +159,17 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             )
     if cfg.eval_every and cfg.eval_batches < 1:
         raise ValueError("--eval-every requires --eval-batches >= 1")
+    if cfg.ep > 1:
+        if not cfg.model.num_experts:
+            raise ValueError("--ep > 1 requires an MoE model (num_experts > 0)")
+        if cfg.model.num_experts % cfg.ep:
+            raise ValueError(
+                f"num_experts {cfg.model.num_experts} must divide evenly "
+                f"over --ep {cfg.ep}"
+            )
     mesh_cfg = MeshConfig(
-        diloco=cfg.num_workers, fsdp=cfg.fsdp, tp=cfg.tp, sp=cfg.sp, pp=cfg.pp
+        diloco=cfg.num_workers, fsdp=cfg.fsdp, tp=cfg.tp, sp=cfg.sp,
+        pp=cfg.pp, ep=cfg.ep,
     )
     if cfg.dcn_slices > 1:
         from nanodiloco_tpu.parallel.mesh import build_hybrid_mesh

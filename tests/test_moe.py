@@ -1,0 +1,178 @@
+"""Mixture-of-Experts (models/moe.py) + expert parallelism over ``ep``.
+
+The reference is dense-only (SURVEY §2: "Expert parallelism (EP / MoE):
+NO"); correctness contracts here: a single ample-capacity expert reduces
+exactly to the dense MLP, routing respects capacity, the Switch aux loss
+is sane, and ep-sharded training matches unsharded.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanodiloco_tpu.models import LlamaConfig, causal_lm_loss, forward, init_params
+from nanodiloco_tpu.parallel import Diloco, DilocoConfig, MeshConfig, build_mesh
+
+MOE = LlamaConfig(
+    vocab_size=96, hidden_size=32, intermediate_size=64,
+    num_attention_heads=4, num_hidden_layers=2, max_position_embeddings=32,
+    loss_chunk=16, num_experts=4, num_experts_per_tok=2,
+)
+
+
+def tree_max_diff(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return max(float(jnp.max(jnp.abs(x - y))) for x, y in zip(la, lb))
+
+
+def test_moe_forward_shapes_and_params():
+    params = init_params(jax.random.key(0), MOE)
+    assert params["layers"]["w_gate"].shape == (2, 4, 32, 64)
+    assert params["layers"]["router"].shape == (2, 32, 4)
+    n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    assert n == MOE.num_params()
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 96)
+    logits, aux = forward(params, tokens, MOE, with_aux=True)
+    assert logits.shape == (2, 16, 96)
+    assert np.isfinite(np.asarray(logits)).all()
+    # near-uniform router at init: Switch aux close to its balanced value 1
+    assert 0.5 < float(aux) / MOE.num_hidden_layers < 2.0
+
+
+def test_single_ample_expert_equals_dense_mlp():
+    """E=1, k=1, capacity >= tokens: the MoE layer must reproduce the
+    dense SwiGLU MLP exactly (combine weight 1 for every token)."""
+    moe_cfg = LlamaConfig(**{
+        **MOE.to_dict(), "num_experts": 1, "num_experts_per_tok": 1,
+        "expert_capacity_factor": 1.0,
+    })
+    dense_cfg = LlamaConfig(**{**MOE.to_dict(), "num_experts": 0})
+    mp = init_params(jax.random.key(0), moe_cfg)
+    dp = init_params(jax.random.key(0), dense_cfg)
+    # graft the single expert's FFN into the dense weights
+    dp["layers"]["w_gate"] = mp["layers"]["w_gate"][:, 0]
+    dp["layers"]["w_up"] = mp["layers"]["w_up"][:, 0]
+    dp["layers"]["w_down"] = mp["layers"]["w_down"][:, 0]
+    for k in ("embed", "final_norm", "lm_head"):
+        dp[k] = mp[k]
+    for k in ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm"):
+        dp["layers"][k] = mp["layers"][k]
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 96)
+    with jax.default_matmul_precision("highest"):
+        out_moe = forward(mp, tokens, moe_cfg)
+        out_dense = forward(dp, tokens, dense_cfg)
+    np.testing.assert_allclose(
+        np.asarray(out_moe), np.asarray(out_dense), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_capacity_drops_tokens_but_stays_finite():
+    """A brutally small capacity factor drops most tokens; the residual
+    stream carries them and nothing NaNs (loss + grads finite)."""
+    cfg = LlamaConfig(**{**MOE.to_dict(), "expert_capacity_factor": 0.1})
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 96)
+    loss, aux = causal_lm_loss(params, tokens, cfg)
+    assert np.isfinite(float(loss))
+    g = jax.grad(lambda p: causal_lm_loss(p, tokens, cfg)[0])(params)
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(g))
+    # the router gets gradient signal (aux loss + combine weights)
+    assert float(jnp.max(jnp.abs(g["layers"]["router"]))) > 0
+
+
+def test_loss_includes_router_aux():
+    params = init_params(jax.random.key(0), MOE)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 96)
+    loss, aux = causal_lm_loss(params, tokens, MOE)
+    ce = float(aux["sum_loss"]) / float(aux["n_tokens"])
+    np.testing.assert_allclose(
+        float(loss), ce + MOE.router_aux_coef * float(aux["router_aux"]),
+        rtol=1e-6,
+    )
+
+
+def test_ep_sharded_round_matches_unsharded():
+    """Full DiLoCo round on a (diloco=2, ep=2) mesh == unsharded — the
+    expert all-to-alls are a layout choice, not math."""
+    cfg = DilocoConfig(num_workers=2, inner_steps=2, warmup_steps=1,
+                       total_steps=10, lr=1e-3, grad_accum=2)
+    tok = jax.random.randint(jax.random.key(7), (2, 2, 2, 16), 0, 96)
+    mask = jnp.ones_like(tok)
+    results = []
+    with jax.default_matmul_precision("highest"):
+        for mc in [MeshConfig(diloco=2, ep=2), MeshConfig()]:
+            dl = Diloco(MOE, cfg, build_mesh(mc))
+            state = dl.init_state(jax.random.key(0))
+            for _ in range(2):
+                state, loss = dl.inner_step(state, tok, mask)
+            state = dl.outer_step(state)
+            results.append(
+                (jax.tree.map(np.asarray, state.snapshot), np.asarray(loss))
+            )
+    (snap_a, loss_a), (snap_b, loss_b) = results
+    np.testing.assert_allclose(loss_a, loss_b, rtol=1e-4)
+    assert tree_max_diff(snap_a, snap_b) < 1e-4
+
+
+def test_moe_config_json_loads():
+    path = os.path.join(os.path.dirname(__file__), "..", "configs", "llama_moe.json")
+    cfg = LlamaConfig.from_dict(json.load(open(path)))
+    assert cfg.num_experts == 8 and cfg.num_experts_per_tok == 2
+
+
+def test_moe_rejected_under_sp_and_pp():
+    with pytest.raises(ValueError, match="MoE is not supported"):
+        Diloco(
+            LlamaConfig(**{**MOE.to_dict(), "attention_impl": "ring"}),
+            DilocoConfig(num_workers=2),
+            build_mesh(MeshConfig(diloco=2, sp=2)),
+        )
+    with pytest.raises(ValueError, match="MoE is not supported"):
+        Diloco(MOE, DilocoConfig(num_workers=2),
+               build_mesh(MeshConfig(diloco=2, pp=2)))
+
+
+def test_ep_cli_validation():
+    from nanodiloco_tpu.cli import build_parser, config_from_args
+    from nanodiloco_tpu.training.train_loop import train
+
+    args = build_parser().parse_args(["--ep", "2"])
+    with pytest.raises(ValueError, match="requires an MoE model"):
+        train(config_from_args(args))
+
+
+def test_padding_claims_no_expert_capacity():
+    """Pad tokens must be invisible to MoE: they route nowhere, consume
+    no expert capacity, and contribute nothing to the aux statistics —
+    so two batches differing ONLY in pad content give identical losses.
+    (Pre-fix, pads claimed queue slots first-come-first-served and
+    changed which real tokens got dropped.)"""
+    cfg = LlamaConfig(**{**MOE.to_dict(), "expert_capacity_factor": 0.6,
+                         "num_experts_per_tok": 1, "num_experts": 2})
+    params = init_params(jax.random.key(0), cfg)
+    real = jax.random.randint(jax.random.key(1), (1, 16), 1, 96)
+    garbage = jax.random.randint(jax.random.key(2), (1, 16), 1, 96)
+    batch_a = jnp.concatenate([real, jnp.zeros((1, 16), jnp.int32)], axis=0)
+    batch_b = jnp.concatenate([real, garbage], axis=0)
+    mask = jnp.concatenate(
+        [jnp.ones((1, 16), jnp.int32), jnp.zeros((1, 16), jnp.int32)], axis=0
+    )
+    with jax.default_matmul_precision("highest"):
+        loss_a, aux_a = causal_lm_loss(params, batch_a, cfg, loss_mask=mask)
+        loss_b, aux_b = causal_lm_loss(params, batch_b, cfg, loss_mask=mask)
+    assert float(aux_a["n_tokens"]) == float(aux_b["n_tokens"]) == 15.0
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+    np.testing.assert_allclose(
+        float(aux_a["router_aux"]), float(aux_b["router_aux"]), rtol=1e-6
+    )
+
+
+def test_k_exceeding_experts_rejected():
+    with pytest.raises(ValueError, match="cannot exceed num_experts"):
+        LlamaConfig(**{**MOE.to_dict(), "num_experts": 1,
+                       "num_experts_per_tok": 2})
