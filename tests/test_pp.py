@@ -69,6 +69,9 @@ def test_pp_loss_matches_unsharded(stages):
     assert float(n) == ref_n
 
 
+@pytest.mark.slow  # ~24 s of (uncacheable) tracing; the same transposed-
+# pipeline grad path trains end-to-end in test_pp_diloco_round_matches_
+# unsharded below (run all: pytest -m "")
 def test_pp_gradients_match_unsharded():
     """The transposed pipeline (jax.grad through scan + ppermute) gives
     the same gradients as the unsharded mean loss — stage-local layer
