@@ -406,8 +406,9 @@ def sp_shard_loss(
         )
     if cfg.num_experts:
         raise ValueError(
-            "MoE is not supported under sequence parallelism (yet): the "
-            "router aux loss is not plumbed through the sp shard loss"
+            "MoE is not supported under sequence parallelism: per-shard "
+            "routing/capacity would not match the unsharded semantics "
+            "(pp and ep compose with MoE; sp does not, yet)"
         )
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)

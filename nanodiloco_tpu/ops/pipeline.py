@@ -63,10 +63,17 @@ def pp_shard_loss(
     cfg: LlamaConfig,
     loss_mask_mb: jax.Array,  # [M, B, S]
     axis_name: str = "pp",
-) -> tuple[jax.Array, jax.Array]:
-    """Per-stage UNREDUCED (sum_loss, n_tokens): only the final stage
-    contributes nonzero values — callers ``psum`` both over ``axis_name``
-    (and psum the replicated embed/head/norm grads).
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-stage UNREDUCED (sum_loss, n_tokens, aux_weighted,
+    metric_sum): callers ``psum`` all four over ``axis_name`` (and psum
+    the replicated embed/head/norm grads). ``aux_weighted`` is the MoE
+    router load-balance loss of this stage's layers, summed over
+    microbatches weighted by each microbatch's token count — psummed it
+    equals ``sum_m n_m * aux_m`` exactly as the unsharded
+    grad-accumulation path weights its gradients (zero for dense
+    models). ``metric_sum`` psummed is ``sum_m (ce_mean_m + coef*aux_m)``
+    — divide by M for the same mean-of-microbatch-means loss METRIC the
+    vmap path reports.
 
     ``params`` is this stage's view: ``layers`` leaves are the local
     ``[L/P, ...]`` slice; ``embed``/``final_norm``/``lm_head`` are the
@@ -81,25 +88,24 @@ def pp_shard_loss(
     if head is None:
         head = params["embed"].T
 
-    if cfg.num_experts:
-        raise ValueError(
-            "MoE is not supported under pipeline parallelism (yet): the "
-            "router aux loss is not plumbed through the stage pipeline"
-        )
-
-    def layer_fn(x, layer, cos, sin):
-        out, _aux = _decoder_layer(cfg, x, layer, cos, sin, None, None)
-        return out
+    def layer_fn(x, layer, cos, sin, valid):
+        return _decoder_layer(cfg, x, layer, cos, sin, None, None, valid)
 
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
 
-    def run_stage(x):
-        def body(carry, layer):
-            return layer_fn(carry, layer, cos, sin), None
+    def run_stage(x, valid):
+        """Local layers on [B, S, d] -> (x, summed router aux).
+        ``valid`` [B, S] is the processed microbatch's pad mask — MoE
+        routing must never spend expert capacity on padding (same
+        contract as the unsharded path)."""
 
-        x, _ = lax.scan(body, x, params["layers"])
-        return x
+        def body(carry, layer):
+            x, aux = layer_fn(carry, layer, cos, sin, valid)
+            return x, aux
+
+        x, auxes = lax.scan(body, x, params["layers"])
+        return x, jnp.sum(auxes)
 
     def mb_loss(y, t):
         """Loss of the microbatch leaving the pipe at tick t (valid only
@@ -116,15 +122,27 @@ def pp_shard_loss(
             cfg.loss_chunk,
         )
 
+    # per-microbatch token counts (the loss-shift weights), for aux
+    # weighting identical to the vmap grad-accumulation path
+    n_per_mb = jnp.sum(loss_mask_mb[:, :, 1:].astype(jnp.float32), axis=(1, 2))
+
+    coef = cfg.router_aux_coef
+
     def tick(carry, t):
-        buf, sum_loss, n_tok = carry
+        buf, sum_loss, n_tok, aux_w, metric = carry
         # stage 0 ingests microbatch t (clamped; drained ticks recompute
         # the last microbatch and their outputs are never used)
         m_in = jnp.clip(t, 0, M - 1)
         tok_in = lax.dynamic_index_in_dim(tokens_mb, m_in, 0, keepdims=False)
         x0 = params["embed"].astype(cdt)[tok_in]
         x = jnp.where(p_idx == 0, x0, buf)
-        y = run_stage(x)
+        # this stage processes microbatch t - p_idx at tick t; its pad
+        # mask rides along so MoE routing stays padding-blind
+        m_here = t - p_idx
+        valid_mb = lax.dynamic_index_in_dim(
+            loss_mask_mb, jnp.clip(m_here, 0, M - 1), 0, keepdims=False
+        )
+        y, stage_aux = run_stage(x, valid_mb)
         # straight-line masking, no lax.cond: per-stage divergent control
         # flow around code whose transpose touches collectives deadlocks
         # the backward (devices reach collectives in different orders),
@@ -136,9 +154,20 @@ def pp_shard_loss(
         ).astype(jnp.float32)
         sl, n = mb_loss(y, t)
         sl, n = valid * sl, valid * n
+        pass_valid = ((m_here >= 0) & (m_here < M)).astype(jnp.float32)
+        n_here = n_per_mb[jnp.clip(m_here, 0, M - 1)]
+        aux_w = aux_w + pass_valid * n_here * stage_aux
+        # metric accumulators mirror the vmap path's mean-of-means
+        # convention: per-microbatch ce mean (last stage) + unweighted
+        # aux (every stage's layers)
+        metric = (
+            metric
+            + valid * sl / jnp.maximum(n, 1.0)
+            + coef * pass_valid * stage_aux
+        )
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         buf = lax.ppermute(y, axis_name, perm)
-        return (buf, sum_loss + sl, n_tok + n), None
+        return (buf, sum_loss + sl, n_tok + n, aux_w, metric), None
 
     # carries start typed as varying over the pp axis (their updates
     # are); data-derived zeros carry any other manual axes' vary-ness
@@ -150,7 +179,7 @@ def pp_shard_loss(
         to="varying",
     )
     T = M + n_stages - 1
-    (_, sum_loss, n_tok), _ = lax.scan(
-        tick, (buf0, z, z), jnp.arange(T, dtype=jnp.int32)
+    (_, sum_loss, n_tok, aux_w, metric), _ = lax.scan(
+        tick, (buf0, z, z, z, z), jnp.arange(T, dtype=jnp.int32)
     )
-    return sum_loss, n_tok
+    return sum_loss, n_tok, aux_w, metric

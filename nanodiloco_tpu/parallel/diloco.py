@@ -118,10 +118,11 @@ class Diloco:
                 )
             if model_cfg.attention_impl == "ring":
                 raise ValueError("pp > 1 requires attention dense or flash")
-        if model_cfg.num_experts and (self.sp > 1 or self.pp > 1):
+        if model_cfg.num_experts and self.sp > 1:
             raise ValueError(
-                "MoE is not supported under sp or pp (yet): the router aux "
-                "loss is not plumbed through those manual-axis loss paths"
+                "MoE is not supported under sequence parallelism: per-shard "
+                "routing/capacity would not match the unsharded semantics "
+                "(pp and ep compose with MoE; sp does not, yet)"
             )
         if (
             (self.sp > 1 or self.pp > 1)
@@ -436,13 +437,23 @@ class Diloco:
             opt_state = jax.tree.map(lambda x: x[0], opt_w)
             w_tokens, w_mask = tok_w[0], mask_w[0]  # [accum(M), B, S]
 
+            coef = self.model_cfg.router_aux_coef
+            accum = w_tokens.shape[0]
+
             def sum_loss_fn(p):
-                sl, n = pp_shard_loss(p, w_tokens, self.model_cfg, w_mask, "pp")
+                sl, n, aux_w, metric = pp_shard_loss(
+                    p, w_tokens, self.model_cfg, w_mask, "pp"
+                )
                 sl = jax.lax.psum(sl, "pp")
                 n = jax.lax.psum(n, "pp")
-                return sl, n
+                # token-weighted router aux, exactly as the vmap grad-
+                # accumulation path weights it (zero for dense models)
+                aux_w = jax.lax.psum(aux_w, "pp")
+                # mean-of-microbatch-means metric == the vmap path's
+                metric = jax.lax.psum(metric, "pp") / accum
+                return sl + coef * aux_w, (n, metric)
 
-            (sl, n), g = jax.value_and_grad(sum_loss_fn, has_aux=True)(params)
+            (_sl, (n, metric)), g = jax.value_and_grad(sum_loss_fn, has_aux=True)(params)
             # replicated leaves: every stage holds a copy, only one
             # computed a nonzero grad — combine so the copies stay equal
             g = {
@@ -470,7 +481,7 @@ class Diloco:
                 )
             updates, opt_state = self.inner_tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            loss = sl / jnp.maximum(n, 1e-9)
+            loss = metric
             return (
                 jax.tree.map(lambda x: x[None], params),
                 jax.tree.map(lambda x: x[None], opt_state),

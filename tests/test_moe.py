@@ -125,16 +125,38 @@ def test_moe_config_json_loads():
     assert cfg.num_experts == 8 and cfg.num_experts_per_tok == 2
 
 
-def test_moe_rejected_under_sp_and_pp():
+def test_moe_rejected_under_sp():
     with pytest.raises(ValueError, match="MoE is not supported"):
         Diloco(
             LlamaConfig(**{**MOE.to_dict(), "attention_impl": "ring"}),
             DilocoConfig(num_workers=2),
             build_mesh(MeshConfig(diloco=2, sp=2)),
         )
-    with pytest.raises(ValueError, match="MoE is not supported"):
-        Diloco(MOE, DilocoConfig(num_workers=2),
-               build_mesh(MeshConfig(diloco=2, pp=2)))
+
+
+def test_moe_pp_round_matches_unsharded():
+    """MoE composes with pipeline (and expert) parallelism: a full
+    DiLoCo round on (diloco=2, pp=2, ep=2) with the router aux loss
+    streamed through the stage pipeline must match unsharded — INCLUDING
+    pad masking (routing must stay padding-blind inside the pipeline)."""
+    cfg = DilocoConfig(num_workers=2, inner_steps=2, warmup_steps=1,
+                       total_steps=10, lr=1e-3, grad_accum=4)
+    tok = jax.random.randint(jax.random.key(7), (2, 4, 2, 16), 0, 96)
+    mask = jnp.ones_like(tok).at[:, 0, :, 12:].set(0)  # padded tails
+    results = []
+    with jax.default_matmul_precision("highest"):
+        for mc in [MeshConfig(diloco=2, pp=2, ep=2), MeshConfig()]:
+            dl = Diloco(MOE, cfg, build_mesh(mc))
+            state = dl.init_state(jax.random.key(0))
+            for _ in range(2):
+                state, loss = dl.inner_step(state, tok, mask)
+            state = dl.outer_step(state)
+            results.append(
+                (jax.tree.map(np.asarray, state.snapshot), np.asarray(loss))
+            )
+    (snap_a, loss_a), (snap_b, loss_b) = results
+    np.testing.assert_allclose(loss_a, loss_b, rtol=1e-4)
+    assert tree_max_diff(snap_a, snap_b) < 1e-4
 
 
 def test_ep_cli_validation():

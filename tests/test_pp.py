@@ -37,12 +37,13 @@ def _pp_loss_fn(mesh, cfg, params):
     }
 
     def shard_fn(params, toks, mask):
-        sl, n = pp_shard_loss(params, toks, cfg, mask, "pp")
-        return jax.lax.psum(sl, "pp"), jax.lax.psum(n, "pp")
+        sl, n, aux_w, _metric = pp_shard_loss(params, toks, cfg, mask, "pp")
+        return (jax.lax.psum(sl, "pp"), jax.lax.psum(n, "pp"),
+                jax.lax.psum(aux_w, "pp"))
 
     return jax.shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(pspec, P(), P()), out_specs=(P(), P()),
+        in_specs=(pspec, P(), P()), out_specs=(P(), P(), P()),
         axis_names={"pp"},
     )
 
@@ -59,7 +60,7 @@ def test_pp_loss_matches_unsharded(stages):
     f = _pp_loss_fn(mesh, TINY, params)
 
     with jax.default_matmul_precision("highest"):
-        sl, n = jax.jit(f)(params, toks, mask)
+        sl, n, _aux = jax.jit(f)(params, toks, mask)
         ref_sl = ref_n = 0.0
         for m in range(M):
             _, aux = causal_lm_loss(params, toks[m], TINY, loss_mask=mask[m])
@@ -84,7 +85,7 @@ def test_pp_gradients_match_unsharded():
     f = _pp_loss_fn(mesh, TINY, params)
 
     def pp_mean(p):
-        sl, n = f(p, toks, mask)
+        sl, n, _ = f(p, toks, mask)
         return sl / jnp.maximum(n, 1.0)
 
     def ref_mean(p):
