@@ -168,3 +168,35 @@ def test_pp_rejects_streaming():
     with pytest.raises(ValueError, match="partition the layer axis"):
         StreamingDiloco(TINY, DilocoConfig(num_workers=2, inner_steps=4),
                         mesh, StreamingConfig(num_fragments=2))
+
+
+def test_pp_through_driver_with_eval_and_resume(tmp_path):
+    """The full train() driver on a pp mesh: fused rounds, snapshot
+    evaluation (auto-sharded over the pp-sharded params), checkpointing,
+    and bit-exact resume."""
+    from nanodiloco_tpu.training.train_loop import TrainConfig, train
+
+    model = LlamaConfig(
+        vocab_size=384, hidden_size=32, intermediate_size=64,
+        num_attention_heads=4, num_hidden_layers=2, max_position_embeddings=64,
+    )
+    def cfg(path, **kw):
+        d = dict(
+            seed=1337, batch_size=8, per_device_batch_size=2, seq_length=32,
+            warmup_steps=2, total_steps=6, inner_steps=3, lr=1e-3,
+            num_workers=2, pp=2, model=model,
+            log_dir=str(path / "runs"), quiet=True, measure_comm=False,
+            eval_every=1, eval_batches=2,
+        )
+        d.update(kw)
+        return TrainConfig(**d)
+
+    full = train(cfg(tmp_path / "a"))
+    assert np.isfinite(full["final_loss"]) and np.isfinite(full["eval_loss"])
+    train(cfg(tmp_path / "b", total_steps=3,
+              checkpoint_dir=str(tmp_path / "ckpt")))
+    resumed = train(cfg(tmp_path / "c", total_steps=6,
+                        checkpoint_dir=str(tmp_path / "ckpt")))
+    for x, y in zip(jax.tree.leaves(full["state"].params),
+                    jax.tree.leaves(resumed["state"].params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
