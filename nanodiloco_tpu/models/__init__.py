@@ -1,5 +1,6 @@
 from nanodiloco_tpu.models.config import LARGE_LLAMA, LLAMA3_8B, TINY_LLAMA, LlamaConfig
 from nanodiloco_tpu.models.llama import causal_lm_loss, forward, init_params
+from nanodiloco_tpu.models.moe import expert_capacity, moe_mlp
 
 __all__ = [
     "LlamaConfig",
@@ -9,4 +10,6 @@ __all__ = [
     "init_params",
     "forward",
     "causal_lm_loss",
+    "moe_mlp",
+    "expert_capacity",
 ]

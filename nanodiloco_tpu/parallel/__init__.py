@@ -1,4 +1,5 @@
 from nanodiloco_tpu.parallel.diloco import Diloco, DilocoConfig, DilocoState
+from nanodiloco_tpu.parallel.feed import BatchFeeder, device_set_slices
 from nanodiloco_tpu.parallel.mesh import (
     AXES,
     MeshConfig,
@@ -14,6 +15,8 @@ from nanodiloco_tpu.parallel.streaming import (
 )
 
 __all__ = [
+    "BatchFeeder",
+    "device_set_slices",
     "Diloco",
     "DilocoConfig",
     "DilocoState",
