@@ -98,22 +98,36 @@ def run_workload(
         )
         return tok, jnp.ones_like(tok)
 
-    # warmup: compile the program(s)
-    key, k = jax.random.split(key)
-    tok, mask = make_round(k)
-    state, loss = dl.round_step(state, tok, mask)
+    # Pre-stage every round's batch on device BEFORE the timed region.
+    # The training loop prepares round N+1's batch on a background thread
+    # while round N computes (train_loop.py prefetch), so batch
+    # generation is not on the critical path of the real cadence —
+    # interleaving randint dispatches with round dispatches here would
+    # charge the tunneled runtime's ~65 ms executable-switch cost to the
+    # training step, which training never pays.
+    staged = []
+    for _ in range(rounds):
+        key, k = jax.random.split(key)
+        staged.append(make_round(k))
+    jax.block_until_ready(staged)
+
+    # warmup: compile the program(s). The inner-only program warms FIRST
+    # so the executable last dispatched before the timed loop is
+    # round_step itself — otherwise round 1 pays the tunneled runtime's
+    # ~65 ms executable-switch cost that steady-state training never sees.
     if measure_sync:
         state_i = jax.tree.map(jnp.copy, state)
         key, k = jax.random.split(key)
         tok, mask = make_round(k)
         state_i, _ = dl.inner_round_step(state_i, tok, mask)
+    key, k = jax.random.split(key)
+    tok, mask = make_round(k)
+    state, loss = dl.round_step(state, tok, mask)
     jax.block_until_ready(loss)
 
     # timed: full rounds (the real training cadence, sync included)
     t0 = time.perf_counter()
-    for _ in range(rounds):
-        key, k = jax.random.split(key)
-        tok, mask = make_round(k)
+    for tok, mask in staged:
         state, loss = dl.round_step(state, tok, mask)
     jax.block_until_ready(loss)
     round_time = time.perf_counter() - t0
@@ -130,16 +144,36 @@ def run_workload(
         "params": model_cfg.num_params(),
     }
     if measure_sync:
-        t0 = time.perf_counter()
-        for _ in range(rounds):
-            key, k = jax.random.split(key)
-            tok, mask = make_round(k)
-            state_i, loss_i = dl.inner_round_step(state_i, tok, mask)
-        jax.block_until_ready(loss_i)
-        inner_time = time.perf_counter() - t0
-        sync_total = max(0.0, round_time - inner_time)
-        out["outer_sync_share"] = round(sync_total / round_time, 5)
-        out["avg_outer_sync_ms"] = round(sync_total / rounds * 1e3, 2)
+        # Warm min-over-repeats differencing: the per-round totals above
+        # include per-dispatch jitter through the tunneled runtime that
+        # would swamp the (small, fused) sync cost, so the sync estimate
+        # uses best-of-N for both programs. On one chip this bounds the
+        # outer step's marginal compute; on a real mesh the same
+        # differencing captures the all-reduce too.
+        key, k = jax.random.split(key)
+        tok, mask = make_round(k)
+        jax.block_until_ready((tok, mask))
+
+        def best_of(step_fn, st, n=3):
+            best = float("inf")
+            for _ in range(n):
+                st, l = step_fn(st, tok, mask)
+                jax.block_until_ready(l)
+                t0 = time.perf_counter()
+                st, l = step_fn(st, tok, mask)
+                jax.block_until_ready(l)
+                best = min(best, time.perf_counter() - t0)
+            return best, st
+
+        full_t, state = best_of(dl.round_step, state)
+        inner_t, state_i = best_of(dl.inner_round_step, state_i)
+        sync_s = max(0.0, full_t - inner_t)
+        out["outer_sync_share"] = round(sync_s / full_t, 5)
+        # renamed from avg_outer_sync_ms: the methodology changed from a
+        # rounds-loop average (which folded in batch-gen dispatch
+        # switches) to this warm best-of-N difference — a new key keeps
+        # old recorded runs from being read as like-for-like.
+        out["min_outer_sync_ms"] = round(sync_s * 1e3, 2)
     if peak_tflops:
         out["mfu"] = round(tflops_chip / peak_tflops, 4)
     return out
@@ -184,7 +218,10 @@ def main() -> None:
     n_dev = int(os.environ.get("BENCH_DEVICES", "1"))
     grad_accum = int(os.environ.get("BENCH_GRAD_ACCUM", "4"))
     inner_steps = int(os.environ.get("BENCH_INNER_STEPS", "10"))
-    rounds = int(os.environ.get("BENCH_ROUNDS", "3"))
+    # 10 rounds ≈ 6 s timed: per-dispatch jitter through the tunneled
+    # runtime is ~±100 ms on a ~560 ms round — 3 rounds let one hiccup
+    # shave ~15% off the measured steady-state throughput.
+    rounds = int(os.environ.get("BENCH_ROUNDS", "10"))
     batch = int(os.environ.get("BENCH_BATCH", "8"))
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
     # blockwise CE (ops/fused_ce.py): never materializes [B, S, 32000]
@@ -259,7 +296,7 @@ def run_mid_only() -> None:
     mid = run_workload(
         mid_cfg,
         n_dev=int(os.environ.get("BENCH_DEVICES", "1")),
-        grad_accum=1, inner_steps=4, rounds=2, batch=8,
+        grad_accum=1, inner_steps=4, rounds=4, batch=8,
         seq=int(os.environ.get("BENCH_SEQ", "1024")),
         peak_tflops=peak,
         # the differencing baseline doubles resident state — skip it
