@@ -231,12 +231,14 @@ def main() -> None:
     # blockwise kernels (measured 633k vs 491k tok/s); flash/ring earn
     # their keep at long context, not here.
     loss_chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "512"))
+    attn = os.environ.get("BENCH_ATTN", "dense")
 
     peak, kind = _peak_tflops()
     backend = jax.default_backend()
 
     model_cfg = LlamaConfig(
         vocab_size=32000, dtype="bfloat16", loss_chunk=loss_chunk,
+        attention_impl=attn,
     )
     tiny = run_workload(
         model_cfg, n_dev=n_dev, grad_accum=grad_accum, inner_steps=inner_steps,
@@ -292,6 +294,7 @@ def run_mid_only() -> None:
         dtype="bfloat16",
         remat=True,
         loss_chunk=loss_chunk,
+        attention_impl=os.environ.get("BENCH_ATTN", "dense"),
     )
     mid = run_workload(
         mid_cfg,

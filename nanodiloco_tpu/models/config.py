@@ -32,6 +32,12 @@ class LlamaConfig:
     dtype: str = "float32"          # activation/compute dtype ("bfloat16" on TPU)
     param_dtype: str = "float32"    # master parameter dtype
     remat: bool = False             # jax.checkpoint each decoder layer
+    # What the per-layer checkpoint may SAVE instead of recomputing:
+    # "nothing" recomputes the whole layer in backward (min HBM);
+    # "dots" saves matmul outputs and recomputes only the cheap
+    # elementwise ops (norms, rope, silu) — less recompute where the
+    # FLOPs are, at higher activation memory.
+    remat_policy: str = "nothing"   # "nothing" | "dots"
     attention_impl: str = "dense"   # "dense" | "flash" | "ring"
     # rows per chunk of the blockwise cross-entropy (ops/fused_ce.py):
     # the full [B, S, V] logits tensor is never materialized. 0 = off.
@@ -62,6 +68,11 @@ class LlamaConfig:
             raise ValueError("num_key_value_heads must be >= 1 (or None for MHA)")
         if self.num_attention_heads % self.kv_heads:
             raise ValueError("num_attention_heads must divide evenly by num_key_value_heads")
+        if self.remat_policy not in ("nothing", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'nothing' or 'dots'; got "
+                f"{self.remat_policy!r}"
+            )
         if self.num_experts and self.num_experts_per_tok > self.num_experts:
             raise ValueError(
                 f"num_experts_per_tok ({self.num_experts_per_tok}) cannot "

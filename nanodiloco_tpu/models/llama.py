@@ -258,7 +258,12 @@ def forward(
         return _decoder_layer(cfg, x, layer, cos, sin, mask, sp_axis, valid)
 
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        policy = (
+            jax.checkpoint_policies.dots_saveable
+            if cfg.remat_policy == "dots"
+            else None  # "nothing": recompute the full layer
+        )
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
     def scan_body(carry, layer):
         x, aux = layer_fn(carry, layer, cos, sin, mask, attn_mask)

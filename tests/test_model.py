@@ -183,3 +183,30 @@ def test_hf_llama_logit_parity(kv_heads):
             ).loss.item()
         our_loss, _ = causal_lm_loss(params, jnp.asarray(tokens), cfg)
         np.testing.assert_allclose(float(our_loss), hf_loss, rtol=1e-4)
+
+
+@pytest.mark.parametrize("policy", ["nothing", "dots"])
+def test_remat_matches_no_remat(policy):
+    """Rematerialization is a memory/compute trade, never a numerics
+    change: loss and grads must match the un-checkpointed forward under
+    either save policy."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, remat=False)
+    cfg_r = dataclasses.replace(CFG, remat=True, remat_policy=policy)
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    mask = jnp.ones_like(tokens)
+
+    def loss_of(c):
+        def f(p):
+            loss, _ = causal_lm_loss(p, tokens, c, loss_mask=mask)
+            return loss
+        return jax.value_and_grad(f)(params)
+
+    with jax.default_matmul_precision("highest"):
+        loss_a, grad_a = loss_of(cfg)
+        loss_b, grad_b = loss_of(cfg_r)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(grad_a), jax.tree.leaves(grad_b)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
