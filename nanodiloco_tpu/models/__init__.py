@@ -1,4 +1,5 @@
 from nanodiloco_tpu.models.config import LARGE_LLAMA, LLAMA3_8B, TINY_LLAMA, LlamaConfig
+from nanodiloco_tpu.models.generate import generate, init_kv_cache, pad_prompts
 from nanodiloco_tpu.models.llama import causal_lm_loss, forward, init_params
 from nanodiloco_tpu.models.moe import expert_capacity, moe_mlp
 
@@ -10,6 +11,9 @@ __all__ = [
     "init_params",
     "forward",
     "causal_lm_loss",
+    "generate",
+    "init_kv_cache",
+    "pad_prompts",
     "moe_mlp",
     "expert_capacity",
 ]

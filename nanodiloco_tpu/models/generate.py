@@ -1,0 +1,227 @@
+"""Autoregressive generation with a static-shape KV cache.
+
+No reference analog (the reference is training-only; its model would rely
+on HF ``generate``, ref nanodiloco/main.py:97-99) — but a framework whose
+users train language models needs to sample from them. The design is
+TPU-native throughout:
+
+- ONE jitted program per (config, shape) pair: prefill + the whole decode
+  loop compile together; the decode loop is a ``lax.scan`` over steps, so
+  there are no per-token dispatches (the usual host-bound decode loop
+  costs one dispatch per token — through this environment's tunneled
+  runtime that alone would be ~65 ms/token).
+- The KV cache is preallocated at ``[L, B, S_max, Hkv, hd]`` and written
+  with ``lax.dynamic_update_slice`` — static shapes, no growing arrays.
+  It rides the layer ``lax.scan`` as per-layer carry slices, mirroring
+  the training forward's scan-over-layers layout (models/llama.py), so
+  the same stacked parameter pytree works unchanged.
+- Decode attention is GQA-native: query heads are grouped against the
+  Hkv cache heads with einsums — cached K/V are never expanded to the
+  full query-head count in HBM (decode is K/V-bandwidth-bound; this is
+  the entire point of GQA).
+
+Variable-length prompts are handled with a right-aligned convention:
+``prompt_len`` marks each row's true length; shorter prompts are padded
+on the LEFT by the caller (or via ``pad_prompts``) so the last prompt
+token always sits at the same static position. Pad positions are masked
+out of attention.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from nanodiloco_tpu.models.config import LlamaConfig
+from nanodiloco_tpu.models.llama import (
+    MASK_VALUE,
+    Params,
+    apply_rope,
+    mlp_block,
+    rms_norm,
+    rope_tables,
+)
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_length: int) -> dict:
+    """Preallocated cache: k/v [L, B, S_max, Hkv, hd] in compute dtype."""
+    shape = (
+        cfg.num_hidden_layers, batch, max_length, cfg.kv_heads, cfg.head_dim,
+    )
+    cdt = jnp.dtype(cfg.dtype)
+    return {"k": jnp.zeros(shape, cdt), "v": jnp.zeros(shape, cdt)}
+
+
+def _cached_block(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,        # [B, T] — T = prompt length (prefill) or 1
+    cache: dict,              # k/v [L, B, S_max, Hkv, hd]
+    pos: jax.Array,           # scalar int32: write offset into the cache
+    key_valid: jax.Array,     # [B, S_max] 1 = cache position holds a real token
+    token_valid: jax.Array,   # [B, T] 1 = input token is real (left-pad = 0);
+                              # MoE routing must not spend capacity on pads
+):
+    """Run the decoder over ``tokens``, reading/writing the KV cache at
+    ``pos``. Returns (last-position logits [B, V] float32, updated
+    cache) — only the final position is ever sampled, so the vocabulary
+    head is applied to it alone (at Llama-3-8B scale, full-prompt prefill
+    logits would be a multi-GB [B, P, V] tensor computed to be thrown
+    away)."""
+    cdt = jnp.dtype(cfg.dtype)
+    b, t = tokens.shape
+    s_max = cache["k"].shape[2]
+    nh, nkv, hd = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    g = nh // nkv
+    scale = 1.0 / math.sqrt(hd)
+
+    x = params["embed"].astype(cdt)[tokens]
+    cos, sin = rope_tables(cfg, t, offset=pos)
+
+    # Additive mask [B, T, S_max]: query at global position pos+qi may see
+    # cache key ki when ki <= pos+qi AND the slot holds a real token.
+    ki = jnp.arange(s_max)[None, None, :]
+    qi = pos + jnp.arange(t)[None, :, None]
+    ok = (ki <= qi) & (key_valid[:, None, :] > 0)
+    mask = jnp.where(ok, 0.0, MASK_VALUE)[:, None]  # [B, 1, T, S_max]
+
+    def layer_body(x, scanned):
+        layer, ck, cv = scanned  # layer params + this layer's cache slices
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ layer["wq"].astype(cdt)).reshape(b, t, nh, hd)
+        k = (h @ layer["wk"].astype(cdt)).reshape(b, t, nkv, hd)
+        v = (h @ layer["wv"].astype(cdt)).reshape(b, t, nkv, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+
+        # grouped GQA attention against the full cache (softmax in fp32)
+        qg = q.reshape(b, t, nkv, g, hd)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck).astype(jnp.float32)
+        scores = scores * scale + mask[:, :, None]  # [B, nkv, G, T, S_max]
+        probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
+        attn = jnp.einsum("bkgts,bskd->btkgd", probs, cv)
+        x = x + attn.reshape(b, t, nh * hd) @ layer["wo"].astype(cdt)
+
+        x, _aux = mlp_block(cfg, x, layer, valid=token_valid)
+        return x, (ck, cv)
+
+    x, (ck, cv) = jax.lax.scan(
+        layer_body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x[:, -1], params["final_norm"], cfg.rms_norm_eps)  # [B, d]
+    head = params.get("lm_head", None)
+    if head is None:
+        head = params["embed"].T
+    logits = (x @ head.astype(cdt)).astype(jnp.float32)
+    return logits, {"k": ck, "v": cv}
+
+
+def _sample(logits, key, temperature: float, top_k: int):
+    """[B, V] logits -> [B] int32. temperature 0 = greedy (key unused)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, MASK_VALUE, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+@functools.lru_cache(maxsize=8)
+def _build_generate(
+    cfg: LlamaConfig, batch: int, prompt_len: int, max_new_tokens: int,
+    temperature: float, top_k: int,
+):
+    s_max = prompt_len + max_new_tokens
+
+    def run(params, prompt, prompt_valid, key):
+        cache = init_kv_cache(cfg, batch, s_max)
+        # prefill: the whole (left-padded) prompt in one block
+        key_valid = jnp.concatenate(
+            [prompt_valid, jnp.ones((batch, max_new_tokens), jnp.int32)], axis=1
+        )
+        logits, cache = _cached_block(
+            params, cfg, prompt, cache, jnp.int32(0), key_valid, prompt_valid
+        )
+        key, k0 = jax.random.split(key)
+        tok0 = _sample(logits, k0, temperature, top_k)
+        if max_new_tokens == 1:
+            return tok0[:, None]
+
+        dec_valid = jnp.ones((batch, 1), jnp.int32)  # generated tokens are real
+
+        def step(carry, step_key):
+            cache, pos, tok = carry
+            logits, cache = _cached_block(
+                params, cfg, tok[:, None], cache, pos, key_valid, dec_valid
+            )
+            nxt = _sample(logits, step_key, temperature, top_k)
+            return (cache, pos + 1, nxt), nxt
+
+        # max_new_tokens - 1 steps: the first new token came from prefill,
+        # and each step emits the token it just sampled (no trailing
+        # forward pass whose sample would be discarded)
+        keys = jax.random.split(key, max_new_tokens - 1)
+        _, rest = jax.lax.scan(
+            step, (cache, jnp.int32(prompt_len), tok0), keys
+        )
+        return jnp.concatenate([tok0[None], rest], axis=0).T  # [B, N]
+
+    return jax.jit(run)
+
+
+def generate(
+    params: Params,
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    max_new_tokens: int,
+    *,
+    prompt_valid: jax.Array | None = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    key: jax.Array | None = None,
+) -> jax.Array:
+    """Sample ``max_new_tokens`` continuations of ``prompt`` [B, P].
+
+    Returns the new tokens [B, max_new_tokens] (int32). ``temperature=0``
+    is greedy decoding; otherwise pass ``key`` (and optionally ``top_k``)
+    for stochastic sampling. ``prompt_valid`` [B, P] marks real prompt
+    tokens for left-padded variable-length prompts (default: all real).
+    The whole prefill+decode runs as one compiled program, cached per
+    (config, shape, sampling) signature.
+    """
+    if prompt.ndim != 2:
+        raise ValueError(f"prompt must be [batch, prompt_len]; got {prompt.shape}")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0; got {temperature}")
+    if temperature > 0.0 and key is None:
+        raise ValueError("stochastic sampling (temperature > 0) requires a PRNG key")
+    if key is None:
+        key = jax.random.key(0)  # unused by greedy sampling
+    b, p = prompt.shape
+    if prompt_valid is None:
+        prompt_valid = jnp.ones((b, p), jnp.int32)
+    fn = _build_generate(
+        cfg, b, p, int(max_new_tokens), float(temperature), int(top_k)
+    )
+    return fn(params, prompt.astype(jnp.int32), prompt_valid, key)
+
+
+def pad_prompts(prompts: list[list[int]], pad_id: int = 0):
+    """Left-pad variable-length prompts to a common length; returns
+    (tokens [B, P], valid [B, P]) ready for ``generate``."""
+    import numpy as np
+
+    p = max(len(x) for x in prompts)
+    toks = np.full((len(prompts), p), pad_id, np.int32)
+    valid = np.zeros((len(prompts), p), np.int32)
+    for i, x in enumerate(prompts):
+        if len(x):
+            toks[i, p - len(x):] = x
+            valid[i, p - len(x):] = 1
+    return jnp.asarray(toks), jnp.asarray(valid)

@@ -207,6 +207,15 @@ def _decoder_layer(
     attn = _attention(cfg, q, k, v, mask, sp_axis)
     x = x + attn.reshape(b, s, nh * hd) @ layer["wo"].astype(cdt)
 
+    return mlp_block(cfg, x, layer, valid)
+
+
+def mlp_block(cfg: LlamaConfig, x, layer: Params, valid=None):
+    """The norm + (dense SwiGLU | MoE) residual half of a decoder layer,
+    shared by the training forward and the cached decode path
+    (models/generate.py) so the two can never drift. Returns
+    (x, aux_loss) — aux is the router load-balance term, 0.0 for dense."""
+    cdt = x.dtype
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
     if cfg.num_experts:
         from nanodiloco_tpu.models.moe import moe_mlp
