@@ -215,7 +215,93 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     )
 
 
+def build_generate_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nanodiloco_tpu generate",
+        description="Sample text from a trained checkpoint (no reference "
+                    "analog — the reference is training-only).",
+    )
+    p.add_argument("--checkpoint-dir", type=str, required=True,
+                   help="directory written by training with --checkpoint-dir; "
+                        "its model_config.json sidecar makes the checkpoint "
+                        "self-describing")
+    p.add_argument("--prompt", type=str, default="The",
+                   help="prompt text (encoded with the training tokenizer)")
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.8,
+                   help="0 = greedy decoding")
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step to load (default: latest)")
+    p.add_argument("--tokenizer", type=str, default=None,
+                   help="override the tokenizer recorded at training time")
+    p.add_argument("--force-cpu-devices", type=int, default=None, metavar="N",
+                   help="run on N virtual CPU devices instead of the "
+                        "accelerator (e.g. sample on CPU while the chip "
+                        "is busy training)")
+    return p
+
+
+def generate_main(argv: list[str]) -> None:
+    args = build_generate_parser().parse_args(argv)
+    if args.force_cpu_devices:
+        from nanodiloco_tpu.utils import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(args.force_cpu_devices)
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from nanodiloco_tpu.data import get_tokenizer
+    from nanodiloco_tpu.models import generate
+    from nanodiloco_tpu.training.checkpoint import CheckpointManager
+
+    sidecar_path = os.path.join(args.checkpoint_dir, "model_config.json")
+    try:
+        with open(sidecar_path) as f:
+            sidecar = json.load(f)
+    except FileNotFoundError:
+        raise SystemExit(
+            f"no model_config.json in {args.checkpoint_dir}: generation needs "
+            "a checkpoint written by this framework's training loop"
+        )
+    model_cfg = LlamaConfig.from_dict(sidecar["model"])
+    tokenizer = get_tokenizer(args.tokenizer or sidecar.get("tokenizer"))
+
+    ckpt = CheckpointManager(args.checkpoint_dir)
+    # only the merged global model — NOT the per-worker params/optimizer
+    # moments, which at scale would not fit the single sampling device
+    state = ckpt.restore_raw(args.step, only={"snapshot"})
+    ckpt.close()
+    params = state["snapshot"]
+
+    ids = tokenizer.encode(args.prompt)
+    if not ids:
+        raise SystemExit("empty prompt after tokenization")
+    if any(i >= model_cfg.vocab_size for i in ids):
+        raise SystemExit(
+            "prompt tokenizes outside the model vocabulary "
+            f"({model_cfg.vocab_size}); pass the training --tokenizer"
+        )
+    prompt = jnp.asarray([ids], jnp.int32)
+    out = generate(
+        params, prompt, model_cfg, args.max_new_tokens,
+        temperature=args.temperature, top_k=args.top_k,
+        key=jax.random.key(args.seed),
+    )
+    text = tokenizer.decode([int(t) for t in out[0]])
+    print(args.prompt + text)
+
+
 def main(argv: list[str] | None = None) -> None:
+    import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "generate":
+        generate_main(argv[1:])
+        return
     print("Training DiLoCo with nanodiloco_tpu...")  # ≡ ref main.py:134
     args = build_parser().parse_args(argv)
     if args.force_cpu_devices:

@@ -197,8 +197,13 @@ def generate(
     """
     if prompt.ndim != 2:
         raise ValueError(f"prompt must be [batch, prompt_len]; got {prompt.shape}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1; got {max_new_tokens}")
     if temperature < 0.0:
         raise ValueError(f"temperature must be >= 0; got {temperature}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0; got {top_k}")
+    top_k = min(int(top_k), cfg.vocab_size)  # top-k over everything == no cut
     if temperature > 0.0 and key is None:
         raise ValueError("stochastic sampling (temperature > 0) requires a PRNG key")
     if key is None:

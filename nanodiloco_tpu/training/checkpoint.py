@@ -27,6 +27,10 @@ class CheckpointManager:
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True
             ),
+            # explicit handler so item_metadata works on a manager that
+            # has not saved in this process (restore_raw's metadata-driven
+            # cross-device restore needs it)
+            item_handlers=ocp.StandardCheckpointHandler(),
         )
 
     def save(self, step: int, state: DilocoState, force: bool = False) -> None:
@@ -47,6 +51,60 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {self.directory}")
         return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract_state))
+
+    def restore_raw(
+        self, step: int | None = None, only: set[str] | None = None
+    ) -> Any:
+        """Restore without a caller-supplied target: returns the saved
+        pytree as nested dicts of single-device arrays. The target is
+        rebuilt from the checkpoint's own metadata WITHOUT the saved
+        shardings, so a checkpoint written on one mesh (e.g. 8 training
+        devices) loads on any other device count. ``only`` names
+        top-level DilocoState fields to materialize (e.g. {"snapshot"});
+        the rest stay un-read placeholders — at multi-worker 8B scale the
+        full state (W x params + optimizer moments) would not fit the one
+        device this restores onto when the snapshot alone does."""
+        step = self.latest_step if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        # A separate read-only manager: partial (PLACEHOLDER) restores go
+        # through PyTreeRestore, which the training manager's standard
+        # handler does not accept.
+        mngr = ocp.CheckpointManager(
+            self.directory, item_handlers=ocp.PyTreeCheckpointHandler()
+        )
+        try:
+            meta = mngr.item_metadata(step).tree
+            sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
+            def abstract(tree):
+                return jax.tree.map(
+                    lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=sharding),
+                    tree,
+                )
+
+            if only is None:
+                item = abstract(meta)
+            else:
+                missing = only - set(meta)
+                if missing:
+                    raise KeyError(
+                        f"checkpoint has no field(s) {sorted(missing)}; "
+                        f"available: {sorted(meta)}"
+                    )
+                item = {
+                    k: (abstract(v) if k in only
+                        else jax.tree.map(lambda _: ocp.PLACEHOLDER, v))
+                    for k, v in meta.items()
+                }
+            rargs = jax.tree.map(
+                lambda _: ocp.ArrayRestoreArgs(sharding=sharding), meta
+            )
+            return mngr.restore(
+                step, args=ocp.args.PyTreeRestore(item=item, restore_args=rargs)
+            )
+        finally:
+            mngr.close()
 
     def close(self) -> None:
         self._mngr.close()

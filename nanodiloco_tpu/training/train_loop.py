@@ -23,6 +23,7 @@ all deliberate:
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import time
 from typing import Any
@@ -249,8 +250,6 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         # from its manifest, not from whatever tokenizer loads here
         manifest_path = cfg.dataset_path + ".manifest.json"
         if os.path.exists(manifest_path):
-            import json
-
             with open(manifest_path) as f:
                 shard_vocab = int(json.load(f)["vocab_size"])
             if model_cfg.vocab_size < shard_vocab:
@@ -308,6 +307,23 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         from nanodiloco_tpu.training.checkpoint import CheckpointManager, abstract_state_like
 
         ckpt = CheckpointManager(cfg.checkpoint_dir)
+        # Self-describing checkpoints: the generate CLI (and any later
+        # consumer) rebuilds the model from this sidecar alone, without
+        # the training flags. Process 0 only — on a multi-host pod the
+        # checkpoint dir is shared storage and concurrent writers would
+        # race on the file.
+        if jax.process_index() == 0:
+            os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+            sidecar = os.path.join(cfg.checkpoint_dir, "model_config.json")
+            with open(sidecar, "w") as f:
+                json.dump(
+                    {
+                        "model": dataclasses.asdict(model_cfg),
+                        "num_workers": cfg.num_workers,
+                        "tokenizer": cfg.tokenizer,
+                    },
+                    f, indent=1,
+                )
         if cfg.resume and ckpt.latest_step is not None:
             state = ckpt.restore(abstract_state_like(state))
 

@@ -251,3 +251,20 @@ def test_cli_measure_comms_from_wandb_config(tmp_path):
     )
     assert config_from_args(args).measure_comm is True
     assert config_from_args(build_parser().parse_args([])).measure_comm is True
+
+
+def test_generate_cli_from_checkpoint(tmp_path, capsys):
+    """Train with checkpointing, then sample from the checkpoint via the
+    generate subcommand — the checkpoint's model_config.json sidecar makes
+    it self-describing (no training flags needed)."""
+    from nanodiloco_tpu.cli import main as cli_main
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    train(small_cfg(tmp_path, checkpoint_dir=ckpt_dir))
+    assert os.path.exists(os.path.join(ckpt_dir, "model_config.json"))
+    cli_main([
+        "generate", "--checkpoint-dir", ckpt_dir, "--prompt", "ab",
+        "--max-new-tokens", "5", "--temperature", "0",
+    ])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert out.startswith("ab") and len(out) > 2
