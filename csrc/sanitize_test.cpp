@@ -1,0 +1,87 @@
+// Standalone sanitizer harness for the tokenshard native layer — the
+// "race detection / sanitizers" aux subsystem (SURVEY §5: absent in the
+// reference, which has no native code; this framework's threaded C++
+// data path earns one). Exercises every extern "C" entry point,
+// including the multithreaded gather and the error paths, under
+// whatever -fsanitize= flags the build passes:
+//
+//   g++ -std=c++17 -g -fsanitize=address,undefined csrc/tokenshard.cpp \
+//       csrc/sanitize_test.cpp -o /tmp/ts_asan -lpthread && /tmp/ts_asan
+//   g++ -std=c++17 -g -fsanitize=thread csrc/tokenshard.cpp \
+//       csrc/sanitize_test.cpp -o /tmp/ts_tsan -lpthread && /tmp/ts_tsan
+//
+// tests/test_tokenshard.py builds and runs both when g++ is available.
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+struct Shard;
+extern "C" {
+int ts_write(const char*, const int32_t*, uint64_t, uint64_t);
+Shard* ts_open(const char*);
+uint64_t ts_n_seqs(const Shard*);
+uint64_t ts_seq_len(const Shard*);
+void ts_close(Shard*);
+int ts_gather(const Shard*, const uint64_t*, uint64_t, int32_t*, int);
+void ts_shuffled_indices(uint64_t, uint64_t, uint64_t, uint64_t, uint64_t*);
+}
+
+int main(int argc, char** argv) {
+  const std::string dir = argc > 1 ? argv[1] : "/tmp";
+  const std::string path = dir + "/sanitize_test.tshrd";
+  constexpr uint64_t kSeqs = 1000, kLen = 96;
+
+  // write a shard whose every cell is derivable from its position
+  std::vector<int32_t> data(kSeqs * kLen);
+  for (uint64_t i = 0; i < data.size(); ++i)
+    data[i] = static_cast<int32_t>(i % 100003);
+  assert(ts_write(path.c_str(), data.data(), kSeqs, kLen) == 0);
+
+  // error paths: missing file, bad magic
+  assert(ts_open((dir + "/definitely_missing.tshrd").c_str()) == nullptr);
+  {
+    const std::string bad = dir + "/bad_magic.tshrd";
+    FILE* f = fopen(bad.c_str(), "wb");
+    const char junk[32] = "NOTASHARDFILE";
+    fwrite(junk, 1, sizeof junk, f);
+    fclose(f);
+    assert(ts_open(bad.c_str()) == nullptr);
+  }
+
+  Shard* s = ts_open(path.c_str());
+  assert(s && ts_n_seqs(s) == kSeqs && ts_seq_len(s) == kLen);
+
+  // shuffled indices: a permutation, deterministic in (seed,epoch,worker)
+  std::vector<uint64_t> perm(kSeqs), perm2(kSeqs), seen(kSeqs, 0);
+  ts_shuffled_indices(kSeqs, 7, 3, 1, perm.data());
+  ts_shuffled_indices(kSeqs, 7, 3, 1, perm2.data());
+  assert(memcmp(perm.data(), perm2.data(), kSeqs * 8) == 0);
+  for (uint64_t v : perm) { assert(v < kSeqs); seen[v]++; }
+  for (uint64_t c : seen) assert(c == 1);
+  ts_shuffled_indices(kSeqs, 7, 4, 1, perm2.data());
+  assert(memcmp(perm.data(), perm2.data(), kSeqs * 8) != 0);
+
+  // gathers: single-thread, many threads, more threads than rows, empty
+  std::vector<int32_t> out(kSeqs * kLen);
+  for (int threads : {1, 8, 64, 0}) {
+    memset(out.data(), -1, out.size() * 4);
+    assert(ts_gather(s, perm.data(), kSeqs, out.data(), threads) == 0);
+    for (uint64_t r = 0; r < kSeqs; ++r)
+      assert(memcmp(out.data() + r * kLen, data.data() + perm[r] * kLen,
+                    kLen * 4) == 0);
+  }
+  uint64_t few[3] = {0, kSeqs - 1, kSeqs / 2};
+  assert(ts_gather(s, few, 3, out.data(), 16) == 0);  // threads > rows
+  assert(ts_gather(s, few, 0, out.data(), 4) == 0);   // empty gather
+  uint64_t oob = kSeqs;                               // out-of-range row
+  assert(ts_gather(s, &oob, 1, out.data(), 2) == -1);
+
+  ts_close(s);
+  ts_close(nullptr);  // must be a no-op
+  std::printf("sanitize_test OK\n");
+  return 0;
+}

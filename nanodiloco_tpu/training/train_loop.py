@@ -219,6 +219,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     eval_needed = cfg.eval_batches * cfg.per_device_batch_size if cfg.eval_every else 0
     eval_rows = None
     eval_mask_rows = None
+    sidecar_tokenizer = cfg.tokenizer  # .tshrd manifest may override below
     if cfg.dataset_path and cfg.dataset_path.endswith(".tshrd"):
         if padded:
             raise ValueError(
@@ -247,13 +248,19 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 "change sequence length"
             )
         # the shard was tokenized at prepare time; size the model's vocab
-        # from its manifest, not from whatever tokenizer loads here
+        # from its manifest, not from whatever tokenizer loads here — and
+        # record the manifest's tokenizer in the checkpoint sidecar (the
+        # generate CLI must decode with the ids the model was trained on,
+        # not with whatever cfg.tokenizer happens to be)
         manifest_path = cfg.dataset_path + ".manifest.json"
         if os.path.exists(manifest_path):
             with open(manifest_path) as f:
-                shard_vocab = int(json.load(f)["vocab_size"])
+                manifest = json.load(f)
+            shard_vocab = int(manifest["vocab_size"])
             if model_cfg.vocab_size < shard_vocab:
                 model_cfg = dataclasses.replace(model_cfg, vocab_size=shard_vocab)
+            mt = manifest.get("tokenizer")
+            sidecar_tokenizer = None if mt in (None, "byte-level") else mt
     else:
         if cfg.dataset_path:
             from nanodiloco_tpu.data import load_hf_dataset_texts
@@ -320,7 +327,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     {
                         "model": dataclasses.asdict(model_cfg),
                         "num_workers": cfg.num_workers,
-                        "tokenizer": cfg.tokenizer,
+                        "tokenizer": sidecar_tokenizer,
                     },
                     f, indent=1,
                 )

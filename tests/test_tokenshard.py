@@ -90,3 +90,37 @@ def test_bad_magic(tmp_path):
     p.write_bytes(b"NOTASHARD" + b"\x00" * 64)
     with pytest.raises(OSError):
         TokenShard(str(p))
+
+
+@pytest.mark.parametrize("flags", ["address,undefined", "thread"])
+def test_native_layer_under_sanitizers(tmp_path, flags):
+    """Build csrc under ASAN+UBSAN / TSAN and run the standalone harness
+    (csrc/sanitize_test.cpp): every entry point incl. the multithreaded
+    gather, clean under the sanitizers — the race-detection/sanitizer
+    aux subsystem (SURVEY §5; the reference has no native code to
+    sanitize)."""
+    import os
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in this environment")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    exe = str(tmp_path / f"ts_{flags.split(',')[0]}")
+    build = subprocess.run(
+        ["g++", "-std=c++17", "-g", f"-fsanitize={flags}",
+         os.path.join(root, "csrc", "tokenshard.cpp"),
+         os.path.join(root, "csrc", "sanitize_test.cpp"),
+         "-o", exe, "-lpthread"],
+        capture_output=True, text=True, timeout=240,
+    )
+    if build.returncode != 0:
+        # g++ exists but the sanitizer runtime (libasan/libtsan) may not
+        if "sanitize" in build.stderr or "asan" in build.stderr or "tsan" in build.stderr:
+            pytest.skip(f"sanitizer runtime unavailable: {build.stderr[-200:]}")
+        pytest.fail(f"sanitizer build failed:\n{build.stderr[-1500:]}")
+    proc = subprocess.run(
+        [exe, str(tmp_path)], capture_output=True, text=True, timeout=240
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "sanitize_test OK" in proc.stdout
