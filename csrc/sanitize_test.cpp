@@ -12,6 +12,9 @@
 //
 // tests/test_tokenshard.py builds and runs both when g++ is available.
 
+// assert() carries the test's side effects — an NDEBUG build must not
+// silently delete them and still print OK
+#undef NDEBUG
 #include <cassert>
 #include <cstdint>
 #include <cstdio>

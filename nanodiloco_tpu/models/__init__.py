@@ -1,5 +1,10 @@
 from nanodiloco_tpu.models.config import LARGE_LLAMA, LLAMA3_8B, TINY_LLAMA, LlamaConfig
 from nanodiloco_tpu.models.generate import generate, init_kv_cache, pad_prompts
+from nanodiloco_tpu.models.hf_interop import (
+    from_hf_state_dict,
+    load_into_hf,
+    to_hf_state_dict,
+)
 from nanodiloco_tpu.models.llama import causal_lm_loss, forward, init_params
 from nanodiloco_tpu.models.moe import expert_capacity, moe_mlp
 
@@ -16,4 +21,7 @@ __all__ = [
     "pad_prompts",
     "moe_mlp",
     "expert_capacity",
+    "from_hf_state_dict",
+    "to_hf_state_dict",
+    "load_into_hf",
 ]

@@ -1,0 +1,131 @@
+"""HF Llama weight interop: import/export between this framework's
+stacked pytree layout and ``transformers.LlamaForCausalLM`` state dicts.
+
+The reference builds its model FROM HF (ref nanodiloco/main.py:97-99), so
+its users live in the HF ecosystem; this module is the bridge in both
+directions:
+
+- ``from_hf_state_dict`` ingests HF weights (e.g. a pretrained Llama) as
+  initialization for training here;
+- ``to_hf_state_dict`` / ``load_into_hf`` export a trained snapshot back
+  into an HF model for the rest of that toolchain (eval harnesses,
+  safetensors serialization, hubs).
+
+Layout differences handled: our projections are [in, out] (HF's are
+[out, in] — each weight transposes), our per-layer weights are STACKED
+on a leading layer axis (the scan-over-layers layout, models/llama.py),
+and tied embeddings drop ``lm_head``. Numerics are exact (pure
+transpose/stack); logit parity with HF is asserted in
+tests/test_model.py::test_hf_llama_logit_parity, round-trip identity in
+tests/test_model.py::test_hf_roundtrip.
+
+MoE configs are rejected: HF's LlamaForCausalLM has no MoE variant (the
+Mixtral layout is a different architecture).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from nanodiloco_tpu.models.config import LlamaConfig
+from nanodiloco_tpu.models.llama import Params
+
+# our layer-stack leaf -> (HF per-layer key template, transpose?)
+_LAYER_MAP: dict[str, tuple[str, bool]] = {
+    "attn_norm": ("model.layers.{}.input_layernorm.weight", False),
+    "wq": ("model.layers.{}.self_attn.q_proj.weight", True),
+    "wk": ("model.layers.{}.self_attn.k_proj.weight", True),
+    "wv": ("model.layers.{}.self_attn.v_proj.weight", True),
+    "wo": ("model.layers.{}.self_attn.o_proj.weight", True),
+    "mlp_norm": ("model.layers.{}.post_attention_layernorm.weight", False),
+    "w_gate": ("model.layers.{}.mlp.gate_proj.weight", True),
+    "w_up": ("model.layers.{}.mlp.up_proj.weight", True),
+    "w_down": ("model.layers.{}.mlp.down_proj.weight", True),
+}
+
+
+def _check_dense(cfg: LlamaConfig) -> None:
+    if cfg.num_experts:
+        raise ValueError(
+            "HF interop supports dense Llama only (transformers' "
+            "LlamaForCausalLM has no MoE variant)"
+        )
+
+
+def from_hf_state_dict(sd: Mapping[str, Any], cfg: LlamaConfig) -> Params:
+    """Build our stacked pytree from an HF Llama state dict whose values
+    are numpy arrays (or anything ``np.asarray`` accepts — pass
+    ``{k: v.detach().float().numpy() for k, v in model.state_dict().items()}``
+    from torch)."""
+    _check_dense(cfg)
+    l = cfg.num_hidden_layers
+
+    def get(key):
+        if key not in sd:
+            raise KeyError(f"HF state dict is missing {key!r}")
+        return np.asarray(sd[key], dtype=np.float32)
+
+    layers = {}
+    for ours, (fmt, transpose) in _LAYER_MAP.items():
+        ws = [get(fmt.format(i)) for i in range(l)]
+        if transpose:
+            ws = [w.T for w in ws]
+        layers[ours] = jnp.asarray(np.stack(ws), dtype=jnp.dtype(cfg.param_dtype))
+
+    params: Params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"),
+                             dtype=jnp.dtype(cfg.param_dtype)),
+        "layers": layers,
+        "final_norm": jnp.asarray(get("model.norm.weight"),
+                                  dtype=jnp.dtype(cfg.param_dtype)),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight").T,
+                                        dtype=jnp.dtype(cfg.param_dtype))
+    return params
+
+
+def to_hf_state_dict(params: Params, cfg: LlamaConfig) -> dict[str, np.ndarray]:
+    """Inverse of ``from_hf_state_dict``: flatten the stacked pytree into
+    HF Llama keys (numpy float32, HF's [out, in] orientation). With tied
+    embeddings, ``lm_head.weight`` is emitted as the embedding matrix —
+    exactly what HF's tying produces."""
+    _check_dense(cfg)
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    for ours, (fmt, transpose) in _LAYER_MAP.items():
+        stacked = np.asarray(params["layers"][ours], np.float32)
+        for i in range(cfg.num_hidden_layers):
+            w = stacked[i]
+            # contiguous + unaliased: serializers (safetensors) reject
+            # transposed views and shared-memory tensors
+            sd[fmt.format(i)] = np.ascontiguousarray(w.T if transpose else w)
+    if cfg.tie_word_embeddings:
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"].copy()
+    else:
+        sd["lm_head.weight"] = np.ascontiguousarray(
+            np.asarray(params["lm_head"], np.float32).T
+        )
+    return sd
+
+
+def load_into_hf(params: Params, hf_model, cfg: LlamaConfig):
+    """Copy a trained snapshot into an existing
+    ``transformers.LlamaForCausalLM`` (in place; returns the model). The
+    model's architecture must match ``cfg``."""
+    import torch
+
+    sd = {k: torch.from_numpy(v.copy()) for k, v in to_hf_state_dict(params, cfg).items()}
+    missing, unexpected = hf_model.load_state_dict(sd, strict=False)
+    # rotary tables / buffers may be non-persistent; real weights must match
+    real_missing = [k for k in missing if "rotary" not in k and "inv_freq" not in k]
+    if real_missing or unexpected:
+        raise ValueError(
+            f"state dict mismatch: missing={real_missing} unexpected={list(unexpected)}"
+        )
+    return hf_model

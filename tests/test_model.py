@@ -2,6 +2,8 @@
 with the reference's model (HF LlamaForCausalLM, ref nanodiloco/main.py:97-99)
 via torch-CPU."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -112,34 +114,13 @@ def test_tied_embeddings():
 # ---------------------------------------------------------------------------
 
 def _hf_to_pytree(hf_model, cfg: LlamaConfig):
-    """Copy HF torch weights into our pytree ([in, out] layout = HF's .T)."""
+    """HF torch weights -> our pytree via the library importer."""
     import torch
 
+    from nanodiloco_tpu.models import from_hf_state_dict
+
     sd = {k: v.detach().to(torch.float32).numpy() for k, v in hf_model.state_dict().items()}
-    l = cfg.num_hidden_layers
-
-    def stack(fmt, transpose=True):
-        ws = [sd[fmt.format(i)] for i in range(l)]
-        ws = [w.T if transpose else w for w in ws]
-        return jnp.asarray(np.stack(ws))
-
-    params = {
-        "embed": jnp.asarray(sd["model.embed_tokens.weight"]),
-        "final_norm": jnp.asarray(sd["model.norm.weight"]),
-        "lm_head": jnp.asarray(sd["lm_head.weight"].T),
-        "layers": {
-            "attn_norm": stack("model.layers.{}.input_layernorm.weight", transpose=False),
-            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
-            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
-            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
-            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
-            "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight", transpose=False),
-            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
-            "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
-            "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
-        },
-    }
-    return params
+    return from_hf_state_dict(sd, cfg)
 
 
 @pytest.mark.parametrize("kv_heads", [4, 2])
@@ -210,3 +191,62 @@ def test_remat_matches_no_remat(policy):
     np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
     for a, b in zip(jax.tree.leaves(grad_a), jax.tree.leaves(grad_b)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_hf_roundtrip():
+    """params -> HF state dict -> params is the identity (pure
+    transpose/stack), for both tied and untied embeddings."""
+    from nanodiloco_tpu.models import from_hf_state_dict, to_hf_state_dict
+
+    for tied in (False, True):
+        cfg = dataclasses.replace(CFG, tie_word_embeddings=tied) if tied else CFG
+        params = init_params(jax.random.key(2), cfg)
+        sd = to_hf_state_dict(params, cfg)
+        back = from_hf_state_dict(sd, cfg)
+        assert jax.tree.structure(back) == jax.tree.structure(params)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_hf_export_logit_parity():
+    """A model trained HERE, exported with load_into_hf, must produce the
+    same logits from transformers — the outbound half of the interop
+    contract (the inbound half is test_hf_llama_logit_parity)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, num_key_value_heads=2, num_hidden_layers=2,
+        max_position_embeddings=64,
+    )
+    params = init_params(jax.random.key(3), cfg)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.kv_heads,
+        num_hidden_layers=cfg.num_hidden_layers,
+        rms_norm_eps=cfg.rms_norm_eps, use_cache=False,
+        max_position_embeddings=cfg.max_position_embeddings,
+        attn_implementation="eager",
+    )
+    from nanodiloco_tpu.models import load_into_hf
+
+    hf_model = load_into_hf(params, transformers.LlamaForCausalLM(hf_cfg).eval(), cfg)
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 16))
+    with torch.no_grad():
+        hf_out = hf_model(input_ids=torch.tensor(tokens)).logits.numpy()
+    with jax.default_matmul_precision("highest"):
+        ours = np.asarray(forward(params, jnp.asarray(tokens), cfg))
+    np.testing.assert_allclose(ours, hf_out, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_interop_rejects_moe():
+    from nanodiloco_tpu.models import to_hf_state_dict
+
+    cfg = dataclasses.replace(CFG, num_experts=4)
+    params = init_params(jax.random.key(0), cfg)
+    with pytest.raises(ValueError, match="dense Llama only"):
+        to_hf_state_dict(params, cfg)

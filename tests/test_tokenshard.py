@@ -115,8 +115,16 @@ def test_native_layer_under_sanitizers(tmp_path, flags):
         capture_output=True, text=True, timeout=240,
     )
     if build.returncode != 0:
-        # g++ exists but the sanitizer runtime (libasan/libtsan) may not
-        if "sanitize" in build.stderr or "asan" in build.stderr or "tsan" in build.stderr:
+        # g++ exists but the sanitizer runtime may not: match the LINKER's
+        # missing-library text specifically — matching loosely (e.g. any
+        # "sanitize") would also swallow real compile errors, whose
+        # diagnostics name sanitize_test.cpp itself
+        runtime_missing = any(
+            pat in build.stderr
+            for pat in ("cannot find -lasan", "cannot find -ltsan",
+                        "cannot find -lubsan", "libasan", "libtsan", "libubsan")
+        )
+        if runtime_missing:
             pytest.skip(f"sanitizer runtime unavailable: {build.stderr[-200:]}")
         pytest.fail(f"sanitizer build failed:\n{build.stderr[-1500:]}")
     proc = subprocess.run(
