@@ -135,11 +135,20 @@ def _sample(logits, key, temperature: float, top_k: int):
 @functools.lru_cache(maxsize=8)
 def _build_generate(
     cfg: LlamaConfig, batch: int, prompt_len: int, max_new_tokens: int,
-    temperature: float, top_k: int,
+    temperature: float, top_k: int, mesh=None, stop_token: int | None = None,
 ):
     s_max = prompt_len + max_new_tokens
 
     def run(params, prompt, prompt_valid, key):
+        if mesh is not None:
+            # sharded decode (e.g. a tp/fsdp-sharded 8B): constrain the
+            # params to the training sharding rules and let GSPMD
+            # partition the cache and einsums around them. Lazy import:
+            # parallel imports models, so the reverse edge must not be
+            # at module top.
+            from nanodiloco_tpu.parallel.sharding import constrain, param_specs
+
+            params = constrain(params, mesh, param_specs(cfg))
         cache = init_kv_cache(cfg, batch, s_max)
         # prefill: the whole (left-padded) prompt in one block
         key_valid = jnp.concatenate(
@@ -154,21 +163,31 @@ def _build_generate(
             return tok0[:, None]
 
         dec_valid = jnp.ones((batch, 1), jnp.int32)  # generated tokens are real
+        # rows that emitted stop_token keep emitting it (static shapes:
+        # the scan always runs max_new_tokens steps; finished rows are
+        # pinned, not exited — the caller truncates at the stop token)
+        done0 = (
+            tok0 == stop_token if stop_token is not None
+            else jnp.zeros((batch,), bool)
+        )
 
         def step(carry, step_key):
-            cache, pos, tok = carry
+            cache, pos, tok, done = carry
             logits, cache = _cached_block(
                 params, cfg, tok[:, None], cache, pos, key_valid, dec_valid
             )
             nxt = _sample(logits, step_key, temperature, top_k)
-            return (cache, pos + 1, nxt), nxt
+            if stop_token is not None:
+                nxt = jnp.where(done, jnp.int32(stop_token), nxt)
+                done = done | (nxt == stop_token)
+            return (cache, pos + 1, nxt, done), nxt
 
         # max_new_tokens - 1 steps: the first new token came from prefill,
         # and each step emits the token it just sampled (no trailing
         # forward pass whose sample would be discarded)
         keys = jax.random.split(key, max_new_tokens - 1)
         _, rest = jax.lax.scan(
-            step, (cache, jnp.int32(prompt_len), tok0), keys
+            step, (cache, jnp.int32(prompt_len), tok0, done0), keys
         )
         return jnp.concatenate([tok0[None], rest], axis=0).T  # [B, N]
 
@@ -185,6 +204,8 @@ def generate(
     temperature: float = 0.0,
     top_k: int = 0,
     key: jax.Array | None = None,
+    mesh=None,
+    stop_token: int | None = None,
 ) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` [B, P].
 
@@ -192,8 +213,12 @@ def generate(
     is greedy decoding; otherwise pass ``key`` (and optionally ``top_k``)
     for stochastic sampling. ``prompt_valid`` [B, P] marks real prompt
     tokens for left-padded variable-length prompts (default: all real).
-    The whole prefill+decode runs as one compiled program, cached per
-    (config, shape, sampling) signature.
+    ``mesh`` shards the decode over its ``tp``/``fsdp`` axes (the
+    training sharding rules, parallel/sharding.py) — for models too big
+    for one device. ``stop_token`` pins a row to that token once emitted
+    (shapes stay static; truncate at the first stop token). The whole
+    prefill+decode runs as one compiled program, cached per
+    (config, shape, sampling, mesh) signature.
     """
     if prompt.ndim != 2:
         raise ValueError(f"prompt must be [batch, prompt_len]; got {prompt.shape}")
@@ -212,8 +237,12 @@ def generate(
     if prompt_valid is None:
         prompt_valid = jnp.ones((b, p), jnp.int32)
     fn = _build_generate(
-        cfg, b, p, int(max_new_tokens), float(temperature), int(top_k)
+        cfg, b, p, int(max_new_tokens), float(temperature), int(top_k), mesh,
+        None if stop_token is None else int(stop_token),
     )
+    if mesh is not None:
+        with jax.set_mesh(mesh):
+            return fn(params, prompt.astype(jnp.int32), prompt_valid, key)
     return fn(params, prompt.astype(jnp.int32), prompt_valid, key)
 
 

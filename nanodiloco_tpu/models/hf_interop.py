@@ -62,11 +62,25 @@ def from_hf_state_dict(sd: Mapping[str, Any], cfg: LlamaConfig) -> Params:
     from torch)."""
     _check_dense(cfg)
     l = cfg.num_hidden_layers
+    extra = f"model.layers.{l}.self_attn.q_proj.weight"
+    if extra in sd:
+        raise ValueError(
+            f"HF state dict has more than {l} layers (found {extra!r}); "
+            "cfg.num_hidden_layers does not match the checkpoint — "
+            "importing would silently truncate the model"
+        )
 
     def get(key):
         if key not in sd:
             raise KeyError(f"HF state dict is missing {key!r}")
         return np.asarray(sd[key], dtype=np.float32)
+
+    embed = get("model.embed_tokens.weight")
+    if embed.shape != (cfg.vocab_size, cfg.hidden_size):
+        raise ValueError(
+            f"embed_tokens shape {embed.shape} does not match config "
+            f"({cfg.vocab_size}, {cfg.hidden_size})"
+        )
 
     layers = {}
     for ours, (fmt, transpose) in _LAYER_MAP.items():
@@ -76,8 +90,7 @@ def from_hf_state_dict(sd: Mapping[str, Any], cfg: LlamaConfig) -> Params:
         layers[ours] = jnp.asarray(np.stack(ws), dtype=jnp.dtype(cfg.param_dtype))
 
     params: Params = {
-        "embed": jnp.asarray(get("model.embed_tokens.weight"),
-                             dtype=jnp.dtype(cfg.param_dtype)),
+        "embed": jnp.asarray(embed, dtype=jnp.dtype(cfg.param_dtype)),
         "layers": layers,
         "final_norm": jnp.asarray(get("model.norm.weight"),
                                   dtype=jnp.dtype(cfg.param_dtype)),

@@ -115,3 +115,40 @@ def test_left_padded_prompt_matches_unpadded():
     np.testing.assert_array_equal(
         np.asarray(padded_out[0]), np.asarray(plain_out[0])
     )
+
+
+def test_sharded_generate_matches_single_device(devices):
+    """Greedy decode with params sharded over tp x fsdp must produce the
+    same tokens as the unsharded run — the big-model (8B-class) sampling
+    path where one device cannot hold the weights."""
+    from nanodiloco_tpu.parallel import MeshConfig, build_mesh
+
+    cfg = dataclasses.replace(CFG, num_key_value_heads=2)
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, cfg.vocab_size)
+    mesh = build_mesh(MeshConfig(diloco=1, fsdp=2, tp=2), devices=devices[:4])
+    with jax.default_matmul_precision("highest"):
+        plain = generate(params, prompt, cfg, 6)
+        sharded = generate(params, prompt, cfg, 6, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(sharded))
+
+
+def test_stop_token_pins_finished_rows():
+    """Once a row emits stop_token every later position repeats it, and
+    rows that never emit it are unaffected (bit-identical to a run
+    without stop_token)."""
+    params = init_params(jax.random.key(0), CFG)
+    prompt = jax.random.randint(jax.random.key(1), (2, 4), 0, CFG.vocab_size)
+    with jax.default_matmul_precision("highest"):
+        free = generate(params, prompt, CFG, 8)
+        # choose the token row 0 emits at step 2 as the stop token; make
+        # sure row 1 never emits it in the free run, so row 1 must match
+        stop = int(free[0, 2])
+        if stop in np.asarray(free[1]).tolist():
+            stop = int(free[0, 0])  # fall back to an earlier stop
+        stopped = generate(params, prompt, CFG, 8, stop_token=stop)
+    row0 = np.asarray(stopped[0]).tolist()
+    first = row0.index(stop)
+    assert all(t == stop for t in row0[first:]), row0
+    if stop not in np.asarray(free[1]).tolist():
+        np.testing.assert_array_equal(np.asarray(stopped[1]), np.asarray(free[1]))

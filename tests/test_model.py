@@ -250,3 +250,13 @@ def test_hf_interop_rejects_moe():
     params = init_params(jax.random.key(0), cfg)
     with pytest.raises(ValueError, match="dense Llama only"):
         to_hf_state_dict(params, cfg)
+
+
+def test_hf_import_rejects_layer_count_mismatch():
+    from nanodiloco_tpu.models import from_hf_state_dict, to_hf_state_dict
+
+    cfg4 = dataclasses.replace(CFG, num_hidden_layers=4)
+    cfg2 = dataclasses.replace(CFG, num_hidden_layers=2)
+    sd = to_hf_state_dict(init_params(jax.random.key(0), cfg4), cfg4)
+    with pytest.raises(ValueError, match="more than 2 layers"):
+        from_hf_state_dict(sd, cfg2)
