@@ -89,15 +89,20 @@ def from_hf_state_dict(sd: Mapping[str, Any], cfg: LlamaConfig) -> Params:
             ws = [w.T for w in ws]
         layers[ours] = jnp.asarray(np.stack(ws), dtype=jnp.dtype(cfg.param_dtype))
 
+    # jnp.array (never jnp.asarray): on the CPU backend asarray can ALIAS
+    # the caller's numpy buffer — and torch's .numpy() shares memory with
+    # the live model, so a later in-place optimizer step over there would
+    # silently mutate these params. (The stacked layer leaves already
+    # copy via np.stack.)
     params: Params = {
-        "embed": jnp.asarray(embed, dtype=jnp.dtype(cfg.param_dtype)),
+        "embed": jnp.array(embed, dtype=jnp.dtype(cfg.param_dtype)),
         "layers": layers,
-        "final_norm": jnp.asarray(get("model.norm.weight"),
-                                  dtype=jnp.dtype(cfg.param_dtype)),
+        "final_norm": jnp.array(get("model.norm.weight"),
+                                dtype=jnp.dtype(cfg.param_dtype)),
     }
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = jnp.asarray(get("lm_head.weight").T,
-                                        dtype=jnp.dtype(cfg.param_dtype))
+        params["lm_head"] = jnp.array(get("lm_head.weight").T,
+                                      dtype=jnp.dtype(cfg.param_dtype))
     return params
 
 
