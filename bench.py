@@ -255,6 +255,36 @@ def _ensure_live_backend() -> str | None:
         _time.sleep(30)
 
 
+def run_decode() -> dict:
+    """Autoregressive decode throughput (BENCH_DECODE=1): one compiled
+    prefill+decode program (models/generate.py) on the reference model
+    architecture. Reported per NEW token — prefill is included in the
+    wall clock, so the figure is the honest end-to-end sampling rate."""
+    from nanodiloco_tpu.models import LlamaConfig, generate, init_params
+
+    b = int(os.environ.get("BENCH_DECODE_BATCH", "8"))
+    p = int(os.environ.get("BENCH_DECODE_PROMPT", "128"))
+    n = int(os.environ.get("BENCH_DECODE_TOKENS", "256"))
+    cfg = LlamaConfig(vocab_size=32000, dtype="bfloat16")
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (b, p), 0, cfg.vocab_size)
+
+    out = generate(params, prompt, cfg, n)  # compile + warm
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = generate(params, prompt, cfg, n)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "model": "llama-tiny-15M decode",
+        "batch": b, "prompt_len": p, "new_tokens": n,
+        "decode_tokens_per_sec": round(b * n / best, 1),
+        "ms_per_token_step": round(best / n * 1e3, 3),
+    }
+
+
 def main() -> None:
     from nanodiloco_tpu.models import LlamaConfig
 
@@ -329,6 +359,8 @@ def main() -> None:
         result["degraded"] = degraded
     if mid is not None:
         result["mid"] = mid
+    if os.environ.get("BENCH_DECODE") == "1":
+        result["decode"] = run_decode()
 
     print(json.dumps(result))
 
