@@ -66,8 +66,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline-parallel stages: the layer stack is "
                         "sharded over this axis and the grad-accumulation "
-                        "microbatches stream through GPipe-style "
-                        "(incompatible with --sp and streaming)")
+                        "microbatches stream through GPipe-style; composes "
+                        "with --sp (sequence-sharded stages, requires "
+                        "--attention ring) but not with streaming")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel shards for MoE models "
                         "(--num-experts via the model config JSON); "

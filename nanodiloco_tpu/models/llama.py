@@ -402,6 +402,27 @@ def causal_lm_loss_sp(
     )(params, tokens, loss_mask)
 
 
+def sp_shift_targets(
+    tokens: jax.Array, loss_mask: jax.Array, axis_name: str
+) -> tuple[jax.Array, jax.Array]:
+    """Cross-shard label shift for sequence-sharded [B, S_local] tokens:
+    the right neighbor's first token completes this shard's targets (one
+    tiny ppermute), and the GLOBAL last position — whose "target" wrapped
+    around the ring — is masked out. Returns (targets, float32 weights).
+    Shared by sp_shard_loss and the pipeline exit loss (ops/pipeline.py)
+    so the shift contract can never drift between them."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_loc = tokens.shape[1]
+    to_left = [(j, (j - 1) % n) for j in range(n)]
+    next_tok = jax.lax.ppermute(tokens[:, :1], axis_name, to_left)
+    next_m = jax.lax.ppermute(loss_mask[:, :1], axis_name, to_left)
+    targets = jnp.concatenate([tokens[:, 1:], next_tok], axis=1)
+    m = jnp.concatenate([loss_mask[:, 1:], next_m], axis=1).astype(jnp.float32)
+    is_global_last = (idx == n - 1) & (jnp.arange(s_loc) == s_loc - 1)  # [S_loc]
+    return targets, m * (1.0 - is_global_last[None].astype(jnp.float32))
+
+
 def sp_shard_loss(
     params: Params,
     tokens: jax.Array,
@@ -424,18 +445,9 @@ def sp_shard_loss(
             "routing/capacity would not match the unsharded semantics "
             "(pp and ep compose with MoE; sp does not, yet)"
         )
-    n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc = tokens.shape
-    # right neighbor's first token completes this shard's label shift
-    to_left = [(j, (j - 1) % n) for j in range(n)]
-    next_tok = jax.lax.ppermute(tokens[:, :1], axis_name, to_left)
-    next_m = jax.lax.ppermute(loss_mask[:, :1], axis_name, to_left)
-    targets = jnp.concatenate([tokens[:, 1:], next_tok], axis=1)
-    m = jnp.concatenate([loss_mask[:, 1:], next_m], axis=1).astype(jnp.float32)
-    # the global last position's "target" wrapped around the ring
-    is_global_last = (idx == n - 1) & (jnp.arange(s_loc) == s_loc - 1)  # [S_loc]
-    m = m * (1.0 - is_global_last[None].astype(jnp.float32))
+    targets, m = sp_shift_targets(tokens, loss_mask, axis_name)
 
     if cfg.loss_chunk:
         # blockwise CE on this shard's rows — long context is exactly

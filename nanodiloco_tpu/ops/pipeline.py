@@ -39,7 +39,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from nanodiloco_tpu.models.config import LlamaConfig
-from nanodiloco_tpu.models.llama import _decoder_layer, rms_norm, rope_tables
+from nanodiloco_tpu.models.llama import (
+    _decoder_layer,
+    rms_norm,
+    rope_tables,
+    sp_shift_targets,
+)
 from nanodiloco_tpu.ops.fused_ce import chunked_softmax_xent
 
 
@@ -63,6 +68,7 @@ def pp_shard_loss(
     cfg: LlamaConfig,
     loss_mask_mb: jax.Array,  # [M, B, S]
     axis_name: str = "pp",
+    sp_axis: str | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Per-stage UNREDUCED (sum_loss, n_tokens, aux_weighted,
     metric_sum): callers ``psum`` all four over ``axis_name`` (and psum
@@ -78,18 +84,39 @@ def pp_shard_loss(
     ``params`` is this stage's view: ``layers`` leaves are the local
     ``[L/P, ...]`` slice; ``embed``/``final_norm``/``lm_head`` are the
     full replicated arrays.
+
+    With ``sp_axis`` the sequence dim is additionally sharded over that
+    (manual) mesh axis: stages run ring attention over ``sp_axis``, rope
+    positions carry each shard's global offset, and the exit loss shifts
+    labels across shard boundaries with one tiny ppermute (the same
+    contract as models.llama.sp_shard_loss). sum_loss/n_tok come back
+    shard-local — callers psum them over BOTH axes. ``metric``'s VALUE is
+    already sp-uniform (reduced in-tick) but its scan-carry TYPE is still
+    sp-varying: callers must apply a value-preserving
+    ``psum(metric, sp_axis) / psum(1, sp_axis)`` to replicate its type
+    before using it in sp-replicated out_specs, then psum over
+    ``axis_name`` as usual (see Diloco._pp_inner_update).
     """
     p_idx = lax.axis_index(axis_name)
     n_stages = lax.psum(1, axis_name)
-    M, B, S = tokens_mb.shape
+    M, B, S = tokens_mb.shape  # S is the LOCAL shard length under sp
     cdt = jnp.dtype(cfg.dtype)
-    cos, sin = rope_tables(cfg, S)
+    if sp_axis is not None:
+        if cfg.attention_impl != "ring":
+            raise ValueError(
+                "pipeline + sequence parallelism requires "
+                f"attention_impl='ring'; got {cfg.attention_impl!r}"
+            )
+        sp_idx = lax.axis_index(sp_axis)
+        cos, sin = rope_tables(cfg, S, offset=sp_idx * S)
+    else:
+        cos, sin = rope_tables(cfg, S)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
 
     def layer_fn(x, layer, cos, sin, valid):
-        return _decoder_layer(cfg, x, layer, cos, sin, None, None, valid)
+        return _decoder_layer(cfg, x, layer, cos, sin, None, sp_axis, valid)
 
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
@@ -109,18 +136,22 @@ def pp_shard_loss(
 
     def mb_loss(y, t):
         """Loss of the microbatch leaving the pipe at tick t (valid only
-        on the final stage for 0 <= t-(P-1) < M)."""
+        on the final stage for 0 <= t-(P-1) < M). Returns this device's
+        shard-local (sum_loss, n_tokens)."""
         m_out = jnp.clip(t - (n_stages - 1), 0, M - 1)
         tok = lax.dynamic_index_in_dim(tokens_mb, m_out, 0, keepdims=False)
         msk = lax.dynamic_index_in_dim(loss_mask_mb, m_out, 0, keepdims=False)
         h = rms_norm(y, params["final_norm"], cfg.rms_norm_eps)
-        return _hidden_ce(
-            h[:, :-1],
-            head,
-            tok[:, 1:],
-            msk[:, 1:].astype(jnp.float32),
-            cfg.loss_chunk,
-        )
+        if sp_axis is None:
+            return _hidden_ce(
+                h[:, :-1],
+                head,
+                tok[:, 1:],
+                msk[:, 1:].astype(jnp.float32),
+                cfg.loss_chunk,
+            )
+        targets, w = sp_shift_targets(tok, msk, sp_axis)
+        return _hidden_ce(h, head, targets, w, cfg.loss_chunk)
 
     # per-microbatch token counts (the loss-shift weights), for aux
     # weighting identical to the vmap grad-accumulation path
@@ -159,10 +190,18 @@ def pp_shard_loss(
         aux_w = aux_w + pass_valid * n_here * stage_aux
         # metric accumulators mirror the vmap path's mean-of-means
         # convention: per-microbatch ce mean (last stage) + unweighted
-        # aux (every stage's layers)
+        # aux (every stage's layers). Under sp the per-microbatch mean
+        # needs the GLOBAL sum/count, so the metric term reduces over sp
+        # here (making metric sp-replicated — callers psum it over pp
+        # only); sum_loss/n_tok stay shard-local for the caller's psum.
+        sl_m, n_m = (
+            (lax.psum(sl, sp_axis), lax.psum(n, sp_axis))
+            if sp_axis is not None
+            else (sl, n)
+        )
         metric = (
             metric
-            + valid * sl / jnp.maximum(n, 1.0)
+            + valid * sl_m / jnp.maximum(n_m, 1.0)
             + coef * pass_valid * stage_aux
         )
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]

@@ -108,16 +108,16 @@ class Diloco:
                 "parallelism: the inner step runs the loss inside a manual "
                 "shard_map region"
             )
-        if self.sp > 1 and self.pp > 1:
-            raise ValueError("sp and pp cannot be combined (yet)")
         if self.pp > 1:
             if model_cfg.num_hidden_layers % self.pp:
                 raise ValueError(
                     f"num_hidden_layers {model_cfg.num_hidden_layers} must "
                     f"divide evenly into {self.pp} pipeline stages"
                 )
-            if model_cfg.attention_impl == "ring":
-                raise ValueError("pp > 1 requires attention dense or flash")
+            if self.sp > 1 and model_cfg.attention_impl != "ring":
+                raise ValueError("pp + sp requires attention ring")
+            if self.sp == 1 and model_cfg.attention_impl == "ring":
+                raise ValueError("pp without sp requires attention dense or flash")
         if model_cfg.num_experts and self.sp > 1:
             raise ValueError(
                 "MoE is not supported under sequence parallelism: per-shard "
@@ -300,10 +300,10 @@ class Diloco:
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss_sum / accum
 
-        if self.sp > 1:
-            params, inner_opt_state, loss = self._sp_inner_update(state, tokens, loss_mask)
-        elif self.pp > 1:
+        if self.pp > 1:  # handles sp>1 too (sequence-sharded pipeline)
             params, inner_opt_state, loss = self._pp_inner_update(state, tokens, loss_mask)
+        elif self.sp > 1:
+            params, inner_opt_state, loss = self._sp_inner_update(state, tokens, loss_mask)
         else:
             params, inner_opt_state, loss = jax.vmap(worker_update)(
                 state.params, state.inner_opt_state, tokens, loss_mask
@@ -431,21 +431,33 @@ class Diloco:
         from nanodiloco_tpu.ops.pipeline import pp_shard_loss
 
         clip = self.cfg.clip_norm
+        sp_axis = "sp" if self.sp > 1 else None
 
         def body(params_w, opt_w, tok_w, mask_w):
             params = jax.tree.map(lambda x: x[0], params_w)
             opt_state = jax.tree.map(lambda x: x[0], opt_w)
-            w_tokens, w_mask = tok_w[0], mask_w[0]  # [accum(M), B, S]
+            w_tokens, w_mask = tok_w[0], mask_w[0]  # [accum(M), B, S(_loc)]
 
             coef = self.model_cfg.router_aux_coef
             accum = w_tokens.shape[0]
 
             def sum_loss_fn(p):
                 sl, n, aux_w, metric = pp_shard_loss(
-                    p, w_tokens, self.model_cfg, w_mask, "pp"
+                    p, w_tokens, self.model_cfg, w_mask, "pp", sp_axis=sp_axis
                 )
                 sl = jax.lax.psum(sl, "pp")
                 n = jax.lax.psum(n, "pp")
+                if sp_axis is not None:
+                    # shard-local sums combine over the sequence shards.
+                    # metric's VALUE is already sp-uniform (pipeline.py
+                    # reduces it in-tick) but its scan-carry TYPE is still
+                    # varying-over-sp; the psum/size mean keeps the value
+                    # and makes the type replicated for the out_specs.
+                    sl = jax.lax.psum(sl, sp_axis)
+                    n = jax.lax.psum(n, sp_axis)
+                    metric = jax.lax.psum(metric, sp_axis) / jax.lax.psum(
+                        1, sp_axis
+                    )
                 # token-weighted router aux, exactly as the vmap grad-
                 # accumulation path weights it (zero for dense models)
                 aux_w = jax.lax.psum(aux_w, "pp")
@@ -461,6 +473,10 @@ class Diloco:
                     lambda x: jax.lax.psum(x, "pp"), v))
                 for k, v in g.items()
             }
+            if sp_axis is not None:
+                # every shard saw only its sequence slice of the SUM loss:
+                # grads combine over sp for ALL leaves
+                g = jax.tree.map(lambda x: jax.lax.psum(x, sp_axis), g)
             grads = jax.tree.map(lambda x: x / jnp.maximum(n, 1e-9), g)
             if clip is not None:
                 sq_layers = sum(
@@ -493,13 +509,15 @@ class Diloco:
         opt_spec = self._pp_state_spec(
             state.inner_opt_state, param_spec, pstruct
         )
-        bspec = P("diloco")
+        # [W, M, B, S]: sequence over sp when present, B/fsdp/tp left auto
+        bspec = P("diloco", None, None, "sp") if sp_axis else P("diloco")
+        axis_names = {"diloco", "pp", "sp"} if sp_axis else {"diloco", "pp"}
         params, inner_opt_state, loss = jax.shard_map(
             body,
             mesh=self.mesh,
             in_specs=(param_spec, opt_spec, bspec, bspec),
             out_specs=(param_spec, opt_spec, P("diloco")),
-            axis_names={"diloco", "pp"},
+            axis_names=axis_names,
         )(state.params, state.inner_opt_state, tokens, loss_mask)
         return params, inner_opt_state, loss
 
