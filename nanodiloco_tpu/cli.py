@@ -237,6 +237,9 @@ def build_generate_parser() -> argparse.ArgumentParser:
                    help="checkpoint step to load (default: latest)")
     p.add_argument("--tokenizer", type=str, default=None,
                    help="override the tokenizer recorded at training time")
+    p.add_argument("--stop-at-eos", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="end the continuation at the tokenizer's EOS token")
     p.add_argument("--force-cpu-devices", type=int, default=None, metavar="N",
                    help="run on N virtual CPU devices instead of the "
                         "accelerator (e.g. sample on CPU while the chip "
@@ -287,12 +290,17 @@ def generate_main(argv: list[str]) -> None:
             f"({model_cfg.vocab_size}); pass the training --tokenizer"
         )
     prompt = jnp.asarray([ids], jnp.int32)
+    stop = getattr(tokenizer, "eos_id", None) if args.stop_at_eos else None
     out = generate(
         params, prompt, model_cfg, args.max_new_tokens,
         temperature=args.temperature, top_k=args.top_k,
         key=jax.random.key(args.seed),
+        stop_token=stop,
     )
-    text = tokenizer.decode([int(t) for t in out[0]])
+    ids_out = [int(t) for t in out[0]]
+    if stop is not None and stop in ids_out:
+        ids_out = ids_out[: ids_out.index(stop)]
+    text = tokenizer.decode(ids_out)
     print(args.prompt + text)
 
 

@@ -107,6 +107,14 @@ def pp_shard_loss(
                 "pipeline + sequence parallelism requires "
                 f"attention_impl='ring'; got {cfg.attention_impl!r}"
             )
+        if cfg.num_experts:
+            # mirrors sp_shard_loss: per-shard routing/capacity (and the
+            # shard-local aux token weighting here) would not match the
+            # unsharded semantics
+            raise ValueError(
+                "MoE is not supported under sequence parallelism "
+                "(pp and ep compose with MoE; sp does not, yet)"
+            )
         sp_idx = lax.axis_index(sp_axis)
         cos, sin = rope_tables(cfg, S, offset=sp_idx * S)
     else:

@@ -40,7 +40,7 @@ def pytest_collection_modifyitems(config, items):
     user asked for them — via ``-m`` or an explicit ``::`` node id."""
     if config.getoption("-m") or any("::" in a for a in config.args):
         return
-    skip = pytest.mark.skip(reason='slow parity test; run with -m "" or by node id')
+    skip = pytest.mark.skip(reason="slow parity test; run with -m slow or by node id")
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
