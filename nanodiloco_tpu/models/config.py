@@ -50,6 +50,16 @@ class LlamaConfig:
     num_experts_per_tok: int = 2
     expert_capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
+    # "tokens_choose": Switch-style top-k experts per token + load-balance
+    # aux loss. "experts_choose": each expert picks its top-C tokens
+    # (arXiv:2202.09368) — perfectly load-balanced by construction, no
+    # aux loss, but token selection sees the whole (batch, sequence) set,
+    # so training is not strictly causal and autoregressive decode is
+    # unsupported. Both modes size the per-expert capacity as
+    # C = ceil(num_experts_per_tok * T / E * capacity_factor): in
+    # expert-choice, num_experts_per_tok is the AVERAGE number of experts
+    # per token (set 1 for Switch-equivalent compute).
+    router_type: str = "tokens_choose"
 
     @property
     def head_dim(self) -> int:
@@ -72,6 +82,11 @@ class LlamaConfig:
             raise ValueError(
                 f"remat_policy must be 'nothing' or 'dots'; got "
                 f"{self.remat_policy!r}"
+            )
+        if self.router_type not in ("tokens_choose", "experts_choose"):
+            raise ValueError(
+                f"router_type must be 'tokens_choose' or 'experts_choose'; "
+                f"got {self.router_type!r}"
             )
         if self.num_experts and self.num_experts_per_tok > self.num_experts:
             raise ValueError(

@@ -222,6 +222,13 @@ def generate(
     """
     if prompt.ndim != 2:
         raise ValueError(f"prompt must be [batch, prompt_len]; got {prompt.shape}")
+    if cfg.num_experts and cfg.router_type == "experts_choose":
+        raise ValueError(
+            "expert-choice routing is training-only: expert top-C token "
+            "selection sees the whole token set, so prefill and per-step "
+            "decode route differently (arXiv:2202.09368's known "
+            "acausality); use router_type='tokens_choose' for sampling"
+        )
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1; got {max_new_tokens}")
     if temperature < 0.0:

@@ -36,6 +36,33 @@ def expert_capacity(cfg: LlamaConfig, n_tokens: int) -> int:
     return max(1, math.ceil(n_tokens * k / e * cfg.expert_capacity_factor))
 
 
+def _experts_choose(
+    cfg: LlamaConfig, x: jax.Array, probs: jax.Array, layer: dict,
+    valid_t: jax.Array | None,
+) -> tuple[jax.Array, jax.Array]:
+    """Expert-choice routing (arXiv:2202.09368): each expert selects its
+    top-C tokens by router affinity — every expert processes exactly C
+    slots (perfect load balance by construction, no auxiliary loss). A
+    token may be picked by several experts (contributions sum) or by
+    none (the residual stream carries it). x: [T, d]; probs: [T, E]
+    router affinities; valid_t: [T] or None. Returns (y [T, d], aux 0.0)."""
+    t, d = x.shape
+    cap = min(expert_capacity(cfg, t), t)  # an expert can't pick a token twice
+    cdt = x.dtype
+    if valid_t is not None:
+        # pad tokens: zero affinity — sorted last by top_k, and a zero
+        # combine weight even when slots outnumber real tokens
+        probs = probs * valid_t.astype(jnp.float32)[:, None]
+    g, idx = jax.lax.top_k(jnp.swapaxes(probs, 0, 1), cap)  # [E, C]
+    disp = jax.nn.one_hot(idx, t, dtype=cdt)                # [E, C, T]
+    expert_in = jnp.einsum("ect,td->ecd", disp, x)
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"].astype(cdt)))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"].astype(cdt))
+    out_e = jnp.einsum("ecf,efd->ecd", gate * up, layer["w_down"].astype(cdt))
+    y = jnp.einsum("ect,ec,ecd->td", disp, g.astype(cdt), out_e)
+    return y, jnp.zeros((), jnp.float32)
+
+
 def moe_mlp(
     cfg: LlamaConfig, h: jax.Array, layer: dict, valid: jax.Array | None = None
 ) -> tuple[jax.Array, jax.Array]:
@@ -43,16 +70,23 @@ def moe_mlp(
     [d, E] and expert FFN weights ``w_gate``/``w_up`` [E, d, f],
     ``w_down`` [E, f, d]; ``valid`` [B, S] 0/1 marks real tokens —
     padding claims no expert capacity and is excluded from the aux-loss
-    statistics. Returns (mlp_out [B, S, d], aux_loss scalar)."""
+    statistics. Returns (mlp_out [B, S, d], aux_loss scalar). Routing is
+    Switch-style top-k per token, or expert-choice with
+    ``cfg.router_type == "experts_choose"``."""
     b, s, d = h.shape
     e, k = cfg.num_experts, cfg.num_experts_per_tok
     cdt = h.dtype
     x = h.reshape(b * s, d)
     t = b * s
-    cap = expert_capacity(cfg, t)
 
     logits = (x @ layer["router"].astype(cdt)).astype(jnp.float32)  # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
+    if cfg.router_type == "experts_choose":
+        y, aux = _experts_choose(
+            cfg, x, probs, layer, None if valid is None else valid.reshape(t)
+        )
+        return y.reshape(b, s, d), aux
+    cap = expert_capacity(cfg, t)
     topk_p, topk_e = jax.lax.top_k(probs, k)                        # [T, k]
     topk_p = topk_p / jnp.sum(topk_p, axis=-1, keepdims=True)
 

@@ -198,3 +198,76 @@ def test_k_exceeding_experts_rejected():
     with pytest.raises(ValueError, match="cannot exceed num_experts"):
         LlamaConfig(**{**MOE.to_dict(), "num_experts": 1,
                        "num_experts_per_tok": 2})
+
+
+EC = LlamaConfig(**{**MOE.to_dict(), "router_type": "experts_choose",
+                    "num_experts_per_tok": 1})
+
+
+def test_expert_choice_single_ample_expert_equals_dense_mlp():
+    """E=1 with capacity >= T: the one expert picks every token with
+    combine weight softmax-over-1 == 1, reducing exactly to the dense
+    SwiGLU MLP."""
+    from nanodiloco_tpu.models.moe import moe_mlp
+
+    cfg = LlamaConfig(**{**EC.to_dict(), "num_experts": 1,
+                         "expert_capacity_factor": 2.0})
+    key = jax.random.key(3)
+    h = jax.random.normal(key, (2, 8, 32), jnp.float32)
+    w_gate = jax.random.normal(jax.random.key(4), (1, 32, 64)) * 0.05
+    w_up = jax.random.normal(jax.random.key(5), (1, 32, 64)) * 0.05
+    w_down = jax.random.normal(jax.random.key(6), (1, 64, 32)) * 0.05
+    layer = {"router": jnp.zeros((32, 1)), "w_gate": w_gate,
+             "w_up": w_up, "w_down": w_down}
+    with jax.default_matmul_precision("highest"):
+        y, aux = moe_mlp(cfg, h, layer)
+        gate = jax.nn.silu(h @ w_gate[0])
+        dense = (gate * (h @ w_up[0])) @ w_down[0]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(dense), rtol=2e-6, atol=2e-7)
+    assert float(aux) == 0.0
+
+
+def test_expert_choice_pads_get_zero_update():
+    from nanodiloco_tpu.models.moe import moe_mlp
+
+    params = init_params(jax.random.key(0), EC)
+    h = jax.random.normal(jax.random.key(1), (1, 8, 32), jnp.float32)
+    valid = jnp.ones((1, 8), jnp.int32).at[0, 5:].set(0)
+    layer = jax.tree.map(lambda x: x[0], params["layers"])
+    layer = {k: layer[k] for k in ("router", "w_gate", "w_up", "w_down")}
+    y, _ = moe_mlp(EC, h, layer, valid=valid)
+    np.testing.assert_array_equal(np.asarray(y[0, 5:]), 0.0)
+    assert float(jnp.abs(y[0, :5]).sum()) > 0
+
+
+def test_expert_choice_ep_round_matches_unsharded(devices):
+    cfg = DilocoConfig(num_workers=2, inner_steps=2, warmup_steps=1,
+                       total_steps=10, lr=1e-3, grad_accum=2)
+    tok = jax.random.randint(jax.random.key(11), (2, 2, 2, 16), 0, EC.vocab_size)
+    mask = jnp.ones_like(tok)
+    results = []
+    with jax.default_matmul_precision("highest"):
+        for mc in [MeshConfig(diloco=2, ep=2), MeshConfig()]:
+            dl = Diloco(EC, cfg, build_mesh(mc))
+            state = dl.init_state(jax.random.key(0))
+            state, loss = dl.inner_step(state, tok, mask)
+            state = dl.outer_step(state)
+            results.append(
+                (jax.tree.map(np.asarray, state.snapshot), np.asarray(loss))
+            )
+    (snap_a, loss_a), (snap_c, loss_c) = results
+    np.testing.assert_allclose(loss_a, loss_c, rtol=1e-4)
+    assert tree_max_diff(snap_a, snap_c) < 1e-4
+
+
+def test_expert_choice_decode_rejected():
+    from nanodiloco_tpu.models import generate
+
+    params = init_params(jax.random.key(0), EC)
+    with pytest.raises(ValueError, match="training-only"):
+        generate(params, jnp.zeros((1, 4), jnp.int32), EC, 2)
+
+
+def test_router_type_validated():
+    with pytest.raises(ValueError, match="router_type"):
+        LlamaConfig(router_type="top2")
