@@ -56,9 +56,10 @@ class LlamaConfig:
     # aux loss, but token selection sees the whole (batch, sequence) set,
     # so training is not strictly causal and autoregressive decode is
     # unsupported. Both modes size the per-expert capacity as
-    # C = ceil(num_experts_per_tok * T / E * capacity_factor): in
-    # expert-choice, num_experts_per_tok is the AVERAGE number of experts
-    # per token (set 1 for Switch-equivalent compute).
+    # C = ceil(num_experts_per_tok * T / E * capacity_factor) — clamped
+    # to T in expert-choice (an expert cannot pick a token twice): there,
+    # num_experts_per_tok is the AVERAGE number of experts per token
+    # (set 1 for Switch-equivalent compute).
     router_type: str = "tokens_choose"
 
     @property

@@ -56,11 +56,18 @@ def _experts_choose(
     g, idx = jax.lax.top_k(jnp.swapaxes(probs, 0, 1), cap)  # [E, C]
     disp = jax.nn.one_hot(idx, t, dtype=cdt)                # [E, C, T]
     expert_in = jnp.einsum("ect,td->ecd", disp, x)
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"].astype(cdt)))
-    up = jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"].astype(cdt))
-    out_e = jnp.einsum("ecf,efd->ecd", gate * up, layer["w_down"].astype(cdt))
+    out_e = _expert_ffn(expert_in, layer)
     y = jnp.einsum("ect,ec,ecd->td", disp, g.astype(cdt), out_e)
     return y, jnp.zeros((), jnp.float32)
+
+
+def _expert_ffn(expert_in: jax.Array, layer: dict) -> jax.Array:
+    """Per-expert SwiGLU over dispatched slots [E, C, d] -> [E, C, d] —
+    the one FFN body both router types share."""
+    cdt = expert_in.dtype
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"].astype(cdt)))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"].astype(cdt))
+    return jnp.einsum("ecf,efd->ecd", gate * up, layer["w_down"].astype(cdt))
 
 
 def moe_mlp(
@@ -112,9 +119,7 @@ def moe_mlp(
     expert_in = jnp.einsum(
         "tec,td->ecd", dispatch.astype(cdt), x
     )                                                                # [E, C, d]
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"].astype(cdt)))
-    up = jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"].astype(cdt))
-    out_e = jnp.einsum("ecf,efd->ecd", gate * up, layer["w_down"].astype(cdt))
+    out_e = _expert_ffn(expert_in, layer)
     y = jnp.einsum("tec,ecd->td", combine.astype(cdt), out_e)
 
     # Switch load-balance loss on the top-1 assignment (pre-capacity),

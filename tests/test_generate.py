@@ -152,3 +152,17 @@ def test_stop_token_pins_finished_rows():
     assert all(t == stop for t in row0[first:]), row0
     if stop not in np.asarray(free[1]).tolist():
         np.testing.assert_array_equal(np.asarray(stopped[1]), np.asarray(free[1]))
+
+
+def test_generate_bf16_smoke():
+    """The TPU compute dtype path: bf16 decode runs end-to-end and emits
+    valid in-range int32 tokens. This pins the dtype PLUMBING only; the
+    fp32 teacher-forcing tests pin decode/training parity (bf16 rounding
+    can legitimately flip near-tie argmaxes between the cached and
+    uncached paths)."""
+    cfg = dataclasses.replace(CFG, dtype="bfloat16")
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, cfg.vocab_size)
+    out = generate(params, prompt, cfg, 6)
+    assert out.shape == (2, 6) and out.dtype == jnp.int32
+    assert ((np.asarray(out) >= 0) & (np.asarray(out) < cfg.vocab_size)).all()
