@@ -253,33 +253,16 @@ def generate_main(argv: list[str]) -> None:
         from nanodiloco_tpu.utils import force_virtual_cpu_devices
 
         force_virtual_cpu_devices(args.force_cpu_devices)
-    import os
-
     import jax
     import jax.numpy as jnp
 
     from nanodiloco_tpu.data import get_tokenizer
     from nanodiloco_tpu.models import generate
-    from nanodiloco_tpu.training.checkpoint import CheckpointManager
 
-    sidecar_path = os.path.join(args.checkpoint_dir, "model_config.json")
-    try:
-        with open(sidecar_path) as f:
-            sidecar = json.load(f)
-    except FileNotFoundError:
-        raise SystemExit(
-            f"no model_config.json in {args.checkpoint_dir}: generation needs "
-            "a checkpoint written by this framework's training loop"
-        )
-    model_cfg = LlamaConfig.from_dict(sidecar["model"])
+    model_cfg, sidecar, params = _load_checkpoint_snapshot(
+        args.checkpoint_dir, args.step
+    )
     tokenizer = get_tokenizer(args.tokenizer or sidecar.get("tokenizer"))
-
-    ckpt = CheckpointManager(args.checkpoint_dir)
-    # only the merged global model — NOT the per-worker params/optimizer
-    # moments, which at scale would not fit the single sampling device
-    state = ckpt.restore_raw(args.step, only={"snapshot"})
-    ckpt.close()
-    params = state["snapshot"]
 
     ids = tokenizer.encode(args.prompt)
     if not ids:
@@ -304,12 +287,88 @@ def generate_main(argv: list[str]) -> None:
     print(args.prompt + text)
 
 
+def _load_checkpoint_snapshot(checkpoint_dir: str, step: int | None):
+    """(model_cfg, sidecar dict, snapshot params) from a self-describing
+    checkpoint — only the merged global model is materialized, NOT the
+    per-worker params/optimizer moments, which at scale would not fit
+    one device. Shared by the generate and export-hf subcommands."""
+    import os
+
+    from nanodiloco_tpu.training.checkpoint import CheckpointManager
+
+    sidecar_path = os.path.join(checkpoint_dir, "model_config.json")
+    try:
+        with open(sidecar_path) as f:
+            sidecar = json.load(f)
+    except FileNotFoundError:
+        raise SystemExit(
+            f"no model_config.json in {checkpoint_dir}: this command needs "
+            "a checkpoint written by this framework's training loop"
+        )
+    model_cfg = LlamaConfig.from_dict(sidecar["model"])
+    ckpt = CheckpointManager(checkpoint_dir)
+    state = ckpt.restore_raw(step, only={"snapshot"})
+    ckpt.close()
+    return model_cfg, sidecar, state["snapshot"]
+
+
+def export_hf_main(argv: list[str]) -> None:
+    """Export a trained checkpoint's merged snapshot as an HF-layout
+    safetensors file (+ config.json), consumable by
+    ``transformers.LlamaForCausalLM.from_pretrained``."""
+    p = argparse.ArgumentParser(prog="nanodiloco_tpu export-hf")
+    p.add_argument("--checkpoint-dir", type=str, required=True)
+    p.add_argument("--out", type=str, required=True,
+                   help="output directory for model.safetensors + config.json")
+    p.add_argument("--step", type=int, default=None)
+    p.add_argument("--force-cpu-devices", type=int, default=None, metavar="N")
+    args = p.parse_args(argv)
+    if args.force_cpu_devices:
+        from nanodiloco_tpu.utils import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(args.force_cpu_devices)
+    import os
+
+    from nanodiloco_tpu.models import to_hf_state_dict
+
+    model_cfg, _sidecar, snapshot = _load_checkpoint_snapshot(
+        args.checkpoint_dir, args.step
+    )
+    sd = to_hf_state_dict(snapshot, model_cfg)
+
+    os.makedirs(args.out, exist_ok=True)
+    from safetensors.numpy import save_file
+
+    save_file(sd, os.path.join(args.out, "model.safetensors"))
+    hf_config = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "vocab_size": model_cfg.vocab_size,
+        "hidden_size": model_cfg.hidden_size,
+        "intermediate_size": model_cfg.intermediate_size,
+        "num_attention_heads": model_cfg.num_attention_heads,
+        "num_key_value_heads": model_cfg.kv_heads,
+        "num_hidden_layers": model_cfg.num_hidden_layers,
+        "rms_norm_eps": model_cfg.rms_norm_eps,
+        "rope_theta": model_cfg.rope_theta,
+        "max_position_embeddings": model_cfg.max_position_embeddings,
+        "tie_word_embeddings": model_cfg.tie_word_embeddings,
+        "torch_dtype": "float32",
+    }
+    with open(os.path.join(args.out, "config.json"), "w") as f:
+        json.dump(hf_config, f, indent=1)
+    print(f"exported {len(sd)} tensors to {args.out}")
+
+
 def main(argv: list[str] | None = None) -> None:
     import sys
 
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "generate":
         generate_main(argv[1:])
+        return
+    if argv and argv[0] == "export-hf":
+        export_hf_main(argv[1:])
         return
     print("Training DiLoCo with nanodiloco_tpu...")  # ≡ ref main.py:134
     args = build_parser().parse_args(argv)

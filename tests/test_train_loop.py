@@ -270,3 +270,29 @@ def test_generate_cli_from_checkpoint(tmp_path, capsys):
     # the full captured output, not a line split of it
     out = capsys.readouterr().out
     assert "ab" in out and len(out.strip()) > 2
+
+
+def test_export_hf_cli_roundtrip(tmp_path, capsys):
+    """Train -> export-hf -> transformers.from_pretrained loads it and
+    produces the same logits as our forward on the snapshot."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from nanodiloco_tpu.cli import main as cli_main
+    from nanodiloco_tpu.models import forward
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    out_dir = str(tmp_path / "hf")
+    summary = train(small_cfg(tmp_path, checkpoint_dir=ckpt_dir))
+    cli_main(["export-hf", "--checkpoint-dir", ckpt_dir, "--out", out_dir])
+    assert "exported" in capsys.readouterr().out
+
+    hf = transformers.LlamaForCausalLM.from_pretrained(out_dir).eval()
+    snapshot = summary["state"].snapshot
+    tokens = np.random.default_rng(0).integers(0, SMALL_MODEL.vocab_size,
+                                               size=(2, 16))
+    with torch.no_grad():
+        hf_logits = hf(input_ids=torch.tensor(tokens)).logits.numpy()
+    with jax.default_matmul_precision("highest"):
+        ours = np.asarray(forward(snapshot, jax.numpy.asarray(tokens), SMALL_MODEL))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
