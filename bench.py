@@ -219,40 +219,17 @@ def _run_mid_subprocess() -> dict:
 
 
 def _ensure_live_backend() -> str | None:
-    """Guard against a wedged accelerator claim: a killed client can leave
-    the tunneled TPU's server-side claim stuck, after which EVERY backend
-    init in every process blocks forever (observed twice on this host).
-    Probe ``jax.devices()`` in a child with a timeout, retrying up to
-    BENCH_CLAIM_WAIT_S (default 900 s) for the claim to clear; if it never
-    does, force this process onto CPU (the probe children blocked, so our
-    own backend is still uninitialized and reconfigurable) and return a
-    reason string for the output JSON — a degraded-but-honest measurement
-    beats a driver-level hang recorded as total failure."""
-    import subprocess
-    import time as _time
+    """Guard against a wedged accelerator claim (see
+    nanodiloco_tpu.utils.ensure_live_backend): retry up to
+    BENCH_CLAIM_WAIT_S (default 900 s) for the claim to clear, then
+    measure on CPU with a reason string for the output JSON — a
+    degraded-but-honest measurement beats a driver-level hang recorded
+    as total failure."""
+    from nanodiloco_tpu.utils import ensure_live_backend
 
-    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-        return None  # explicit CPU run: nothing to probe
-    deadline = _time.monotonic() + int(os.environ.get("BENCH_CLAIM_WAIT_S", "900"))
-    reason = None
-    while True:
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                capture_output=True, timeout=120,
-            )
-            if probe.returncode == 0:
-                return None
-            # fast failure (e.g. tunnel down): same degrade path as a
-            # hang — erroring out with no JSON defeats the guard's point
-            reason = "accelerator backend init failed; measured on CPU"
-        except subprocess.TimeoutExpired:
-            reason = "accelerator backend init blocked (stuck claim); measured on CPU"
-        if _time.monotonic() > deadline:
-            jax.config.update("jax_platforms", "cpu")
-            os.environ["JAX_PLATFORMS"] = "cpu"  # children follow suit
-            return reason
-        _time.sleep(30)
+    return ensure_live_backend(
+        wait_s=int(os.environ.get("BENCH_CLAIM_WAIT_S", "900"))
+    )
 
 
 def run_decode() -> dict:

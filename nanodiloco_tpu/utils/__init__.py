@@ -1,7 +1,13 @@
 from nanodiloco_tpu.utils.utils import (
     create_run_name,
+    ensure_live_backend,
     force_virtual_cpu_devices,
     set_seed_all,
 )
 
-__all__ = ["create_run_name", "force_virtual_cpu_devices", "set_seed_all"]
+__all__ = [
+    "create_run_name",
+    "ensure_live_backend",
+    "force_virtual_cpu_devices",
+    "set_seed_all",
+]

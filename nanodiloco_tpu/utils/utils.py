@@ -8,6 +8,7 @@ itself a reproducibility upgrade over the torch stack (ref utils.py:11-15).
 
 from __future__ import annotations
 
+import os
 import random
 import uuid
 from datetime import datetime
@@ -43,6 +44,66 @@ def force_virtual_cpu_devices(n: int, strict: bool = True) -> bool:
             )
         return False
     return True
+
+
+def ensure_live_backend(
+    wait_s: int = 0, probe_timeout: int = 120, n_cpu_devices: int = 1
+) -> str | None:
+    """Guard against a wedged accelerator claim: a client killed
+    mid-compile can leave the tunneled chip's server-side claim stuck,
+    after which EVERY backend init in EVERY process blocks forever
+    (PERF.md). Probe ``jax.devices()`` in a child with a timeout,
+    retrying until ``wait_s`` elapses; if the accelerator stays blocked
+    (or errors), reconfigure THIS process to ``n_cpu_devices`` virtual
+    CPU devices and set JAX_PLATFORMS=cpu so children follow suit.
+
+    Returns a reason string when degraded, None when the backend is live.
+    Must run before anything initializes a backend in this process. The
+    probe child is interrupted SIGINT-first with a grace period — a
+    SIGKILL mid-init is exactly the event that wedges a healthy claim.
+    """
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return None
+    deadline = time.monotonic() + wait_s
+    reason = None
+    last_err = b""
+    while True:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        try:
+            _, err = proc.communicate(timeout=probe_timeout)
+            if proc.returncode == 0:
+                return None
+            reason = "accelerator backend init failed; using CPU"
+            last_err = err
+        except subprocess.TimeoutExpired:
+            proc.send_signal(signal.SIGINT)
+            try:
+                proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
+            reason = "accelerator backend init blocked (stuck claim); using CPU"
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(30)
+    if not force_virtual_cpu_devices(n_cpu_devices, strict=False):
+        print(
+            f"[nanodiloco] warning: {reason}, but a backend is already "
+            "initialized in this process; proceeding on its devices. Probe "
+            f"stderr: {last_err.decode(errors='replace')[-200:]}",
+            file=sys.stderr,
+        )
+        return reason
+    os.environ["JAX_PLATFORMS"] = "cpu"  # children must not re-probe/hang
+    return reason
 
 
 def create_run_name(
