@@ -370,19 +370,27 @@ def main(argv: list[str] | None = None) -> None:
     if argv and argv[0] == "export-hf":
         export_hf_main(argv[1:])
         return
-    print("Training DiLoCo with nanodiloco_tpu...")  # ≡ ref main.py:134
     args = build_parser().parse_args(argv)
     if args.force_cpu_devices:
         from nanodiloco_tpu.utils import force_virtual_cpu_devices
 
         force_virtual_cpu_devices(args.force_cpu_devices)
+    # rank-0-only console, same gate as train()'s notices: on a pod every
+    # host runs main(). Checked only after the device setup above — the
+    # process index initializes the backend.
+    import jax
+
+    rank0 = jax.process_index() == 0
+    if rank0:
+        print("Training DiLoCo with nanodiloco_tpu...")  # ≡ ref main.py:134
     summary = train(config_from_args(args))
     sync_s, share = summary["avg_sync_time_s"], summary["comm_share"]
-    print(
-        f"Training completed! final_loss={summary['final_loss']:.4f} "
-        f"avg_sync={'n/a' if sync_s is None else f'{sync_s * 1e3:.1f}ms'} "
-        f"comm_share={'n/a' if share is None else f'{share:.2%}'}"
-    )
+    if rank0:
+        print(
+            f"Training completed! final_loss={summary['final_loss']:.4f} "
+            f"avg_sync={'n/a' if sync_s is None else f'{sync_s * 1e3:.1f}ms'} "
+            f"comm_share={'n/a' if share is None else f'{share:.2%}'}"
+        )
 
 
 if __name__ == "__main__":

@@ -62,10 +62,25 @@ class MetricsLogger:
         wandb_project: str = "nano-diloco",
         config: dict | None = None,
         quiet: bool = False,
+        process_index: int | None = None,
     ) -> None:
         self.run_name = run_name
         self.quiet = quiet
+        if process_index is None:
+            import jax
+
+            process_index = jax.process_index()
+        # Every sink is rank-0-only: on a pod, N unguarded processes mean
+        # N wandb runs, N JSONL files, and N interleaved stdout streams
+        # for one job — the bug class the reference half-has (wandb.init
+        # on global rank 0 but wandb.log on each node's local rank 0,
+        # ref main.py:71-73,118-127). process_index is injectable so the
+        # gating is testable without a real pod.
+        self.is_writer = process_index == 0
         self._file = None
+        if not self.is_writer:
+            self._wandb = None
+            return
         if out_dir:
             os.makedirs(out_dir, exist_ok=True)
             self.path = os.path.join(out_dir, f"{run_name}.jsonl")
@@ -81,6 +96,8 @@ class MetricsLogger:
                 self._wandb = None  # wandb missing/offline: JSONL remains
 
     def log(self, metrics: dict[str, Any], step: int | None = None) -> None:
+        if not self.is_writer:
+            return
         rec = dict(metrics)
         if step is not None:
             rec["step"] = step

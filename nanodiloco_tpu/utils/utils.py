@@ -121,3 +121,31 @@ def create_run_name(
     if is_debug:
         parts.insert(0, "debug")
     return "_".join(parts) + f"_{str(uuid.uuid4())[:8]}"
+
+
+def resolve_run_name(local_name: str, max_len: int = 128) -> str:
+    """Make every host in a multi-process job agree on ONE run name.
+
+    ``create_run_name`` embeds a per-process timestamp and uuid, so on a
+    pod each host would derive a different name — N wandb runs and N
+    JSONL files for one job. The reference has the same divergence
+    (per-rank uuid name, ref utils.py:18-39) and only dodges it by
+    initializing wandb on rank 0 (ref main.py:71-73) while still calling
+    ``wandb.log`` on every node's local rank 0 (ref main.py:118-127), a
+    latent crash. Here the fix is structural: broadcast process 0's name
+    bytes to all hosts, so agreement holds by construction.
+
+    Single-process (and the virtual-device test meshes): pass-through.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return local_name
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(max_len, np.uint8)
+    enc = local_name.encode()[:max_len]
+    buf[: len(enc)] = np.frombuffer(enc, np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return bytes(out).rstrip(b"\x00").decode(errors="replace")
