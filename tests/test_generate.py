@@ -166,3 +166,46 @@ def test_generate_bf16_smoke():
     out = generate(params, prompt, cfg, 6)
     assert out.shape == (2, 6) and out.dtype == jnp.int32
     assert ((np.asarray(out) >= 0) & (np.asarray(out) < cfg.vocab_size)).all()
+
+
+def test_blockwise_decode_matches_dense():
+    """decode_block tiles the cache with the online-softmax recurrence
+    (VERDICT r2 weak #5); greedy tokens must match the dense path exactly,
+    including with left-padded prompts and GQA, and the block-aligned
+    cache round-up (13+7=20 -> 32 slots at block 8) must be invisible."""
+    cfg = dataclasses.replace(CFG, num_key_value_heads=2)
+    params = init_params(jax.random.key(0), cfg)
+    prompt, valid = pad_prompts([[5, 9, 2, 11, 3], [7, 1]], pad_id=0)
+    prompt = jnp.pad(prompt, ((0, 0), (8, 0)))  # P=13: not block-aligned
+    valid = jnp.pad(valid, ((0, 0), (8, 0)))
+    with jax.default_matmul_precision("highest"):
+        dense = generate(params, prompt, cfg, 7, prompt_valid=valid,
+                         decode_block=0)
+        blockwise = generate(params, prompt, cfg, 7, prompt_valid=valid,
+                             decode_block=8)
+    np.testing.assert_array_equal(np.asarray(dense), np.asarray(blockwise))
+
+
+def test_blockwise_decode_matches_training_forward_long():
+    """Long-context smoke: a cache larger than one block, verified against
+    the training forward (the gold parity), on the blockwise path."""
+    cfg = dataclasses.replace(CFG, max_position_embeddings=256)
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (2, 100), 0, cfg.vocab_size)
+    with jax.default_matmul_precision("highest"):
+        out = generate(params, prompt, cfg, 30, decode_block=32)
+        full = jnp.concatenate([prompt, out], axis=1)
+        logits = forward(params, full, cfg)
+    for i in range(30):
+        expect = jnp.argmax(logits[:, 99 + i], axis=-1)
+        np.testing.assert_array_equal(np.asarray(out[:, i]), np.asarray(expect))
+
+
+def test_decode_block_auto_threshold():
+    """None auto-selects: dense under 1024 total context, 512-key tiles at
+    or above it (the regime where O(S) scores start to matter)."""
+    from nanodiloco_tpu.models.generate import _auto_decode_block
+
+    assert _auto_decode_block(1023) == 0
+    assert _auto_decode_block(1024) == 512
+    assert _auto_decode_block(131072) == 512
