@@ -319,8 +319,13 @@ def export_hf_main(argv: list[str]) -> None:
     p = argparse.ArgumentParser(prog="nanodiloco_tpu export-hf")
     p.add_argument("--checkpoint-dir", type=str, required=True)
     p.add_argument("--out", type=str, required=True,
-                   help="output directory for model.safetensors + config.json")
+                   help="output directory for safetensors shard(s) + config.json")
     p.add_argument("--step", type=int, default=None)
+    p.add_argument(
+        "--max-shard-gb", type=float, default=5.0,
+        help="split safetensors above this size (HF sharded layout with "
+        "index; 5 GB is transformers' own default)",
+    )
     p.add_argument("--force-cpu-devices", type=int, default=None, metavar="N")
     args = p.parse_args(argv)
     if args.force_cpu_devices:
@@ -329,17 +334,16 @@ def export_hf_main(argv: list[str]) -> None:
         force_virtual_cpu_devices(args.force_cpu_devices)
     import os
 
-    from nanodiloco_tpu.models import to_hf_state_dict
+    from nanodiloco_tpu.models import save_hf_pretrained
 
     model_cfg, _sidecar, snapshot = _load_checkpoint_snapshot(
         args.checkpoint_dir, args.step
     )
-    sd = to_hf_state_dict(snapshot, model_cfg)
-
     os.makedirs(args.out, exist_ok=True)
-    from safetensors.numpy import save_file
-
-    save_file(sd, os.path.join(args.out, "model.safetensors"))
+    written = save_hf_pretrained(
+        snapshot, model_cfg, args.out,
+        max_shard_bytes=int(args.max_shard_gb * 1024**3),
+    )
     hf_config = {
         "architectures": ["LlamaForCausalLM"],
         "model_type": "llama",
@@ -357,7 +361,7 @@ def export_hf_main(argv: list[str]) -> None:
     }
     with open(os.path.join(args.out, "config.json"), "w") as f:
         json.dump(hf_config, f, indent=1)
-    print(f"exported {len(sd)} tensors to {args.out}")
+    print(f"exported {', '.join(written)} to {args.out}")
 
 
 def main(argv: list[str] | None = None) -> None:

@@ -1,8 +1,10 @@
 from nanodiloco_tpu.models.config import LARGE_LLAMA, LLAMA3_8B, TINY_LLAMA, LlamaConfig
 from nanodiloco_tpu.models.generate import generate, init_kv_cache, pad_prompts
 from nanodiloco_tpu.models.hf_interop import (
+    from_hf_pretrained,
     from_hf_state_dict,
     load_into_hf,
+    save_hf_pretrained,
     to_hf_state_dict,
 )
 from nanodiloco_tpu.models.llama import causal_lm_loss, forward, init_params
@@ -22,6 +24,8 @@ __all__ = [
     "moe_mlp",
     "expert_capacity",
     "from_hf_state_dict",
+    "from_hf_pretrained",
     "to_hf_state_dict",
+    "save_hf_pretrained",
     "load_into_hf",
 ]

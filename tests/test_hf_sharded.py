@@ -1,0 +1,148 @@
+"""Sharded HF checkpoint IO (VERDICT r2 missing #5): export emits the
+sharded safetensors + index layout ``from_pretrained`` accepts, import
+streams shard-by-shard — both with host memory bounded by one shard /
+one leaf, never the whole fp32 state dict. Chunked IO is exercised by
+forcing tiny shard budgets on a tiny model (the code path is size-blind).
+Ref context: the reference lives entirely in the HF ecosystem
+(ref nanodiloco/main.py:97-99)."""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from nanodiloco_tpu.models import (
+    LlamaConfig,
+    forward,
+    from_hf_pretrained,
+    init_params,
+    save_hf_pretrained,
+    to_hf_state_dict,
+)
+
+CFG = LlamaConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_attention_heads=4, num_key_value_heads=2, num_hidden_layers=3,
+    max_position_embeddings=64,
+)
+
+
+def _assert_tree_equal(a, b):
+    assert jax.tree.structure(a) == jax.tree.structure(b)
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_multi_shard_roundtrip_exact(tmp_path):
+    params = init_params(jax.random.key(0), CFG)
+    written = save_hf_pretrained(
+        params, CFG, str(tmp_path), max_shard_bytes=200_000
+    )
+    shard_files = [w for w in written if w.endswith(".safetensors")]
+    assert len(shard_files) > 1  # the chunked path actually ran
+    assert shard_files == [
+        f"model-{i + 1:05d}-of-{len(shard_files):05d}.safetensors"
+        for i in range(len(shard_files))
+    ]
+    index = json.load(open(tmp_path / "model.safetensors.index.json"))
+    assert set(index["weight_map"].values()) == set(shard_files)
+    expect_bytes = sum(
+        t.nbytes for t in to_hf_state_dict(params, CFG).values()
+    )
+    assert index["metadata"]["total_size"] == expect_bytes
+    _assert_tree_equal(from_hf_pretrained(str(tmp_path), CFG), params)
+
+
+def test_single_file_roundtrip(tmp_path):
+    params = init_params(jax.random.key(1), CFG)
+    written = save_hf_pretrained(params, CFG, str(tmp_path))
+    assert written == ["model.safetensors"]  # fits: no shards, no index
+    assert not (tmp_path / "model.safetensors.index.json").exists()
+    _assert_tree_equal(from_hf_pretrained(str(tmp_path), CFG), params)
+    # a bare file path works too
+    _assert_tree_equal(
+        from_hf_pretrained(str(tmp_path / "model.safetensors"), CFG), params
+    )
+
+
+def test_tied_export_omits_lm_head(tmp_path):
+    cfg = dataclasses.replace(CFG, tie_word_embeddings=True)
+    params = init_params(jax.random.key(2), cfg)
+    save_hf_pretrained(params, cfg, str(tmp_path), max_shard_bytes=200_000)
+    index = json.load(open(tmp_path / "model.safetensors.index.json"))
+    # matching transformers.save_pretrained: the tied head is re-tied by
+    # from_pretrained via tie_word_embeddings in config.json, not stored
+    assert "lm_head.weight" not in index["weight_map"]
+    _assert_tree_equal(from_hf_pretrained(str(tmp_path), cfg), params)
+
+
+def test_plan_shapes_match_produced_tensors():
+    """The shard planner assigns files from shapes alone; a shape drift
+    from what produce() emits would mis-size shards silently."""
+    from nanodiloco_tpu.models.hf_interop import _export_plan
+
+    params = init_params(jax.random.key(3), CFG)
+    for key, shape, produce in _export_plan(params, CFG):
+        t = produce()
+        assert t.shape == shape, key
+        assert t.dtype == np.float32
+        assert t.flags["C_CONTIGUOUS"], key
+    # and the plan covers exactly the state-dict keys
+    plan_keys = {k for k, _s, _p in _export_plan(params, CFG)}
+    assert plan_keys == set(to_hf_state_dict(params, CFG))
+
+
+def test_transformers_loads_sharded_export(tmp_path):
+    """The done-bar from VERDICT: a multi-shard layout that
+    ``LlamaForCausalLM.from_pretrained`` accepts, with logit parity."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    params = init_params(jax.random.key(4), CFG)
+    save_hf_pretrained(params, CFG, str(tmp_path), max_shard_bytes=200_000)
+    hf_config = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "vocab_size": CFG.vocab_size,
+        "hidden_size": CFG.hidden_size,
+        "intermediate_size": CFG.intermediate_size,
+        "num_attention_heads": CFG.num_attention_heads,
+        "num_key_value_heads": CFG.kv_heads,
+        "num_hidden_layers": CFG.num_hidden_layers,
+        "rms_norm_eps": CFG.rms_norm_eps,
+        "rope_theta": CFG.rope_theta,
+        "max_position_embeddings": CFG.max_position_embeddings,
+        "tie_word_embeddings": CFG.tie_word_embeddings,
+        "torch_dtype": "float32",
+    }
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump(hf_config, f)
+    hf_model = transformers.LlamaForCausalLM.from_pretrained(
+        str(tmp_path), attn_implementation="eager"
+    ).eval()
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, CFG.vocab_size, size=(2, 16))
+    with torch.no_grad():
+        hf_out = hf_model(input_ids=torch.tensor(tokens)).logits.numpy()
+    with jax.default_matmul_precision("highest"):
+        import jax.numpy as jnp
+
+        ours = np.asarray(forward(params, jnp.asarray(tokens), CFG))
+    np.testing.assert_allclose(ours, hf_out, atol=2e-4, rtol=2e-4)
+
+
+def test_reexport_prunes_stale_shards(tmp_path):
+    """A sharded export followed by a single-file export into the same
+    directory must not leave the old index/shards behind — the import
+    probe is index-first and would silently serve the stale weights."""
+    a = init_params(jax.random.key(5), CFG)
+    b = init_params(jax.random.key(6), CFG)
+    save_hf_pretrained(a, CFG, str(tmp_path), max_shard_bytes=200_000)
+    save_hf_pretrained(b, CFG, str(tmp_path))  # fits one file
+    assert not (tmp_path / "model.safetensors.index.json").exists()
+    leftovers = [p.name for p in tmp_path.glob("model-*.safetensors")]
+    assert leftovers == []
+    _assert_tree_equal(from_hf_pretrained(str(tmp_path), CFG), b)
