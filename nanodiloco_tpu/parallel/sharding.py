@@ -9,8 +9,10 @@ Layout (2D "megatron-style" over fsdp x tp):
   output dim on tp — the following reduction over the tp-sharded dim is
   a single XLA-inserted all-reduce per block, riding ICI;
 - row-parallel consumers (wo, w_down) the transpose;
-- embedding: vocab axis replicated (a token gather from a vocab-sharded
-  table forces XLA into replicate-then-reshard), features over fsdp;
+- embedding: VOCAB axis over fsdp, features replicated — a vocab-sharded
+  token gather lowers to SPMD's mask+psum pattern, while feature-sharding
+  was measured to trigger an involuntary full rematerialization of the
+  gather output every step (PERF.md round-3 diagnosis);
   the untied lm_head carries the tp-sharded vocab on its matmul side;
   norm scales replicated.
 
@@ -49,11 +51,16 @@ def param_specs(
             "w_down": P(lax0, "tp", "fsdp"),
         }
     specs = {
-        # vocab axis deliberately NOT sharded: a token gather from a
-        # vocab-sharded table forces XLA into full rematerialization
-        # (replicate-then-reshard); features shard over fsdp instead, and
-        # the tp-sharded vocab lives on the matmul-side lm_head only.
-        "embed": P(None, "fsdp"),
+        # VOCAB axis over fsdp (measured, round 3): with the FEATURE axis
+        # sharded instead, the partitioner all-gathers the table and then
+        # cannot reshard the gather output (batch-over-fsdp from the token
+        # indices -> feature-over-fsdp for the wq/w_gate matmuls) without
+        # an "[SPMD] Involuntary full rematerialization" — replicating
+        # [W, B, S, D] every step on the fsdp x tp and ep x fsdp meshes
+        # (MULTICHIP_r02 tail). A vocab-sharded gather lowers to SPMD's
+        # mask+psum pattern and every dryrun mesh compiles warning-free
+        # with identical losses.
+        "embed": P("fsdp", None),
         "final_norm": P(),
         "layers": {
             "attn_norm": P(lax0, None),
