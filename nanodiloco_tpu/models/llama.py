@@ -92,6 +92,18 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
 # Building blocks
 # ---------------------------------------------------------------------------
 
+def checkpoint_policy(cfg: LlamaConfig):
+    """``cfg.remat_policy`` -> jax.checkpoint policy, shared by every
+    remat site (this forward and the pipeline stages, ops/pipeline.py) so
+    a new policy value can never be honored in one path and silently
+    fall back to full recompute in the other."""
+    return (
+        jax.checkpoint_policies.dots_saveable
+        if cfg.remat_policy == "dots"
+        else None  # "nothing": recompute the full layer
+    )
+
+
 def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     """RMSNorm with float32 accumulation (HF casts to fp32 for the variance)."""
     dtype = x.dtype
@@ -267,12 +279,7 @@ def forward(
         return _decoder_layer(cfg, x, layer, cos, sin, mask, sp_axis, valid)
 
     if cfg.remat:
-        policy = (
-            jax.checkpoint_policies.dots_saveable
-            if cfg.remat_policy == "dots"
-            else None  # "nothing": recompute the full layer
-        )
-        layer_fn = jax.checkpoint(layer_fn, policy=policy)
+        layer_fn = jax.checkpoint(layer_fn, policy=checkpoint_policy(cfg))
 
     def scan_body(carry, layer):
         x, aux = layer_fn(carry, layer, cos, sin, mask, attn_mask)

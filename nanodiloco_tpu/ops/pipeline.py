@@ -41,6 +41,7 @@ from jax import lax
 from nanodiloco_tpu.models.config import LlamaConfig
 from nanodiloco_tpu.models.llama import (
     _decoder_layer,
+    checkpoint_policy,
     rms_norm,
     rope_tables,
     sp_shift_targets,
@@ -127,7 +128,9 @@ def pp_shard_loss(
         return _decoder_layer(cfg, x, layer, cos, sin, None, sp_axis, valid)
 
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        # honor cfg.remat_policy exactly like the unsharded forward
+        # (ADVICE r2) — one shared mapping, models/llama.py
+        layer_fn = jax.checkpoint(layer_fn, policy=checkpoint_policy(cfg))
 
     def run_stage(x, valid):
         """Local layers on [B, S, d] -> (x, summed router aux).

@@ -362,7 +362,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     if cfg.eval_every:
         from nanodiloco_tpu.training.evaluate import Evaluator, holdout_batches
 
-        evaluator = Evaluator(model_cfg, mesh)
+        evaluator = Evaluator(model_cfg, mesh, quiet=quiet)
         eval_set = holdout_batches(
             eval_rows, cfg.per_device_batch_size, mask_rows=eval_mask_rows
         )

@@ -68,6 +68,14 @@ def ensure_live_backend(
     import time
 
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # The env var alone is NOT safe here: with the accelerator plugin
+        # registered at interpreter start, jax.devices() can still block
+        # on a wedged claim even under JAX_PLATFORMS=cpu (observed round
+        # 3: a child that inherited the degraded parent's env hung in
+        # backend init). Pin the platform in-process too — that path is
+        # proven immune. Best-effort: if a cpu backend is somehow already
+        # live, the process is past the dangerous init anyway.
+        force_virtual_cpu_devices(n_cpu_devices, strict=False)
         return None
     deadline = time.monotonic() + wait_s
     reason = None

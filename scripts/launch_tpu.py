@@ -110,8 +110,15 @@ def provision_commands(args) -> list[list[str]]:
     bootstrap = tpu + [
         "ssh", args.name, *loc, "--worker=all",
         "--command",
+        # requirements.lock first: the VM must get the exact jax/flax/
+        # optax versions this tree was tested with, not whatever pip
+        # resolves on provision day; jax[tpu] is pinned to the same
+        # locked version so the libtpu extra can't drag jax forward
+        # (VERDICT r2 weak #7)
         "cd ~/nanodiloco_tpu_repo && "
-        "pip install -q -e . 'jax[tpu]' -f "
+        "pip install -q -r requirements.lock && "
+        "pip install -q -e . "
+        "\"jax[tpu]==$(python -c 'import jax; print(jax.__version__)')\" -f "
         "https://storage.googleapis.com/jax-releases/libtpu_releases.html",
     ]
     multihost = "NANODILOCO_MULTIHOST=1 " if args.multihost else ""

@@ -164,7 +164,10 @@ def test_launch_tpu_provision_dry_run():
     lines = [l for l in out.stdout.splitlines() if l.startswith("+ gcloud")]
     assert len(lines) == 4
     assert "create t" in lines[0] and "--worker=all" in lines[1]
-    assert "pip install" in lines[2]
+    # bootstrap installs the tested pins first, and jax[tpu] is locked to
+    # the pinned jax so the libtpu extra can't drift (VERDICT r2 weak #7)
+    assert "pip install -q -r requirements.lock" in lines[2]
+    assert "jax[tpu]==" in lines[2]
     assert "NANODILOCO_MULTIHOST=1" in lines[3] and "benchmark" in lines[3]
 
 
