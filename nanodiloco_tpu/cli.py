@@ -68,7 +68,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "sharded over this axis and the grad-accumulation "
                         "microbatches stream through GPipe-style; composes "
                         "with --sp (sequence-sharded stages, requires "
-                        "--attention ring) but not with streaming")
+                        "--attention ring) and with streaming when "
+                        "--streaming-fragments aligns with the stages")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel shards for MoE models "
                         "(--num-experts via the model config JSON); "

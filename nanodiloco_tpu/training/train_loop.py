@@ -150,11 +150,27 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         if cfg.seq_length % cfg.sp:
             raise ValueError("seq_length must divide evenly by sp")
     if cfg.pp > 1:
-        if cfg.streaming_fragments > 0:
+        if cfg.model.num_hidden_layers % cfg.pp:
             raise ValueError(
-                "--pp cannot be combined with streaming DiLoCo (fragment "
-                "slicing and stage sharding both partition the layer axis)"
+                f"--pp {cfg.pp} must divide the layer count "
+                f"({cfg.model.num_hidden_layers})"
             )
+        if cfg.streaming_fragments > 0:
+            # fast-fail the alignment contract here (StreamingDiloco
+            # re-checks it) — by construction time the whole dataset
+            # would already be loaded and tokenized
+            from nanodiloco_tpu.parallel.streaming import fragment_bounds
+
+            stage = cfg.model.num_hidden_layers // cfg.pp
+            bounds = fragment_bounds(
+                cfg.model.num_hidden_layers, cfg.streaming_fragments
+            )
+            if any(e % stage for lo, hi in bounds for e in (lo, hi)):
+                raise ValueError(
+                    f"--streaming-fragments {cfg.streaming_fragments} does "
+                    f"not align with --pp {cfg.pp} ({stage} layers per "
+                    f"stage); use a fragment count dividing {cfg.pp}"
+                )
         if cfg.grad_accum < 2 * cfg.pp and not quiet:
             print(
                 f"[nanodiloco] warning: grad_accum {cfg.grad_accum} < "
