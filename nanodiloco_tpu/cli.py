@@ -70,6 +70,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "with --sp (sequence-sharded stages, requires "
                         "--attention ring) and with streaming when "
                         "--streaming-fragments aligns with the stages")
+    p.add_argument("--pp-schedule", type=str, default="gpipe",
+                   choices=["gpipe", "1f1b"],
+                   help="pipeline schedule: gpipe (autodiff backward wave, "
+                        "activation memory grows with the microbatch count) "
+                        "or 1f1b (per-microbatch backward, activation "
+                        "memory capped at 2*pp-1 microbatches)")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel shards for MoE models "
                         "(--num-experts via the model config JSON); "
@@ -191,6 +197,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         tp=args.tp,
         sp=args.sp,
         pp=args.pp,
+        pp_schedule=args.pp_schedule,
         ep=args.ep,
         dcn_slices=args.dcn_slices,
         streaming_fragments=args.streaming_fragments,

@@ -17,10 +17,17 @@ parallelism (PP): NO"); this is a TPU-native capability add. Design:
   ``t`` from the (grad-accumulation) microbatch axis; the last stage
   emits a loss for microbatch ``t - (P-1)`` when valid. The pipeline
   bubble is the standard GPipe ``(P-1)/(M+P-1)``.
-- **Backward for free.** ``jax.grad`` through the scan+ppermute forward
-  yields the reverse pipeline schedule automatically (the cotangent of a
-  ``ppermute`` is the inverse ``ppermute``), so there is no hand-written
-  backward schedule to maintain.
+- **Backward for free (GPipe), or scheduled (1F1B).** ``jax.grad``
+  through the scan+ppermute forward yields the reverse pipeline schedule
+  automatically (the cotangent of a ``ppermute`` is the inverse
+  ``ppermute``) — no hand-written backward, at the cost of keeping every
+  tick's stage input alive (``M + P - 1`` microbatches).
+  ``pp_shard_grads_1f1b`` instead runs one forward AND one per-microbatch
+  ``jax.vjp`` backward per cycle, capping live activations at ``2P - 1``
+  stage inputs — select with ``DilocoConfig.pp_schedule`` /
+  ``--pp-schedule``; gradients agree up to fp summation order (the
+  schedules accumulate microbatch gradients in different orders;
+  ~1e-7 observed, test_pp.py).
 - **Head/embed replicated over pp.** Only stage 0's embedding lookup and
   the last stage's LM head contribute (masked straight-line compute —
   per-stage divergent ``lax.cond`` deadlocks the transposed collectives,
@@ -47,6 +54,57 @@ from nanodiloco_tpu.models.llama import (
     sp_shift_targets,
 )
 from nanodiloco_tpu.ops.fused_ce import chunked_softmax_xent
+
+
+def _pipeline_setup(cfg: LlamaConfig, S: int, sp_axis: str | None):
+    """Shared stage machinery for BOTH schedules (GPipe and 1F1B):
+    validated sp setup, rope tables (shard-global offsets under sp), and
+    the (possibly rematerialized) per-layer function. One copy, so a
+    semantics change can never diverge the two schedules silently."""
+    if sp_axis is not None:
+        if cfg.attention_impl != "ring":
+            raise ValueError(
+                "pipeline + sequence parallelism requires "
+                f"attention_impl='ring'; got {cfg.attention_impl!r}"
+            )
+        if cfg.num_experts:
+            # mirrors sp_shard_loss: per-shard routing/capacity (and the
+            # shard-local aux token weighting here) would not match the
+            # unsharded semantics
+            raise ValueError(
+                "MoE is not supported under sequence parallelism "
+                "(pp and ep compose with MoE; sp does not, yet)"
+            )
+        sp_idx = lax.axis_index(sp_axis)
+        cos, sin = rope_tables(cfg, S, offset=sp_idx * S)
+    else:
+        cos, sin = rope_tables(cfg, S)
+
+    def layer_fn(x, layer, cos, sin, valid):
+        return _decoder_layer(cfg, x, layer, cos, sin, None, sp_axis, valid)
+
+    if cfg.remat:
+        # honor cfg.remat_policy exactly like the unsharded forward
+        # (ADVICE r2) — one shared mapping, models/llama.py
+        layer_fn = jax.checkpoint(layer_fn, policy=checkpoint_policy(cfg))
+    return cos, sin, layer_fn
+
+
+def _exit_loss(cfg: LlamaConfig, prm: dict, y, tok, msk, sp_axis: str | None):
+    """Pipe-exit loss: final norm -> (sp-shifted) targets -> chunked CE,
+    with the head falling back to tied embeddings. Derived entirely from
+    ``prm`` so a vjp through it routes every parameter cotangent."""
+    head = prm.get("lm_head")
+    if head is None:
+        head = prm["embed"].T
+    h = rms_norm(y, prm["final_norm"], cfg.rms_norm_eps)
+    if sp_axis is None:
+        return _hidden_ce(
+            h[:, :-1], head, tok[:, 1:],
+            msk[:, 1:].astype(jnp.float32), cfg.loss_chunk,
+        )
+    targets, w = sp_shift_targets(tok, msk, sp_axis)
+    return _hidden_ce(h, head, targets, w, cfg.loss_chunk)
 
 
 def _hidden_ce(h, head, targets, weights, chunk: int):
@@ -102,35 +160,7 @@ def pp_shard_loss(
     n_stages = lax.psum(1, axis_name)
     M, B, S = tokens_mb.shape  # S is the LOCAL shard length under sp
     cdt = jnp.dtype(cfg.dtype)
-    if sp_axis is not None:
-        if cfg.attention_impl != "ring":
-            raise ValueError(
-                "pipeline + sequence parallelism requires "
-                f"attention_impl='ring'; got {cfg.attention_impl!r}"
-            )
-        if cfg.num_experts:
-            # mirrors sp_shard_loss: per-shard routing/capacity (and the
-            # shard-local aux token weighting here) would not match the
-            # unsharded semantics
-            raise ValueError(
-                "MoE is not supported under sequence parallelism "
-                "(pp and ep compose with MoE; sp does not, yet)"
-            )
-        sp_idx = lax.axis_index(sp_axis)
-        cos, sin = rope_tables(cfg, S, offset=sp_idx * S)
-    else:
-        cos, sin = rope_tables(cfg, S)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-
-    def layer_fn(x, layer, cos, sin, valid):
-        return _decoder_layer(cfg, x, layer, cos, sin, None, sp_axis, valid)
-
-    if cfg.remat:
-        # honor cfg.remat_policy exactly like the unsharded forward
-        # (ADVICE r2) — one shared mapping, models/llama.py
-        layer_fn = jax.checkpoint(layer_fn, policy=checkpoint_policy(cfg))
+    cos, sin, layer_fn = _pipeline_setup(cfg, S, sp_axis)
 
     def run_stage(x, valid):
         """Local layers on [B, S, d] -> (x, summed router aux).
@@ -152,17 +182,7 @@ def pp_shard_loss(
         m_out = jnp.clip(t - (n_stages - 1), 0, M - 1)
         tok = lax.dynamic_index_in_dim(tokens_mb, m_out, 0, keepdims=False)
         msk = lax.dynamic_index_in_dim(loss_mask_mb, m_out, 0, keepdims=False)
-        h = rms_norm(y, params["final_norm"], cfg.rms_norm_eps)
-        if sp_axis is None:
-            return _hidden_ce(
-                h[:, :-1],
-                head,
-                tok[:, 1:],
-                msk[:, 1:].astype(jnp.float32),
-                cfg.loss_chunk,
-            )
-        targets, w = sp_shift_targets(tok, msk, sp_axis)
-        return _hidden_ce(h, head, targets, w, cfg.loss_chunk)
+        return _exit_loss(cfg, params, y, tok, msk, sp_axis)
 
     # per-microbatch token counts (the loss-shift weights), for aux
     # weighting identical to the vmap grad-accumulation path
@@ -233,3 +253,156 @@ def pp_shard_loss(
         tick, (buf0, z, z, z, z), jnp.arange(T, dtype=jnp.int32)
     )
     return sum_loss, n_tok, aux_w, metric
+
+
+def pp_shard_grads_1f1b(
+    params: dict,
+    tokens_mb: jax.Array,     # [M, B, S]
+    cfg: LlamaConfig,
+    loss_mask_mb: jax.Array,  # [M, B, S]
+    axis_name: str = "pp",
+    sp_axis: str | None = None,
+):
+    """1F1B schedule: gradients of the same summed loss as
+    ``pp_shard_loss``, computed by a hand-scheduled per-microbatch VJP so
+    activation memory is O(P), not O(M).
+
+    GPipe-via-autodiff (``jax.grad`` over ``pp_shard_loss``'s tick scan)
+    must keep every tick's stage input alive until the reverse wave —
+    ``M + P - 1`` microbatch activations per stage. Here each cycle of a
+    single scan runs, per stage, ONE forward (microbatch ``c - s``, as in
+    GPipe) and ONE backward (microbatch ``c - (2P-2-s)``: the backward
+    wave departs the last stage the same cycle its forward lands and
+    trails back down). A backward recomputes its stage from the SAVED
+    STAGE INPUT via ``jax.vjp``, so the only live activations are a
+    ``2P-1``-slot input queue — at M=32, P=4 that is 7 saved microbatch
+    inputs versus GPipe's 35 per-tick carries (each of which multiplies
+    by L/P inner-scan carries under per-layer remat).
+
+    Trade-off, stated honestly: the fused F+B cycle idles its B half
+    during warmup and its F half during drain, so the bubble is
+    ``2(P-1)`` cycles — twice GPipe's per-wave bubble. The win is memory:
+    at fixed HBM the cheaper activations buy a larger M, which is what
+    actually shrinks the bubble fraction ``2(P-1)/(M+2P-2)``.
+
+    Same contract as ``pp_shard_loss`` for the loss statistics; returns
+    ``(grads, sum_loss, n_tok, aux_weighted, metric_sum)`` where
+    ``grads`` is the UNREDUCED per-stage gradient of
+    ``psum(sum_loss) + coef * psum(aux_weighted)`` — callers psum the
+    replicated (embed/head/norm) leaves over ``axis_name`` exactly as
+    they do for the autodiff path. Cross-stage dependencies flow through
+    the reverse ``ppermute`` of input cotangents; the forward ring's
+    wraparound (last stage -> stage 0) carries a cotangent that is
+    identically zero because stage 0's ``where`` selects the embedding
+    branch — no special-casing at the ends.
+    """
+    p_idx = lax.axis_index(axis_name)
+    n_stages = lax.psum(1, axis_name)  # static: mesh axis sizes are known
+    M, B, S = tokens_mb.shape
+    cdt = jnp.dtype(cfg.dtype)
+    cos, sin, layer_fn = _pipeline_setup(cfg, S, sp_axis)
+
+    def cell(prm, m, x_prev):
+        """One stage pass of microbatch m, everything derived from
+        ``prm`` so a vjp routes every parameter's cotangent: ingest (stage
+        0) or receive, local layers, exit loss (counted by the caller only
+        on the last stage). Straight-line like the GPipe tick — masked,
+        never branched, so the transposed collectives stay in lockstep."""
+        tok = lax.dynamic_index_in_dim(tokens_mb, m, 0, keepdims=False)
+        msk = lax.dynamic_index_in_dim(loss_mask_mb, m, 0, keepdims=False)
+        x_in = jnp.where(p_idx == 0, prm["embed"].astype(cdt)[tok], x_prev)
+
+        def body(carry, layer):
+            x, aux = layer_fn(carry, layer, cos, sin, msk)
+            return x, aux
+
+        y, auxes = lax.scan(body, x_in, prm["layers"])
+        sl, n = _exit_loss(cfg, prm, y, tok, msk, sp_axis)
+        return y, sl, n, jnp.sum(auxes)
+
+    n_per_mb = jnp.sum(loss_mask_mb[:, :, 1:].astype(jnp.float32), axis=(1, 2))
+    coef = cfg.router_aux_coef
+    Q = 2 * n_stages - 1   # max in-flight stage inputs: 2(P-1-s)+1 <= 2P-1
+    T = M + 2 * n_stages - 2
+    is_last = (p_idx == n_stages - 1).astype(jnp.float32)
+    perm_f = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    perm_b = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+    def cycle(carry, c):
+        buf, dybuf, queue, grads, sum_loss, n_tok, aux_w, metric = carry
+
+        # ---- forward half: microbatch c - s, exactly GPipe's wave ----
+        m_raw = c - p_idx
+        f_valid = (m_raw >= 0) & (m_raw < M)
+        m_f = jnp.clip(m_raw, 0, M - 1)  # clamped: edge cycles recompute
+        fv = f_valid.astype(jnp.float32)
+        y, sl, n, aux = cell(params, m_f, buf)
+        lv = is_last * fv
+        sl, n = lv * sl, lv * n
+        aux_w = aux_w + fv * n_per_mb[m_f] * aux
+        sl_m, n_m = (
+            (lax.psum(sl, sp_axis), lax.psum(n, sp_axis))
+            if sp_axis is not None else (sl, n)
+        )
+        metric = metric + lv * sl_m / jnp.maximum(n_m, 1.0) + coef * fv * aux
+        # save this cycle's received input for the microbatch's backward;
+        # guarded so clamped edge cycles can't clobber a live slot
+        slot = m_f % Q
+        old = lax.dynamic_index_in_dim(queue, slot, 0, keepdims=False)
+        queue = lax.dynamic_update_index_in_dim(
+            queue, jnp.where(f_valid, buf, old), slot, 0
+        )
+
+        # ---- backward half: microbatch c - (2P-2-s), the reverse wave --
+        mb_raw = c - (2 * n_stages - 2 - p_idx)
+        b_valid = (mb_raw >= 0) & (mb_raw < M)
+        bv = b_valid.astype(jnp.float32)
+        m_b = jnp.clip(mb_raw, 0, M - 1)
+        x_saved = lax.dynamic_index_in_dim(queue, m_b % Q, 0, keepdims=False)
+        (y_p, sl_p, n_p, aux_p), pull = jax.vjp(
+            lambda prm, xp: cell(prm, m_b, xp), params, x_saved
+        )
+        # cotangents of (y, sl, n, aux): y's arrives from the next stage
+        # (zero into the last stage via the ring, see docstring); sl
+        # counts once at the exit; n is a count (no gradient); aux enters
+        # the total loss as coef * n_m * aux (the vmap path's weighting).
+        # Each adds primal * 0 so its manual-axis vary-ness matches the
+        # primal's (vjp rejects a replicated cotangent for a varying out).
+        # dense models: aux is the constant 0.0 (replicated type) and
+        # contributes nothing — its cotangent must be replicated too
+        aux_ct = (
+            bv * coef * n_per_mb[m_b] + aux_p * 0
+            if cfg.num_experts else aux_p * 0
+        )
+        dprm, dx = pull((
+            (dybuf * bv).astype(cdt) + y_p * 0,
+            bv * is_last + sl_p * 0,
+            n_p * 0,
+            aux_ct,
+        ))
+        grads = jax.tree.map(lambda g, d: g + d, grads, dprm)
+
+        buf = lax.ppermute(y, axis_name, perm_f)
+        dybuf = lax.ppermute((dx * bv).astype(cdt), axis_name, perm_b)
+        return (buf, dybuf, queue, grads, sum_loss + sl, n_tok + n,
+                aux_w, metric), None
+
+    # carries start typed as varying over the manual axes: derive a zero
+    # from the (sharded) data and add it everywhere (same trick as
+    # pp_shard_loss's pcast'd zeros)
+    first = params["embed"].astype(cdt)[tokens_mb[0]]
+    z = lax.pcast(
+        jnp.sum(first[..., 0]).astype(jnp.float32) * 0.0,
+        (axis_name,), to="varying",
+    )
+    buf0 = jnp.zeros_like(first) + z.astype(cdt)
+    queue0 = jnp.zeros((Q,) + first.shape, cdt) + z.astype(cdt)
+    grads0 = jax.tree.map(
+        lambda p: jnp.zeros_like(p) + z.astype(p.dtype), params
+    )
+    (_, _, _, grads, sum_loss, n_tok, aux_w, metric), _ = lax.scan(
+        cycle,
+        (buf0, buf0, queue0, grads0, z, z, z, z),
+        jnp.arange(T, dtype=jnp.int32),
+    )
+    return grads, sum_loss, n_tok, aux_w, metric

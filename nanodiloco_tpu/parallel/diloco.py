@@ -64,6 +64,11 @@ class DilocoConfig:
     weight_decay: float = 0.01
     clip_norm: float | None = 1.0
     grad_accum: int = 1             # microbatches per inner step
+    # pipeline schedule: "gpipe" (autodiff through the tick scan; stores
+    # M+P-1 stage inputs) or "1f1b" (hand-scheduled per-microbatch vjp;
+    # stores 2P-1 — see ops/pipeline.py:pp_shard_grads_1f1b for the
+    # bubble/memory trade)
+    pp_schedule: str = "gpipe"
     offload_snapshot: bool = False  # keep snapshot in host memory between syncs
     # Wire format of the outer all-reduce payload (e.g. "bfloat16" halves
     # DCN/ICI traffic; pseudo-gradients are noise-tolerant — the reference
@@ -107,6 +112,10 @@ class Diloco:
                 "custom loss_fn is not supported with sequence or pipeline "
                 "parallelism: the inner step runs the loss inside a manual "
                 "shard_map region"
+            )
+        if cfg.pp_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pp_schedule {cfg.pp_schedule!r}: use 'gpipe' or '1f1b'"
             )
         if self.pp > 1:
             if model_cfg.num_hidden_layers % self.pp:
@@ -445,27 +454,42 @@ class Diloco:
                 sl, n, aux_w, metric = pp_shard_loss(
                     p, w_tokens, self.model_cfg, w_mask, "pp", sp_axis=sp_axis
                 )
-                sl = jax.lax.psum(sl, "pp")
-                n = jax.lax.psum(n, "pp")
+                # the differentiated value: summed CE + token-weighted
+                # router aux (zero for dense models; zero under sp, where
+                # MoE is rejected), combined over the stages — and over
+                # the sequence shards, each of which saw only its slice
+                total = jax.lax.psum(sl + coef * aux_w, "pp")
                 if sp_axis is not None:
-                    # shard-local sums combine over the sequence shards.
-                    # metric's VALUE is already sp-uniform (pipeline.py
-                    # reduces it in-tick) but its scan-carry TYPE is still
-                    # varying-over-sp; the psum/size mean keeps the value
-                    # and makes the type replicated for the out_specs.
-                    sl = jax.lax.psum(sl, sp_axis)
-                    n = jax.lax.psum(n, sp_axis)
-                    metric = jax.lax.psum(metric, sp_axis) / jax.lax.psum(
-                        1, sp_axis
-                    )
-                # token-weighted router aux, exactly as the vmap grad-
-                # accumulation path weights it (zero for dense models)
-                aux_w = jax.lax.psum(aux_w, "pp")
-                # mean-of-microbatch-means metric == the vmap path's
-                metric = jax.lax.psum(metric, "pp") / accum
-                return sl + coef * aux_w, (n, metric)
+                    total = jax.lax.psum(total, sp_axis)
+                return total, (n, metric)
 
-            (_sl, (n, metric)), g = jax.value_and_grad(sum_loss_fn, has_aux=True)(params)
+            if self.cfg.pp_schedule == "1f1b":
+                # hand-scheduled per-microbatch vjp: same summed loss,
+                # O(P) activation memory (ops/pipeline.py). Gradients and
+                # statistics come back unreduced exactly like autodiff's.
+                from nanodiloco_tpu.ops.pipeline import pp_shard_grads_1f1b
+
+                g, _sl, n, _aux_w, metric = pp_shard_grads_1f1b(
+                    params, w_tokens, self.model_cfg, w_mask, "pp",
+                    sp_axis=sp_axis,
+                )
+            else:
+                (_t, (n, metric)), g = jax.value_and_grad(
+                    sum_loss_fn, has_aux=True
+                )(params)
+            # ONE statistics-normalization tail for both schedules:
+            # global token count, and the mean-of-microbatch-means metric.
+            n = jax.lax.psum(n, "pp")
+            if sp_axis is not None:
+                # metric's VALUE is already sp-uniform (pipeline.py
+                # reduces it in-tick) but its scan-carry TYPE is still
+                # varying-over-sp; the psum/size mean keeps the value
+                # and makes the type replicated for the out_specs.
+                n = jax.lax.psum(n, sp_axis)
+                metric = jax.lax.psum(metric, sp_axis) / jax.lax.psum(
+                    1, sp_axis
+                )
+            metric = jax.lax.psum(metric, "pp") / accum
             # replicated leaves: every stage holds a copy, only one
             # computed a nonzero grad — combine so the copies stay equal
             g = {

@@ -69,7 +69,10 @@ class TrainConfig:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1   # sequence-parallel shards (ring attention long-context path)
-    pp: int = 1   # pipeline stages (layer stack sharded, GPipe microbatching)
+    pp: int = 1   # pipeline stages (layer stack sharded, microbatch streaming)
+    # "gpipe": autodiff backward wave, stores M+P-1 stage inputs;
+    # "1f1b": per-microbatch vjp schedule, stores 2P-1 (ops/pipeline.py)
+    pp_schedule: str = "gpipe"
     ep: int = 1   # expert-parallel shards (MoE experts, models/moe.py)
     dcn_slices: int = 1  # multi-slice: diloco axis spans slices over DCN
     # dispatch whole DiLoCo rounds (H inner steps + sync) as ONE fused
@@ -207,6 +210,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         lr=cfg.lr,
         outer_lr=cfg.outer_lr,
         grad_accum=cfg.grad_accum,
+        pp_schedule=cfg.pp_schedule,
         offload_snapshot=cfg.offload_snapshot,
         outer_comm_dtype=cfg.outer_comm_dtype,
     )
