@@ -219,20 +219,21 @@ def _decoder_layer(
     attn = _attention(cfg, q, k, v, mask, sp_axis)
     x = x + attn.reshape(b, s, nh * hd) @ layer["wo"].astype(cdt)
 
-    return mlp_block(cfg, x, layer, valid)
+    return mlp_block(cfg, x, layer, valid, sp_axis=sp_axis)
 
 
-def mlp_block(cfg: LlamaConfig, x, layer: Params, valid=None):
+def mlp_block(cfg: LlamaConfig, x, layer: Params, valid=None, sp_axis=None):
     """The norm + (dense SwiGLU | MoE) residual half of a decoder layer,
     shared by the training forward and the cached decode path
     (models/generate.py) so the two can never drift. Returns
-    (x, aux_loss) — aux is the router load-balance term, 0.0 for dense."""
+    (x, aux_loss) — aux is the router load-balance term, 0.0 for dense.
+    ``sp_axis``: see moe_mlp (sequence-sharded routing)."""
     cdt = x.dtype
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
     if cfg.num_experts:
         from nanodiloco_tpu.models.moe import moe_mlp
 
-        mlp_out, aux = moe_mlp(cfg, h, layer, valid=valid)
+        mlp_out, aux = moe_mlp(cfg, h, layer, valid=valid, sp_axis=sp_axis)
         return x + mlp_out, aux
     gate = jax.nn.silu(h @ layer["w_gate"].astype(cdt))
     up = h @ layer["w_up"].astype(cdt)
@@ -389,11 +390,18 @@ def causal_lm_loss_sp(
         loss_mask = jnp.ones_like(tokens)
 
     def shard_fn(params, tokens, loss_mask):
-        sum_local, n_local = sp_shard_loss(params, tokens, cfg, loss_mask, axis_name)
+        sum_local, n_local, aux = sp_shard_loss(
+            params, tokens, cfg, loss_mask, axis_name
+        )
         sum_loss = jax.lax.psum(sum_local, axis_name)
         n_tok = jax.lax.psum(n_local, axis_name)
-        return sum_loss / jnp.maximum(n_tok, 1.0), {
-            "n_tokens": n_tok, "sum_loss": sum_loss,
+        # aux's VALUE is already globally exact (moe_mlp reduces its
+        # statistics over the axis); the psum/size mean only replicates
+        # its manual-axis TYPE for the out_specs
+        aux = jax.lax.psum(aux, axis_name) / jax.lax.psum(1, axis_name)
+        loss = sum_loss / jnp.maximum(n_tok, 1.0) + cfg.router_aux_coef * aux
+        return loss, {
+            "n_tokens": n_tok, "sum_loss": sum_loss, "router_aux": aux,
         }
 
     from jax.sharding import PartitionSpec as P
@@ -404,7 +412,7 @@ def causal_lm_loss_sp(
         shard_fn,
         mesh=mesh,
         in_specs=(pspec, seq_spec, seq_spec),
-        out_specs=(P(), {"n_tokens": P(), "sum_loss": P()}),
+        out_specs=(P(), {"n_tokens": P(), "sum_loss": P(), "router_aux": P()}),
         axis_names={axis_name},
     )(params, tokens, loss_mask)
 
@@ -436,21 +444,20 @@ def sp_shard_loss(
     cfg: LlamaConfig,
     loss_mask: jax.Array,
     axis_name: str,
-) -> tuple[jax.Array, jax.Array]:
+) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Per-shard UNREDUCED loss body for sequence parallelism: must run
     inside a region manual over ``axis_name``. Returns this shard's
-    (sum_loss, n_tokens) — callers psum both (and psum parameter grads).
-    tokens/loss_mask: [B, S_local]."""
+    (sum_loss, n_tokens, router_aux) — callers psum the first two (and
+    psum parameter grads); ``router_aux`` is already GLOBALLY exact (its
+    statistics reduce over the axis inside moe_mlp; 0.0 for dense), so
+    callers use it as-is, never psummed. tokens/loss_mask: [B, S_local].
+
+    MoE composes via token-choice routing with per-shard capacity — see
+    moe_mlp for the exact-when-capacity-is-ample semantics."""
     if cfg.attention_impl != "ring":
         raise ValueError(
             "sequence-parallel loss requires attention_impl='ring'; "
             f"got {cfg.attention_impl!r}"
-        )
-    if cfg.num_experts:
-        raise ValueError(
-            "MoE is not supported under sequence parallelism: per-shard "
-            "routing/capacity would not match the unsharded semantics "
-            "(pp and ep compose with MoE; sp does not, yet)"
         )
     idx = jax.lax.axis_index(axis_name)
     b, s_loc = tokens.shape
@@ -461,25 +468,26 @@ def sp_shard_loss(
         # where materializing [B, S_loc, V] logits hurts most
         from nanodiloco_tpu.ops.fused_ce import chunked_softmax_xent
 
-        h = forward(
+        h, aux = forward(
             params, tokens, cfg, attn_mask=None, sp_axis=axis_name,
-            position_offset=idx * s_loc, return_hidden=True,
+            position_offset=idx * s_loc, return_hidden=True, with_aux=True,
         )
         head = params.get("lm_head", None)
         if head is None:
             head = params["embed"].T
-        return chunked_softmax_xent(
+        sl, n = chunked_softmax_xent(
             h.reshape(b * s_loc, h.shape[-1]),
             head.astype(h.dtype),
             targets.reshape(-1),
             m.reshape(-1),
             chunk=cfg.loss_chunk,
         )
+        return sl, n, aux
 
-    logits = forward(
+    logits, aux = forward(
         params, tokens, cfg, attn_mask=None, sp_axis=axis_name,
-        position_offset=idx * s_loc,
+        position_offset=idx * s_loc, with_aux=True,
     )
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.sum(nll * m), jnp.sum(m)
+    return jnp.sum(nll * m), jnp.sum(m), aux

@@ -71,7 +71,8 @@ def _expert_ffn(expert_in: jax.Array, layer: dict) -> jax.Array:
 
 
 def moe_mlp(
-    cfg: LlamaConfig, h: jax.Array, layer: dict, valid: jax.Array | None = None
+    cfg: LlamaConfig, h: jax.Array, layer: dict,
+    valid: jax.Array | None = None, sp_axis: str | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """h: [B, S, d] normed hidden states; layer carries ``router``
     [d, E] and expert FFN weights ``w_gate``/``w_up`` [E, d, f],
@@ -79,7 +80,21 @@ def moe_mlp(
     padding claims no expert capacity and is excluded from the aux-loss
     statistics. Returns (mlp_out [B, S, d], aux_loss scalar). Routing is
     Switch-style top-k per token, or expert-choice with
-    ``cfg.router_type == "experts_choose"``."""
+    ``cfg.router_type == "experts_choose"``.
+
+    ``sp_axis`` composes MoE with sequence parallelism (S is this
+    shard's slice, the region is manual over that axis). Token-choice
+    routing is per-token, so shard-local routing is IDENTICAL to the
+    unsharded forward as long as expert capacity does not bind; capacity
+    itself is sized from the shard's local tokens, so WHICH tokens
+    overflow to the residual path differs from the unsharded order when
+    it does bind (the same documented divergence as cached decode,
+    models/generate.py). The load-balance statistics stay globally
+    exact: f_e/p_e reduce over ``sp_axis`` (three [E]-sized psums), so
+    the aux value equals the unsharded one on every shard. Expert-choice
+    routing stays sequence-local-only: top-C token selection over a
+    shard is a different function than over the sequence, at any
+    capacity."""
     b, s, d = h.shape
     e, k = cfg.num_experts, cfg.num_experts_per_tok
     cdt = h.dtype
@@ -89,6 +104,14 @@ def moe_mlp(
     logits = (x @ layer["router"].astype(cdt)).astype(jnp.float32)  # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
     if cfg.router_type == "experts_choose":
+        if sp_axis is not None:
+            raise ValueError(
+                "expert-choice routing does not compose with sequence "
+                "parallelism: each expert's top-C token selection sees "
+                "the whole sequence, so per-shard selection computes a "
+                "different function at any capacity (arXiv:2202.09368); "
+                "use router_type='tokens_choose' with --sp"
+            )
         y, aux = _experts_choose(
             cfg, x, probs, layer, None if valid is None else valid.reshape(t)
         )
@@ -123,14 +146,23 @@ def moe_mlp(
     y = jnp.einsum("tec,ecd->td", combine.astype(cdt), out_e)
 
     # Switch load-balance loss on the top-1 assignment (pre-capacity),
-    # statistics over REAL tokens only
+    # statistics over REAL tokens only — and over the WHOLE sequence
+    # under sp (global means, not a mean of per-shard products: f_e*p_e
+    # is nonlinear, so per-shard auxes would not average to the
+    # unsharded value)
     if valid is not None:
         v = valid.reshape(t).astype(jnp.float32)
-        denom = jnp.maximum(jnp.sum(v), 1.0)
-        f_e = jnp.sum(onehot[:, 0, :], axis=0) / denom               # [E]
-        p_e = jnp.sum(probs * v[:, None], axis=0) / denom
+        num_f = jnp.sum(onehot[:, 0, :], axis=0)                     # [E]
+        num_p = jnp.sum(probs * v[:, None], axis=0)
+        den = jnp.sum(v)
     else:
-        f_e = jnp.mean(onehot[:, 0, :], axis=0)
-        p_e = jnp.mean(probs, axis=0)
-    aux = e * jnp.sum(f_e * p_e)
+        num_f = jnp.sum(onehot[:, 0, :], axis=0)
+        num_p = jnp.sum(probs, axis=0)
+        den = jnp.float32(t)
+    if sp_axis is not None:
+        num_f = jax.lax.psum(num_f, sp_axis)
+        num_p = jax.lax.psum(num_p, sp_axis)
+        den = jax.lax.psum(den, sp_axis)
+    den = jnp.maximum(den, 1.0)
+    aux = e * jnp.sum((num_f / den) * (num_p / den))
     return y.reshape(b, s, d), aux

@@ -67,13 +67,13 @@ def _pipeline_setup(cfg: LlamaConfig, S: int, sp_axis: str | None):
                 "pipeline + sequence parallelism requires "
                 f"attention_impl='ring'; got {cfg.attention_impl!r}"
             )
-        if cfg.num_experts:
-            # mirrors sp_shard_loss: per-shard routing/capacity (and the
-            # shard-local aux token weighting here) would not match the
-            # unsharded semantics
+        if cfg.num_experts and cfg.router_type == "experts_choose":
+            # token-choice MoE composes with sp (moe_mlp routes locally
+            # with globally-exact aux stats); expert-choice cannot — see
+            # moe_mlp's rejection
             raise ValueError(
-                "MoE is not supported under sequence parallelism "
-                "(pp and ep compose with MoE; sp does not, yet)"
+                "expert-choice routing does not compose with sequence "
+                "parallelism; use router_type='tokens_choose' with sp"
             )
         sp_idx = lax.axis_index(sp_axis)
         cos, sin = rope_tables(cfg, S, offset=sp_idx * S)
@@ -105,6 +105,28 @@ def _exit_loss(cfg: LlamaConfig, prm: dict, y, tok, msk, sp_axis: str | None):
         )
     targets, w = sp_shift_targets(tok, msk, sp_axis)
     return _hidden_ce(h, head, targets, w, cfg.loss_chunk)
+
+
+def _mb_token_counts(loss_mask_mb, sp_axis: str | None):
+    """Per-microbatch CE-target counts [M] — the router-aux gradient
+    weights, which must equal the n_tokens the exit loss reports (the
+    vmap path weights aux by exactly that count). Under sp the count
+    follows sp_shift_targets: the right neighbor's first mask completes
+    each shard's targets and the GLOBAL last position is dropped — raw
+    ``msk[:, :, 1:]`` sums would underweight by (sp-1)/(S-1)."""
+    if sp_axis is None:
+        return jnp.sum(loss_mask_mb[:, :, 1:].astype(jnp.float32), axis=(1, 2))
+    n = lax.psum(1, sp_axis)
+    idx = lax.axis_index(sp_axis)
+    to_left = [(j, (j - 1) % n) for j in range(n)]
+    nxt = lax.ppermute(loss_mask_mb[:, :, :1], sp_axis, to_left)
+    m = jnp.concatenate(
+        [loss_mask_mb[:, :, 1:], nxt], axis=2
+    ).astype(jnp.float32)
+    s_loc = loss_mask_mb.shape[2]
+    last_pos = (jnp.arange(s_loc) == s_loc - 1)[None, None]
+    m = m * (1.0 - last_pos * (idx == n - 1)).astype(jnp.float32)
+    return jnp.sum(m, axis=(1, 2))
 
 
 def _hidden_ce(h, head, targets, weights, chunk: int):
@@ -186,7 +208,7 @@ def pp_shard_loss(
 
     # per-microbatch token counts (the loss-shift weights), for aux
     # weighting identical to the vmap grad-accumulation path
-    n_per_mb = jnp.sum(loss_mask_mb[:, :, 1:].astype(jnp.float32), axis=(1, 2))
+    n_per_mb = _mb_token_counts(loss_mask_mb, sp_axis)
 
     coef = cfg.router_aux_coef
 
@@ -318,9 +340,18 @@ def pp_shard_grads_1f1b(
 
         y, auxes = lax.scan(body, x_in, prm["layers"])
         sl, n = _exit_loss(cfg, prm, y, tok, msk, sp_axis)
-        return y, sl, n, jnp.sum(auxes)
+        aux = jnp.sum(auxes)
+        # the aux term exactly as it enters the total loss: weighted by
+        # the exit loss's OWN token count (shard-local under sp; the
+        # per-shard weights psum to the vmap path's global n_tokens). A
+        # separate output from the raw ``aux`` because the two need
+        # different backward cotangents: the loss term backprops on
+        # every stage (mask bv), the raw statistic never does. ``n`` has
+        # no parameter dependence, so routing it into the weight adds no
+        # gradient path.
+        return y, sl, n, aux, coef * n * aux
 
-    n_per_mb = jnp.sum(loss_mask_mb[:, :, 1:].astype(jnp.float32), axis=(1, 2))
+    n_per_mb = _mb_token_counts(loss_mask_mb, sp_axis)
     coef = cfg.router_aux_coef
     Q = 2 * n_stages - 1   # max in-flight stage inputs: 2(P-1-s)+1 <= 2P-1
     T = M + 2 * n_stages - 2
@@ -336,7 +367,7 @@ def pp_shard_grads_1f1b(
         f_valid = (m_raw >= 0) & (m_raw < M)
         m_f = jnp.clip(m_raw, 0, M - 1)  # clamped: edge cycles recompute
         fv = f_valid.astype(jnp.float32)
-        y, sl, n, aux = cell(params, m_f, buf)
+        y, sl, n, aux, _auxw = cell(params, m_f, buf)
         lv = is_last * fv
         sl, n = lv * sl, lv * n
         aux_w = aux_w + fv * n_per_mb[m_f] * aux
@@ -359,26 +390,27 @@ def pp_shard_grads_1f1b(
         bv = b_valid.astype(jnp.float32)
         m_b = jnp.clip(mb_raw, 0, M - 1)
         x_saved = lax.dynamic_index_in_dim(queue, m_b % Q, 0, keepdims=False)
-        (y_p, sl_p, n_p, aux_p), pull = jax.vjp(
+        (y_p, sl_p, n_p, aux_p, auxw_p), pull = jax.vjp(
             lambda prm, xp: cell(prm, m_b, xp), params, x_saved
         )
-        # cotangents of (y, sl, n, aux): y's arrives from the next stage
-        # (zero into the last stage via the ring, see docstring); sl
-        # counts once at the exit; n is a count (no gradient); aux enters
-        # the total loss as coef * n_m * aux (the vmap path's weighting).
-        # Each adds primal * 0 so its manual-axis vary-ness matches the
-        # primal's (vjp rejects a replicated cotangent for a varying out).
-        # dense models: aux is the constant 0.0 (replicated type) and
-        # contributes nothing — its cotangent must be replicated too
-        aux_ct = (
-            bv * coef * n_per_mb[m_b] + aux_p * 0
-            if cfg.num_experts else aux_p * 0
-        )
+        # cotangents of (y, sl, n, aux, aux_weighted): y's arrives from
+        # the next stage (zero into the last stage via the ring, see
+        # docstring); sl counts once at the exit; n and the raw aux
+        # statistic carry no gradient; aux_weighted backprops on every
+        # stage that processed a valid microbatch. Each adds primal * 0
+        # so its manual-axis vary-ness matches the primal's (vjp rejects
+        # a cotangent typed differently from its output — e.g. the raw
+        # MoE aux under sp is sp-invariant after its stats psums, while
+        # bv-derived masks are not).
+        # dense models: aux terms are the constant 0.0 (replicated type)
+        # and contribute nothing — cotangents must stay replicated too
+        auxw_ct = bv + auxw_p * 0 if cfg.num_experts else auxw_p * 0
         dprm, dx = pull((
             (dybuf * bv).astype(cdt) + y_p * 0,
             bv * is_last + sl_p * 0,
             n_p * 0,
-            aux_ct,
+            aux_p * 0,
+            auxw_ct,
         ))
         grads = jax.tree.map(lambda g, d: g + d, grads, dprm)
 

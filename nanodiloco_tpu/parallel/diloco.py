@@ -127,11 +127,16 @@ class Diloco:
                 raise ValueError("pp + sp requires attention ring")
             if self.sp == 1 and model_cfg.attention_impl == "ring":
                 raise ValueError("pp without sp requires attention dense or flash")
-        if model_cfg.num_experts and self.sp > 1:
+        if (
+            model_cfg.num_experts
+            and self.sp > 1
+            and model_cfg.router_type == "experts_choose"
+        ):
             raise ValueError(
-                "MoE is not supported under sequence parallelism: per-shard "
-                "routing/capacity would not match the unsharded semantics "
-                "(pp and ep compose with MoE; sp does not, yet)"
+                "expert-choice routing does not compose with sequence "
+                "parallelism (per-shard top-C token selection is a "
+                "different function at any capacity); use "
+                "router_type='tokens_choose' with sp"
             )
         if (
             (self.sp > 1 or self.pp > 1)
@@ -343,17 +348,24 @@ class Diloco:
             opt_state = jax.tree.map(lambda x: x[0], opt_w)
             w_tokens, w_mask = tok_w[0], mask_w[0]  # [accum, B, S_loc]
 
+            coef = self.model_cfg.router_aux_coef
+
             def sum_loss_fn(p, t, m):
-                sl, n = sp_shard_loss(p, t, self.model_cfg, m, "sp")
-                return sl, n
+                sl, n, aux = sp_shard_loss(p, t, self.model_cfg, m, "sp")
+                # aux is globally exact (stats reduced over sp inside
+                # moe_mlp); weight it by the microbatch's GLOBAL token
+                # count so the psum'd gradient matches the vmap path's
+                # token-weighted accumulation exactly
+                n_glob = jax.lax.psum(n, "sp")
+                return sl + coef * n_glob * aux, (sl, n, aux)
 
             grad_fn = jax.value_and_grad(sum_loss_fn, has_aux=True)
 
             def micro(carry, batch):
-                g_acc, sl_acc, n_acc = carry
-                (sl, n), g = grad_fn(params, batch[0], batch[1])
+                g_acc, sl_acc, n_acc, aux_acc = carry
+                (_t, (sl, n, aux)), g = grad_fn(params, batch[0], batch[1])
                 g_acc = jax.tree.map(jnp.add, g_acc, g)
-                return (g_acc, sl_acc + sl, n_acc + n), None
+                return (g_acc, sl_acc + sl, n_acc + n, aux_acc + aux), None
 
             # carries must enter the scan already typed as varying over the
             # manual axes (their updates are), hence the explicit pcasts
@@ -366,8 +378,9 @@ class Diloco:
             zscalar = jax.lax.pcast(
                 jnp.zeros((), jnp.float32), ("diloco", "sp"), to="varying"
             )
-            (g_sum, sl_sum, n_sum), _ = jax.lax.scan(
-                micro, (zeros, zscalar, zscalar), (w_tokens, w_mask)
+            accum = w_tokens.shape[0]
+            (g_sum, sl_sum, n_sum, aux_sum), _ = jax.lax.scan(
+                micro, (zeros, zscalar, zscalar, zscalar), (w_tokens, w_mask)
             )
             # grads of the SUM loss: combine shard contributions over sp,
             # then normalize by the global token count — identical math to
@@ -375,12 +388,18 @@ class Diloco:
             g_sum = jax.tree.map(lambda x: jax.lax.psum(x, "sp"), g_sum)
             sl_sum = jax.lax.psum(sl_sum, "sp")
             n_sum = jax.lax.psum(n_sum, "sp")
+            # aux's value is sp-uniform already; psum/size replicates its
+            # manual-axis type for the out_specs
+            aux_sum = jax.lax.psum(aux_sum, "sp") / jax.lax.psum(1, "sp")
             grads = jax.tree.map(lambda g: g / jnp.maximum(n_sum, 1e-9), g_sum)
             updates, opt_state = self.inner_tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             # per-worker mean token loss (== mean of per-micro means for
-            # the packed equal-length sequences this path requires)
-            loss = sl_sum / jnp.maximum(n_sum, 1e-9)
+            # the packed equal-length sequences this path requires) plus
+            # the mean router aux, matching the vmap path's loss metric
+            loss = (
+                sl_sum / jnp.maximum(n_sum, 1e-9) + coef * aux_sum / accum
+            )
             return (
                 jax.tree.map(lambda x: x[None], params),
                 jax.tree.map(lambda x: x[None], opt_state),
@@ -455,9 +474,11 @@ class Diloco:
                     p, w_tokens, self.model_cfg, w_mask, "pp", sp_axis=sp_axis
                 )
                 # the differentiated value: summed CE + token-weighted
-                # router aux (zero for dense models; zero under sp, where
-                # MoE is rejected), combined over the stages — and over
-                # the sequence shards, each of which saw only its slice
+                # router aux (zero for dense models; globally-exact stats
+                # under sp, weighted by shard-local counts that psum to
+                # the global token weight), combined over the stages —
+                # and over the sequence shards, each of which saw only
+                # its slice
                 total = jax.lax.psum(sl + coef * aux_w, "pp")
                 if sp_axis is not None:
                     total = jax.lax.psum(total, sp_axis)
