@@ -14,6 +14,9 @@ Usage:
 
     python scripts/prepare_data.py --out data/c4tiny.tshrd \
         --dataset-path /path/to/c4-tiny/save_to_disk --seq-length 1024
+    # fully offline: one document per text file under a directory tree
+    python scripts/prepare_data.py --out data/local.tshrd \
+        --text-dir /usr/lib/python3.12 --text-glob '*.py'
     python scripts/prepare_data.py --out data/synth.tshrd  # synthetic corpus
 """
 
@@ -56,6 +59,35 @@ def download_dataset(name: str, config: str, save_dir: str) -> str:
     return save_dir
 
 
+def load_text_dir(root: str, patterns: str, max_docs: int = 0) -> list[str]:
+    """One document per matching file under ``root`` (recursive), sorted
+    for determinism, decoded permissively. The fully-offline corpus
+    source for environments where the hub is unreachable."""
+    import fnmatch
+
+    pats = [p.strip() for p in patterns.split(",") if p.strip()]
+    paths = []
+    for dirpath, _dirs, files in os.walk(root):
+        for name in files:
+            if any(fnmatch.fnmatch(name, p) for p in pats):
+                paths.append(os.path.join(dirpath, name))
+    paths.sort()
+    if max_docs:
+        paths = paths[:max_docs]
+    texts = []
+    for path in paths:
+        try:
+            with open(path, "rb") as f:
+                t = f.read().decode("utf-8", errors="ignore")
+        except OSError:
+            continue
+        if t.strip():
+            texts.append(t)
+    if not texts:
+        raise SystemExit(f"no text documents matched {patterns!r} under {root}")
+    return texts
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", required=True, help="output .tshrd path")
@@ -80,6 +112,14 @@ def main() -> None:
     p.add_argument("--save-dir", default=None,
                    help="save_to_disk target for --download "
                         "(default: <out>.hf)")
+    p.add_argument("--text-dir", default=None,
+                   help="build the corpus from a directory tree of plain-"
+                        "text files (one document per file) instead of an "
+                        "HF dataset — the fully-offline path")
+    p.add_argument("--text-glob", default="*.txt,*.md,*.rst,*.py",
+                   help="comma-separated patterns for --text-dir")
+    p.add_argument("--max-docs", type=int, default=0,
+                   help="cap the number of --text-dir documents (0 = all)")
     args = p.parse_args()
 
     if args.download:
@@ -89,7 +129,10 @@ def main() -> None:
         )
 
     tokenizer = get_tokenizer(args.tokenizer)
-    if args.dataset_path:
+    if args.text_dir:
+        texts = load_text_dir(args.text_dir, args.text_glob, args.max_docs)
+        source = f"text-dir({args.text_dir}, {args.text_glob})"
+    elif args.dataset_path:
         from nanodiloco_tpu.data import load_hf_dataset_texts
 
         texts = load_hf_dataset_texts(args.dataset_path)
