@@ -1,0 +1,205 @@
+"""One-command on-chip evidence capture for when the TPU claim is healthy.
+
+The round-2/3 chip wedges left the scoreboard without driver-captured
+hardware numbers (VERDICT r2 items 1-2). This script runs the full
+on-chip agenda in one sitting and records everything as JSON lines, so a
+recovered chip — whenever that happens — turns into evidence with zero
+ceremony:
+
+  1. the headline bench (``bench.py`` defaults + decode entry), and a
+     refresh of ``bench_baseline.json`` when the new number is a real
+     chip measurement;
+  2. the long-context attention sweep on the mid (414M GQA) model:
+     seq 1024/2048/4096/8192 x {dense, flash} — the measurement VERDICT
+     r2 asked to set ``attention_impl`` defaults from (the reference
+     caps sequence at 1024, ref training_utils/utils.py:45,50; long
+     context is this rebuild's differentiator);
+  3. a jax.profiler trace of a few steady-state mid-model steps.
+
+Usage (each phase also runs alone):
+    python scripts/chip_agenda.py               # everything
+    python scripts/chip_agenda.py bench sweep   # named phases
+Results append to ``perf_chip_agenda.jsonl``; the profile lands under
+``runs/profile-mid/``. Never SIGKILL this while it holds the chip —
+every phase bounds itself and exits cleanly (PERF.md operational rule).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "perf_chip_agenda.jsonl",
+)
+
+
+def record(rec: dict) -> None:
+    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), **rec}
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def chip_is_live() -> bool:
+    """Probe the accelerator claim in a child, SIGINT-first (a SIGKILL
+    mid-init is what wedges a healthy claim, PERF.md). Deliberately
+    ignores a JAX_PLATFORMS=cpu override in this shell — the agenda is
+    only meaningful on the chip, so a cpu-pinned environment must abort,
+    not silently measure CPU."""
+    import signal
+
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+    )
+    try:
+        proc.communicate(timeout=120)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+        return False
+
+
+def phase_bench() -> None:
+    """Headline bench in a child (it must claim the chip itself), with
+    the decode entry; refresh bench_baseline.json on a real-chip win."""
+    env = {**os.environ, "BENCH_DECODE": "1", "BENCH_CLAIM_WAIT_S": "60"}
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(OUT),
+    )
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    try:
+        result = json.loads(line)
+    except Exception:
+        record({"phase": "bench", "error": (proc.stderr or proc.stdout)[-400:]})
+        return
+    record({"phase": "bench", **result})
+    base_path = os.path.join(os.path.dirname(OUT), "bench_baseline.json")
+    prev = None
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            prev = json.load(f).get("tokens_per_sec_per_chip")
+    if (
+        result.get("backend") == "tpu"
+        and "degraded" not in result
+        # only a WIN refreshes: a noisy/regressed run must not lower the
+        # bar and mask itself from every later vs_baseline
+        and (prev is None or result["value"] >= prev)
+    ):
+        with open(base_path, "w") as f:
+            json.dump(
+                {
+                    "tokens_per_sec_per_chip": result["value"],
+                    "recorded": f"chip_agenda {time.strftime('%Y-%m-%d')}, "
+                    f"{result.get('device_kind')}",
+                    "note": "self-measured; reference publishes no numbers "
+                    "(BASELINE.md)",
+                },
+                f, indent=1,
+            )
+        record({"phase": "bench", "baseline_refreshed": result["value"]})
+
+
+def phase_sweep() -> None:
+    """Mid-model long-context sweep: tokens/s and MFU per (seq, attn).
+    Batch shrinks as seq grows to hold tokens/step (and HBM) roughly
+    constant. flash at block defaults; a winning flash config is the
+    evidence for flipping attention_impl defaults (VERDICT r2 item 2)."""
+    import bench
+    from nanodiloco_tpu.models import LlamaConfig
+
+    peak, kind = bench._peak_tflops()
+    for seq in (1024, 2048, 4096, 8192):
+        batch = max(1, 8192 // seq)
+        for attn in ("dense", "flash"):
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                num_hidden_layers=6, num_attention_heads=16,
+                num_key_value_heads=8, max_position_embeddings=seq,
+                dtype="bfloat16", remat=True, loss_chunk=512,
+                attention_impl=attn,
+            )
+            try:
+                r = bench.run_workload(
+                    cfg, n_dev=1, grad_accum=1, inner_steps=4, rounds=4,
+                    batch=batch, seq=seq, peak_tflops=peak,
+                    measure_sync=False,
+                )
+                record({
+                    "phase": "sweep", "seq": seq, "batch": batch,
+                    "attention": attn, "device_kind": kind, **r,
+                })
+            except Exception as e:  # OOM at some config is itself a datum
+                record({
+                    "phase": "sweep", "seq": seq, "batch": batch,
+                    "attention": attn, "error": f"{type(e).__name__}: {e}"[:300],
+                })
+
+
+def phase_profile() -> None:
+    """jax.profiler trace of steady-state mid-model steps (the missing
+    explanation for the remaining ~60% of MFU, VERDICT r2 weak #2)."""
+    import jax
+
+    import bench
+    from nanodiloco_tpu.models import LlamaConfig
+
+    peak, _ = bench._peak_tflops()
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=6, num_attention_heads=16, num_key_value_heads=8,
+        max_position_embeddings=2048, dtype="bfloat16", remat=True,
+        loss_chunk=512,
+    )
+    trace_dir = os.path.join(os.path.dirname(OUT), "runs", "profile-mid")
+    os.makedirs(trace_dir, exist_ok=True)
+    # warm once outside the trace, then capture a short timed window
+    bench.run_workload(
+        cfg, n_dev=1, grad_accum=1, inner_steps=2, rounds=1, batch=8,
+        seq=1024, peak_tflops=peak, measure_sync=False,
+    )
+    with jax.profiler.trace(trace_dir):
+        r = bench.run_workload(
+            cfg, n_dev=1, grad_accum=1, inner_steps=2, rounds=2, batch=8,
+            seq=1024, peak_tflops=peak, measure_sync=False,
+        )
+    record({"phase": "profile", "trace_dir": trace_dir, **r})
+
+
+PHASES = {"bench": phase_bench, "sweep": phase_sweep, "profile": phase_profile}
+
+
+def main() -> None:
+    names = sys.argv[1:] or list(PHASES)
+    unknown = [n for n in names if n not in PHASES]
+    if unknown:
+        raise SystemExit(f"unknown phases {unknown}; choose from {list(PHASES)}")
+    # canonical order regardless of argv: bench must run FIRST — sweep and
+    # profile claim the single-claimant chip in THIS process and never
+    # release it, so a bench child started after them would block on the
+    # held claim and degrade to CPU
+    names = [n for n in PHASES if n in names]
+    if not chip_is_live():
+        record({"phase": "abort", "reason": "accelerator claim not available"})
+        raise SystemExit(1)
+    for name in names:
+        record({"phase": name, "status": "start"})
+        PHASES[name]()
+
+
+if __name__ == "__main__":
+    main()
