@@ -46,6 +46,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "default: built-in synthetic corpus")
     p.add_argument("--llama-config-file", type=str, default=None,
                    help="HF-style model config JSON (ref configs/llama_default.json)")
+    p.add_argument("--init-hf", type=str, default=None, metavar="DIR",
+                   help="initialize weights from an HF Llama checkpoint "
+                        "directory (sharded or single-file safetensors) — "
+                        "continued pretraining. DIR/config.json supplies "
+                        "the model config unless --llama-config-file is "
+                        "given; a resumable checkpoint still wins")
     p.add_argument("--wandb-config-file", type=str, default=None)
     p.add_argument("--data-layout", type=str, default="packed",
                    choices=["packed", "padded"],
@@ -157,9 +163,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def config_from_args(args: argparse.Namespace) -> TrainConfig:
+    import os as _os
+
+    model_cfg_file = args.llama_config_file
+    if not model_cfg_file and getattr(args, "init_hf", None):
+        # the imported checkpoint's own config describes its architecture
+        candidate = _os.path.join(args.init_hf, "config.json")
+        if _os.path.exists(candidate):
+            model_cfg_file = candidate
     model = (
-        LlamaConfig.from_dict(load_config_from_file(args.llama_config_file))
-        if args.llama_config_file
+        LlamaConfig.from_dict(load_config_from_file(model_cfg_file))
+        if model_cfg_file
         else LlamaConfig()
     )
     overrides = {}
@@ -192,6 +206,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         project=args.project,
         dataset_path=args.dataset_path,
         data_layout=args.data_layout,
+        init_hf=args.init_hf,
         num_workers=args.num_workers,
         fsdp=args.fsdp,
         tp=args.tp,

@@ -229,11 +229,15 @@ class Diloco:
 
     # -- init ---------------------------------------------------------------
 
-    def init_state(self, rng: jax.Array) -> DilocoState:
+    def init_state(self, rng: jax.Array, params: Any = None) -> DilocoState:
+        """Fresh training state. ``params`` optionally supplies the model
+        weights (e.g. an HF import for continued pretraining) instead of
+        the PRNG init — every worker and the snapshot start from the same
+        tree either way, the reference's init-broadcast contract
+        (ref diloco.py:21-22)."""
         W = self.cfg.num_workers
 
-        def _init():
-            p = init_params(rng, self.model_cfg)
+        def _init(p):
             p = self._constrain(p, worker_axis=False)
             stacked = jax.tree.map(
                 lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), p
@@ -249,11 +253,17 @@ class Diloco:
                 inner_step_count=jnp.zeros((), jnp.int32),
             )
 
+        if params is not None:
+            # as a jit ARGUMENT (not a closed-over constant): an 8B
+            # import must not be baked into the executable
+            fn = lambda: jax.jit(_init)(params)
+        else:
+            fn = jax.jit(lambda: _init(init_params(rng, self.model_cfg)))
         if self.mesh.size == 1:
-            state = jax.jit(_init)()
+            state = fn()
         else:
             with jax.set_mesh(self.mesh):
-                state = jax.jit(_init)()
+                state = fn()
         return self._offload(state)
 
     # -- inner step (H of these between syncs; zero cross-worker comms) -----

@@ -197,8 +197,8 @@ class StreamingDiloco(Diloco):
 
     # -- init ----------------------------------------------------------------
 
-    def init_state(self, rng: jax.Array) -> StreamingState:  # type: ignore[override]
-        base = super().init_state(rng)
+    def init_state(self, rng: jax.Array, params=None) -> StreamingState:  # type: ignore[override]
+        base = super().init_state(rng, params=params)
         frags = [
             fragment_slice(base.snapshot, p, self.bounds, stacked=False)
             for p in range(self.scfg.num_fragments)

@@ -93,6 +93,12 @@ class TrainConfig:
     merge_alpha: float = 1.0
     outer_comm_dtype: str | None = None  # e.g. "bfloat16": halve sync traffic
     model: LlamaConfig = dataclasses.field(default_factory=LlamaConfig)
+    # initialize weights from an HF Llama checkpoint directory (sharded
+    # or single-file safetensors) — continued pretraining. Streams
+    # shard-by-shard (models/hf_interop.py); disables fit_vocab (the
+    # checkpoint defines the vocabulary); a --resume'd checkpoint still
+    # wins over it.
+    init_hf: str | None = None
     tokenizer: str | None = None     # HF name/path; None -> byte fallback
     # shrink vocab_size to the tokenizer's real vocabulary (rounded up to
     # the 128-lane MXU tile) when the config's is larger
@@ -226,6 +232,8 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         # prepare time (possibly by a larger-vocab tokenizer than the one
         # loaded here); the shard manifest below is the authority
         and not (cfg.dataset_path and cfg.dataset_path.endswith(".tshrd"))
+        # nor against an HF import: the checkpoint defines the vocabulary
+        and not cfg.init_hf
     ):
         # shrink the embedding/lm_head to the tokenizer's real vocabulary,
         # rounded up to the 128-lane MXU tile (the reference default of
@@ -331,7 +339,14 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         )
     else:
         dl = Diloco(model_cfg, dcfg, mesh)
-    state = dl.init_state(jax.random.key(cfg.seed))
+    init_tree = None
+    if cfg.init_hf:
+        from nanodiloco_tpu.models import from_hf_pretrained
+
+        if not quiet:
+            print(f"[nanodiloco] initializing weights from {cfg.init_hf}")
+        init_tree = from_hf_pretrained(cfg.init_hf, model_cfg)
+    state = dl.init_state(jax.random.key(cfg.seed), params=init_tree)
     schedule = warmup_cosine_schedule(cfg.lr, cfg.warmup_steps, cfg.total_steps)
 
     ckpt = None

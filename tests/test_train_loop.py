@@ -296,3 +296,35 @@ def test_export_hf_cli_roundtrip(tmp_path, capsys):
     with jax.default_matmul_precision("highest"):
         ours = np.asarray(forward(snapshot, jax.numpy.asarray(tokens), SMALL_MODEL))
     np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_init_hf_continued_pretraining(tmp_path):
+    """Full circle: train -> export-hf -> --init-hf starts a NEW run
+    from the exported weights (snapshot == import, every worker equal),
+    so continued pretraining begins where the export left off."""
+    import json
+
+    from nanodiloco_tpu.cli import main
+    from nanodiloco_tpu.models import LlamaConfig, from_hf_pretrained
+    from nanodiloco_tpu.parallel import Diloco, DilocoConfig, MeshConfig, build_mesh
+
+    ck, out = str(tmp_path / "ck"), str(tmp_path / "hf")
+    base = ["--total-steps", "2", "--inner-steps", "2", "--batch-size", "4",
+            "--per-device-batch-size", "2", "--seq-length", "32",
+            "--warmup-steps", "1", "--quiet", "--no-resume"]
+    main(base + ["--checkpoint-dir", ck, "--log-dir", str(tmp_path)])
+    main(["export-hf", "--checkpoint-dir", ck, "--out", out])
+
+    # library-level: init_state(params=import) seeds snapshot and workers
+    cfg = LlamaConfig.from_dict(json.load(open(out + "/config.json")))
+    imported = from_hf_pretrained(out, cfg)
+    dl = Diloco(cfg, DilocoConfig(num_workers=2), build_mesh(MeshConfig(diloco=2)))
+    state = dl.init_state(jax.random.key(0), params=imported)
+    for a, b in zip(jax.tree.leaves(state.snapshot), jax.tree.leaves(imported)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for w, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(imported)):
+        for i in range(2):
+            np.testing.assert_array_equal(np.asarray(w[i]), np.asarray(b))
+
+    # CLI end-to-end: --init-hf trains from the export
+    main(base + ["--init-hf", out, "--log-dir", str(tmp_path / "runs2")])
