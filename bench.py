@@ -179,6 +179,18 @@ def run_workload(
     return out
 
 
+def _salvage_watchdog_line(out: str) -> dict | None:
+    """Return the child's last stdout line as a result ONLY when it is the
+    SIGALRM watchdog's tagged line ({"watchdog": true, ...}); None
+    otherwise. Keeps a crashed child's failure from being silently
+    recorded as a valid measurement (ADVICE r3)."""
+    try:
+        rec = json.loads(out.strip().splitlines()[-1])
+    except Exception:
+        return None
+    return rec if isinstance(rec, dict) and rec.get("watchdog") else None
+
+
 def _run_mid_subprocess() -> dict:
     """Bench the mid-size model in a CHILD process with a timeout, so a
     compile hang or OOM at that size can never cost the headline metric.
@@ -210,21 +222,23 @@ def _run_mid_subprocess() -> dict:
             except subprocess.TimeoutExpired:
                 proc.kill()
                 out, err = proc.communicate()
-            # the child's SIGALRM watchdog prints a JSON line before
-            # exiting — salvage it rather than discarding the run
+            # the child's SIGALRM watchdog prints a tagged JSON line
+            # before exiting — salvage it rather than discarding the run
             # (ADVICE r2)
-            try:
-                return json.loads(out.strip().splitlines()[-1])
-            except Exception:
-                return {"error": f"timed out after {budget}s"}
+            salvaged = _salvage_watchdog_line(out)
+            if salvaged is not None:
+                return salvaged
+            return {"error": f"timed out after {budget}s"}
         if proc.returncode == 0:
             return json.loads(out.strip().splitlines()[-1])
         # the child's own SIGALRM watchdog exits nonzero AFTER printing a
-        # JSON line — the common overrun path; salvage it here too
-        try:
-            return json.loads(out.strip().splitlines()[-1])
-        except Exception:
-            return {"error": (err or out).strip()[-300:]}
+        # tagged JSON line — the common overrun path. Only a line carrying
+        # the "watchdog" sentinel is salvageable (ADVICE r3): any other
+        # nonzero exit is a crash whose error text must survive.
+        salvaged = _salvage_watchdog_line(out)
+        if salvaged is not None:
+            return salvaged
+        return {"error": (err or out).strip()[-300:]}
     except Exception as e:  # malformed child output must not kill main
         return {"error": f"unparseable mid result: {e}"}
 
@@ -385,7 +399,15 @@ def run_mid_only() -> None:
     budget = int(os.environ.get("BENCH_MID_TIMEOUT_S", "480"))
 
     def _bail(signum, frame):
-        print(json.dumps({"error": f"mid bench hit the {budget}s watchdog"}))
+        # "watchdog": True is the salvage sentinel — the parent only
+        # accepts a nonzero-exit child's last line as a result when it
+        # carries this tag (ADVICE r3: an arbitrary crash after printing
+        # some JSON-shaped progress line must not masquerade as a
+        # measurement)
+        print(json.dumps(
+            {"error": f"mid bench hit the {budget}s watchdog",
+             "watchdog": True}
+        ))
         raise SystemExit(1)
 
     signal.signal(signal.SIGALRM, _bail)
@@ -418,6 +440,10 @@ def run_mid_only() -> None:
         # at this size; sync share is reported by the tiny entry
         measure_sync=False,
     )
+    # disarm before printing: an alarm firing during teardown would
+    # append the tagged watchdog line AFTER a valid measurement and the
+    # parent's salvage would record the timeout instead of the result
+    signal.alarm(0)
     print(json.dumps({
         "model": "llama-mid-414M (hidden 2048 x 6 layers, GQA 16q/8kv)",
         **mid,

@@ -313,14 +313,17 @@ def save_hf_pretrained(
     # model-K-of-N shards) from a run with a different shard count would
     # otherwise win the index-first probe in _HFWeightSource and silently
     # serve stale weights — transformers.save_pretrained prunes for the
-    # same reason
-    import glob as _glob
+    # same reason. The prune is restricted to the exact names this writer
+    # emits (model.safetensors / model-NNNNN-of-NNNNN.safetensors / the
+    # index) and logs each removal, so an unrelated checkpoint sitting in
+    # out_dir is never destroyed silently (ADVICE r3).
+    import re as _re
 
-    for stale in _glob.glob(os.path.join(out_dir, "model*.safetensors")) + [
-        os.path.join(out_dir, "model.safetensors.index.json")
-    ]:
-        if os.path.exists(stale):
-            os.remove(stale)
+    _own = _re.compile(r"^model(-\d{5}-of-\d{5})?\.safetensors$")
+    for fname in sorted(os.listdir(out_dir)):
+        if _own.match(fname) or fname == "model.safetensors.index.json":
+            print(f"[nanodiloco] export: pruning previous {fname}")
+            os.remove(os.path.join(out_dir, fname))
 
     n = len(shards)
     names = (

@@ -307,6 +307,17 @@ def pp_shard_grads_1f1b(
     at fixed HBM the cheaper activations buy a larger M, which is what
     actually shrinks the bubble fraction ``2(P-1)/(M+2P-2)``.
 
+    Compute trade-off (ADVICE r3): each cycle runs the full cell once in
+    its forward half and AGAIN inside ``jax.vjp`` for its backward half
+    — the forward-half outputs are not reused by the backward, so every
+    stage pays ~2 forwards + 1 backward per microbatch. That matches
+    GPipe-with-per-layer-remat (which also recomputes each stage inside
+    the reverse wave) and is ~1.33x the forward FLOPs of a no-remat
+    GPipe — but a no-remat GPipe's O(M+P) live activations are exactly
+    the regime 1F1B exists to avoid, so against the schedules this module
+    actually offers the FLOPs are a wash and the choice is purely the
+    activation-memory / bubble trade above.
+
     Same contract as ``pp_shard_loss`` for the loss statistics; returns
     ``(grads, sum_loss, n_tok, aux_weighted, metric_sum)`` where
     ``grads`` is the UNREDUCED per-stage gradient of

@@ -29,7 +29,10 @@ class Evaluator:
         self.mesh = mesh
         cfg = model_cfg
         if cfg.attention_impl == "ring":
-            if not quiet:
+            # rank-0 gate in addition to the caller's quiet flag: an
+            # Evaluator constructed outside train() would otherwise print
+            # once per process on a pod (ADVICE r3)
+            if not quiet and jax.process_index() == 0:
                 # never-silent standard (VERDICT r2 weak #8): the swap is
                 # numerically identical but the user should know eval runs
                 # a different kernel than training
