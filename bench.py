@@ -188,7 +188,10 @@ def _salvage_watchdog_line(out: str) -> dict | None:
         rec = json.loads(out.strip().splitlines()[-1])
     except Exception:
         return None
-    return rec if isinstance(rec, dict) and rec.get("watchdog") else None
+    if not (isinstance(rec, dict) and rec.get("watchdog")):
+        return None
+    rec.pop("watchdog", None)  # transport sentinel, not a result field
+    return rec
 
 
 def _run_mid_subprocess() -> dict:

@@ -578,31 +578,65 @@ class Diloco:
 
     # -- outer step (the ONLY recurring communication) -----------------------
 
-    def _pseudograd(self, snapshot: Any, params_w: Any) -> Any:
+    def _pseudograd(
+        self, snapshot: Any, params_w: Any, worker_mask: jax.Array | None = None
+    ) -> Any:
         """Worker-averaged pseudo-gradient ``mean_w(snapshot - params_w)``.
         The mean over the stacked worker axis is the all-reduce over the
         ``diloco`` mesh axis (ref diloco.py:48-49); with ``outer_comm_dtype``
         set, each worker's delta is quantized to the wire dtype FIRST (the
         lossy step happens per worker, before any cross-worker traffic),
         then the mean accumulates in float32 so rounding error does not
-        grow with worker count beyond the intended quantization."""
-        cdt = self.cfg.outer_comm_dtype
-        if cdt is None:
-            return jax.tree.map(
-                lambda s, p: s - jnp.mean(p, axis=0), snapshot, params_w
-            )
-        dt = jnp.dtype(cdt)
-        return jax.tree.map(
-            lambda s, p: jnp.mean(
-                (s[None] - p).astype(dt).astype(jnp.float32), axis=0
-            ).astype(s.dtype),
-            snapshot, params_w,
-        )
+        grow with worker count beyond the intended quantization.
 
-    def _outer_step(self, state: DilocoState) -> DilocoState:
+        ``worker_mask`` ([W], bool/0-1) restricts the mean to SURVIVING
+        workers: a dead worker's stale replica contributes nothing and the
+        denominator shrinks to the survivor count — DiLoCo's natural
+        fault story, which the reference cannot express (a dead rank
+        kills its NCCL all-reduce outright, SURVEY §5). All-dead is
+        guarded to a zero pseudo-gradient (denominator clamped to 1), so
+        the outer step degenerates to momentum-only rather than NaN."""
+        cdt = self.cfg.outer_comm_dtype
+        if worker_mask is None:
+            if cdt is None:
+                return jax.tree.map(
+                    lambda s, p: s - jnp.mean(p, axis=0), snapshot, params_w
+                )
+            dt = jnp.dtype(cdt)
+            return jax.tree.map(
+                lambda s, p: jnp.mean(
+                    (s[None] - p).astype(dt).astype(jnp.float32), axis=0
+                ).astype(s.dtype),
+                snapshot, params_w,
+            )
+        w = worker_mask.astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(w), 1.0)
+        dt = None if cdt is None else jnp.dtype(cdt)
+
+        def masked_mean(s, p):
+            d = s[None] - p
+            if dt is not None:
+                d = d.astype(dt)
+            d = d.astype(jnp.float32)
+            # hard-exclude masked rows BEFORE the contraction: a dead
+            # worker's replica may be non-finite (divergence is a prime
+            # reason to mask it) and 0 * NaN = NaN would poison the
+            # survivor mean through a plain weighted sum
+            keep = (w > 0).reshape((-1,) + (1,) * (d.ndim - 1))
+            d = jnp.where(keep, d, 0.0)
+            # weighted sum contracts the worker axis in float32 — the
+            # all-reduce over `diloco`, just with per-worker weights
+            d = jnp.tensordot(w, d, axes=(0, 0))
+            return (d / denom).astype(s.dtype)
+
+        return jax.tree.map(masked_mean, snapshot, params_w)
+
+    def _outer_step(
+        self, state: DilocoState, worker_mask: jax.Array | None = None
+    ) -> DilocoState:
         W = self.cfg.num_workers
         # pseudo-gradient, pre-averaged (ref diloco.py:48-49)
-        delta = self._pseudograd(state.snapshot, state.params)
+        delta = self._pseudograd(state.snapshot, state.params, worker_mask)
         delta = self._constrain(delta, worker_axis=False)
         updates, outer_opt_state = self.outer_tx.update(
             delta, state.outer_opt_state, state.snapshot

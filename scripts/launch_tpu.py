@@ -169,7 +169,20 @@ def supervise(
     train_modal.py:86): run training as a child process and restart it on
     nonzero exit up to ``retries`` times. With --checkpoint-dir set the
     restart resumes bit-exactly from the last outer sync, so a TPU
-    preemption or OOM-kill costs at most one round of work."""
+    preemption or OOM-kill costs at most one round of work.
+
+    Restart vs mask-out: this supervisor implements the RESTART story —
+    the whole job resumes from the checkpoint. When only a subset of
+    workers dies (e.g. one slice of a multi-slice deployment preempted),
+    the complementary story is Diloco.outer_step's ``worker_mask``
+    ([W] validity vector, see parallel/diloco.py::_pseudograd): surviving
+    workers keep training and the next outer sync averages over survivors
+    only, excluding the dead worker's stale replica. Mask-out costs no
+    wall-clock and no lost inner steps but shrinks the effective batch
+    until the worker rejoins (it is reset to the new snapshot by the same
+    sync); restart preserves full worker count at the cost of one round.
+    Orchestrators detecting partial failure should prefer mask-out for
+    transient gaps and restart for lasting capacity loss."""
     import time
 
     if not any(f.startswith("--checkpoint-dir") for f in flags):

@@ -237,3 +237,52 @@ def test_grad_accum_scaling():
     from nanodiloco_tpu.parallel.diloco import DilocoState  # noqa: F401
 
     assert tree_max_diff(outs[0], outs[1]) < 1e-6
+
+
+def test_worker_mask_outer_sync():
+    """Worker-dropout-tolerant outer sync (beyond the reference, whose
+    dead rank kills the NCCL all-reduce, SURVEY §5): masking worker k out
+    must equal the plain outer step on a state whose worker-k replica is
+    overwritten with the survivors' mean (so the W-mean degenerates to
+    the W-1 survivor mean); an all-ones mask must match the unmasked
+    path; an all-zero mask must yield a zero pseudo-gradient (cold
+    momentum -> snapshot unchanged), not NaN."""
+    W = 4
+    mesh = build_mesh(MeshConfig(diloco=W))
+    cfg = DilocoConfig(num_workers=W, inner_steps=2, warmup_steps=2,
+                       total_steps=20, lr=1e-3)
+    dl = Diloco(TINY, cfg, mesh)
+    state = dl.init_state(jax.random.key(0))
+    tokens, lmask = make_batch(jax.random.key(1), TINY, W=W)
+    state, _ = dl.inner_step(state, tokens, lmask)
+    state, _ = dl.inner_step(state, tokens, lmask)  # lr>0: workers diverged
+
+    base = jax.tree.map(np.asarray, state)  # host master (outer_step donates)
+    mk = lambda: jax.tree.map(jnp.asarray, base)
+
+    masked = dl.outer_step(mk(), jnp.asarray([1.0, 1.0, 0.0, 1.0]))
+    surg = mk()
+    surv = jnp.asarray([0, 1, 3])
+    params = jax.tree.map(
+        lambda p: p.at[2].set(jnp.mean(p[surv], axis=0)), surg.params
+    )
+    ref = dl.outer_step(surg.replace(params=params))
+    assert tree_max_diff(masked.snapshot, ref.snapshot) < 1e-6
+
+    all_on = dl.outer_step(mk(), jnp.ones(W))
+    plain = dl.outer_step(mk())
+    assert tree_max_diff(all_on.snapshot, plain.snapshot) < 1e-6
+
+    dead = dl.outer_step(mk(), jnp.zeros(W))
+    assert tree_max_diff(dead.snapshot, base.snapshot) == 0.0
+    for leaf in jax.tree.leaves(dead.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+    # a NaN replica (divergence IS a prime reason to mask a worker out)
+    # must not poison the survivor mean: masked NaN == masked finite run
+    poisoned = mk()
+    poisoned = poisoned.replace(params=jax.tree.map(
+        lambda p: p.at[2].set(jnp.nan), poisoned.params
+    ))
+    nan_masked = dl.outer_step(poisoned, jnp.asarray([1.0, 1.0, 0.0, 1.0]))
+    assert tree_max_diff(nan_masked.snapshot, masked.snapshot) == 0.0
