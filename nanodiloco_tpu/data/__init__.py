@@ -1,7 +1,9 @@
 from nanodiloco_tpu.data.pipeline import (
     DilocoBatcher,
+    iter_hf_dataset_texts,
     load_hf_dataset_texts,
     pack_corpus,
+    pack_corpus_to_shard,
     pad_corpus,
     synthetic_corpus,
 )
@@ -10,8 +12,10 @@ from nanodiloco_tpu.data.tokenizer import ByteTokenizer, HFTokenizer, get_tokeni
 __all__ = [
     "DilocoBatcher",
     "pack_corpus",
+    "pack_corpus_to_shard",
     "pad_corpus",
     "synthetic_corpus",
+    "iter_hf_dataset_texts",
     "load_hf_dataset_texts",
     "get_tokenizer",
     "ByteTokenizer",

@@ -1,6 +1,8 @@
 """Native tokenshard loader: build, round-trip, gather, deterministic
 shuffle, and native/fallback agreement."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -132,3 +134,62 @@ def test_native_layer_under_sanitizers(tmp_path, flags):
     )
     assert proc.returncode == 0, proc.stderr[-1500:]
     assert "sanitize_test OK" in proc.stdout
+
+
+def test_shard_writer_bit_identical_to_one_pass(tmp_path):
+    """The streaming materialization path (ShardWriter + chunked
+    pack_corpus_to_shard at several forced-small flush sizes) must
+    produce a byte-identical file to write_shard(pack_corpus(...)) —
+    the past-RAM data path's correctness contract (VERDICT r3 #4)."""
+    from nanodiloco_tpu.data import get_tokenizer, pack_corpus, pack_corpus_to_shard, synthetic_corpus
+    from nanodiloco_tpu.data.tokenshard import ShardWriter
+
+    texts = synthetic_corpus(n_docs=60, seed=3)
+    tok = get_tokenizer(None)
+    seq = 128
+    one_pass = str(tmp_path / "one.tshrd")
+    write_shard(one_pass, pack_corpus(texts, tok, seq))
+    expect = open(one_pass, "rb").read()
+
+    for flush_rows in (1, 3, 1024):
+        p = str(tmp_path / f"stream{flush_rows}.tshrd")
+        with ShardWriter(p, seq) as w:
+            n = pack_corpus_to_shard(iter(texts), tok, seq, w, flush_rows=flush_rows)
+        assert open(p, "rb").read() == expect, f"flush_rows={flush_rows}"
+        ts = TokenShard(p)
+        assert ts.n_seqs == n and ts.seq_len == seq
+        ts.close()
+
+
+def test_shard_writer_too_small_raises(tmp_path):
+    from nanodiloco_tpu.data import get_tokenizer, pack_corpus_to_shard
+    from nanodiloco_tpu.data.tokenshard import ShardWriter
+
+    with ShardWriter(str(tmp_path / "t.tshrd"), 4096) as w:
+        with pytest.raises(ValueError, match="corpus too small"):
+            pack_corpus_to_shard(iter(["hi"]), get_tokenizer(None), 4096, w)
+
+
+def test_shard_writer_rejects_bad_rows(tmp_path):
+    from nanodiloco_tpu.data.tokenshard import ShardWriter
+
+    with ShardWriter(str(tmp_path / "t.tshrd"), 8) as w:
+        with pytest.raises(ValueError):
+            w.append(np.zeros((2, 9), np.int32))
+
+
+def test_shard_writer_atomic_on_failure(tmp_path):
+    """A failed streaming run must not clobber a previously good shard:
+    ShardWriter stages to .tmp and only installs on clean close."""
+    from nanodiloco_tpu.data.tokenshard import ShardWriter
+
+    p = str(tmp_path / "t.tshrd")
+    good = np.arange(16, dtype=np.int32).reshape(2, 8)
+    write_shard(p, good)
+    before = open(p, "rb").read()
+    with pytest.raises(RuntimeError):
+        with ShardWriter(p, 8) as w:
+            w.append(good)
+            raise RuntimeError("boom")
+    assert open(p, "rb").read() == before
+    assert not os.path.exists(p + ".tmp")
