@@ -198,11 +198,13 @@ def _attention(cfg: LlamaConfig, q, k, v, mask, axis_name: str | None):
 # ---------------------------------------------------------------------------
 
 def _decoder_layer(
-    cfg: LlamaConfig, x, layer: Params, cos, sin, mask, sp_axis, valid=None
+    cfg: LlamaConfig, x, layer: Params, cos, sin, mask, sp_axis, valid=None,
+    with_stats: bool = False,
 ):
     """Returns (x, aux_loss) — aux is the router load-balance term for
     MoE layers, 0.0 for dense. ``valid`` [B, S] marks real tokens so MoE
-    routing never spends expert capacity on padding."""
+    routing never spends expert capacity on padding. ``with_stats`` adds
+    the router observability vector (see moe_mlp)."""
     b, s, d = x.shape
     nh, nkv, hd = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
     cdt = x.dtype
@@ -219,25 +221,38 @@ def _decoder_layer(
     attn = _attention(cfg, q, k, v, mask, sp_axis)
     x = x + attn.reshape(b, s, nh * hd) @ layer["wo"].astype(cdt)
 
-    return mlp_block(cfg, x, layer, valid, sp_axis=sp_axis)
+    return mlp_block(cfg, x, layer, valid, sp_axis=sp_axis, with_stats=with_stats)
 
 
-def mlp_block(cfg: LlamaConfig, x, layer: Params, valid=None, sp_axis=None):
+def mlp_block(
+    cfg: LlamaConfig, x, layer: Params, valid=None, sp_axis=None,
+    with_stats: bool = False,
+):
     """The norm + (dense SwiGLU | MoE) residual half of a decoder layer,
     shared by the training forward and the cached decode path
     (models/generate.py) so the two can never drift. Returns
     (x, aux_loss) — aux is the router load-balance term, 0.0 for dense.
-    ``sp_axis``: see moe_mlp (sequence-sharded routing)."""
+    ``sp_axis``: see moe_mlp (sequence-sharded routing). ``with_stats``
+    appends the [dropped_frac, router_entropy] vector (zeros for dense)
+    — the diagnostics-probe channel, never on the training path."""
     cdt = x.dtype
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
     if cfg.num_experts:
         from nanodiloco_tpu.models.moe import moe_mlp
 
-        mlp_out, aux = moe_mlp(cfg, h, layer, valid=valid, sp_axis=sp_axis)
+        out = moe_mlp(
+            cfg, h, layer, valid=valid, sp_axis=sp_axis, with_stats=with_stats
+        )
+        if with_stats:
+            mlp_out, aux, stats = out
+            return x + mlp_out, aux, stats
+        mlp_out, aux = out
         return x + mlp_out, aux
     gate = jax.nn.silu(h @ layer["w_gate"].astype(cdt))
     up = h @ layer["w_up"].astype(cdt)
     x = x + (gate * up) @ layer["w_down"].astype(cdt)
+    if with_stats:
+        return x, jnp.zeros((), jnp.float32), jnp.zeros((2,), jnp.float32)
     return x, jnp.zeros((), jnp.float32)
 
 
@@ -250,6 +265,7 @@ def forward(
     position_offset: int | jax.Array = 0,
     return_hidden: bool = False,
     with_aux: bool = False,
+    collect_stats: bool = False,
 ) -> jax.Array:
     """tokens [B, S] int32 -> logits [B, S, vocab] float32 (or the final
     normed hidden states [B, S, d] in compute dtype if ``return_hidden`` —
@@ -261,6 +277,10 @@ def forward(
     it is combined with causal masking. ``sp_axis`` names the mesh axis the
     sequence dim is sharded over when running ring attention inside a
     shard_map; ``position_offset`` is this shard's global start position.
+
+    ``collect_stats`` (implies an extra return value; diagnostics only,
+    never the training program) appends the layer-mean MoE router stats
+    [dropped_frac, router_entropy] — see moe.make_router_stats_fn.
     """
     cdt = jnp.dtype(cfg.dtype)
     b, s = tokens.shape
@@ -277,25 +297,35 @@ def forward(
     # Bind all non-array arguments (cfg, sp_axis) BEFORE jax.checkpoint so
     # only JAX types flow through the remat boundary.
     def layer_fn(x, layer, cos, sin, mask, valid):
-        return _decoder_layer(cfg, x, layer, cos, sin, mask, sp_axis, valid)
+        return _decoder_layer(
+            cfg, x, layer, cos, sin, mask, sp_axis, valid,
+            with_stats=collect_stats,
+        )
 
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn, policy=checkpoint_policy(cfg))
 
     def scan_body(carry, layer):
-        x, aux = layer_fn(carry, layer, cos, sin, mask, attn_mask)
-        return x, aux
+        out = layer_fn(carry, layer, cos, sin, mask, attn_mask)
+        return out[0], out[1:]
 
-    x, auxes = jax.lax.scan(scan_body, x, params["layers"])
-    aux = jnp.sum(auxes)
+    x, ys = jax.lax.scan(scan_body, x, params["layers"])
+    aux = jnp.sum(ys[0])
+    stats = jnp.mean(ys[1], axis=0) if collect_stats else None  # [2]
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+
+    def pack(out):
+        if collect_stats:
+            return (out, aux, stats) if with_aux else (out, stats)
+        return (out, aux) if with_aux else out
+
     if return_hidden:
-        return (x, aux) if with_aux else x
+        return pack(x)
     head = params.get("lm_head", None)
     if head is None:
         head = params["embed"].T
     logits = (x @ head.astype(cdt)).astype(jnp.float32)
-    return (logits, aux) if with_aux else logits
+    return pack(logits)
 
 
 # ---------------------------------------------------------------------------

@@ -402,6 +402,31 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             eval_rows, cfg.per_device_batch_size, mask_rows=eval_mask_rows
         )
 
+    # MoE observability: once per outer sync, probe the snapshot's router
+    # on one microbatch — dropped-token fraction + router entropy land in
+    # the JSONL, so capacity-bound dropping / router collapse can't stay
+    # silent (a collapsed router otherwise looks perfectly healthy in the
+    # loss for a long time)
+    moe_stats_fn = None
+    if model_cfg.num_experts:
+        from nanodiloco_tpu.models.moe import make_router_stats_fn
+
+        moe_stats_fn = make_router_stats_fn(model_cfg)
+
+    _moe_probe_err: list = []
+
+    def moe_probe(snapshot, tok_bs) -> dict:
+        if moe_stats_fn is None or _moe_probe_err:
+            return {}
+        try:
+            stats = moe_stats_fn(snapshot, jnp.asarray(tok_bs))
+            return {k: float(v) for k, v in stats.items()}
+        except Exception as e:  # exotic sharding the probe can't place
+            _moe_probe_err.append(e)
+            if not quiet:
+                print(f"[nanodiloco] MoE router-stats probe disabled: {e}")
+            return {}
+
     start_step = int(state.inner_step_count)
     # actual row width (padded layout rounds to a multiple of 8 and can
     # be shorter than --seq-length; tshrd shards fix their own length)
@@ -517,10 +542,24 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 if evaluator is not None and rnd % cfg.eval_every == 0:
                     eval_metrics = evaluator(state.snapshot, eval_set)
                     last_eval_step, last_eval = real_step, eval_metrics
-                losses = np.asarray(losses)  # [H, W]
+                if moe_stats_fn is not None:
+                    # new dict (not .update): eval_metrics may be aliased
+                    # by last_eval / the returned summary, and the token
+                    # index would dispatch a throwaway gather on dense runs
+                    eval_metrics = {
+                        **eval_metrics,
+                        **moe_probe(state.snapshot, toks[-1, 0, 0]),
+                    }
+                # reduce the worker axis ON DEVICE first: losses is [H, W]
+                # sharded over `diloco`, which spans other processes on a
+                # pod — np.asarray of the raw array would raise on
+                # non-addressable shards (caught by test_multihost.py);
+                # the mean's output is replicated, so every host can
+                # fetch it
+                losses_h = np.asarray(jnp.mean(losses, axis=1))  # [H]
                 for i in range(cfg.inner_steps):
                     step = real_step - cfg.inner_steps + 1 + i
-                    step_loss = float(losses[i].mean())
+                    step_loss = float(losses_h[i])
                     logger.log(
                         {
                             **(eval_metrics if i == cfg.inner_steps - 1 else {}),
@@ -536,7 +575,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         },
                         step=step,
                     )
-                last_loss = float(losses[-1].mean())
+                last_loss = float(losses_h[-1])
         finally:
             if pending is not None:
                 pending.cancel()
@@ -593,6 +632,11 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             eval_metrics = evaluator(state.snapshot, eval_set)
             last_eval_step = real_step
             last_eval = eval_metrics
+        if synced and moe_stats_fn is not None:
+            eval_metrics = {
+                **eval_metrics,
+                **moe_probe(state.snapshot, tokens[0, 0]),
+            }
 
         last_loss = float(jnp.mean(loss))
         total_time = compute_time + sync_timer.total
