@@ -76,7 +76,15 @@ def chip_is_live() -> bool:
 def phase_bench() -> None:
     """Headline bench in a child (it must claim the chip itself), with
     the decode entry; refresh bench_baseline.json on a real-chip win."""
-    env = {**os.environ, "BENCH_DECODE": "1", "BENCH_CLAIM_WAIT_S": "60"}
+    env = {
+        **os.environ,
+        "BENCH_DECODE": "1",
+        # round-4 additions: the MoE workload and the streaming-vs-
+        # classic comparison ride the same chip sitting
+        "BENCH_MOE": "1",
+        "BENCH_STREAMING": "1",
+        "BENCH_CLAIM_WAIT_S": "60",
+    }
     proc = subprocess.run(
         [sys.executable, "bench.py"], capture_output=True, text=True, env=env,
         cwd=os.path.dirname(OUT),
