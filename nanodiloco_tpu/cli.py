@@ -113,6 +113,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--outer-comm-dtype", type=str, default=None,
                    help="wire dtype of the outer all-reduce payload "
                         "(e.g. bfloat16 halves sync traffic)")
+    p.add_argument("--quarantine-nonfinite", action="store_true",
+                   help="mask any worker with a non-finite inner loss out "
+                        "of the outer sync's mean; the sync's reset then "
+                        "self-heals the diverged replica (classic rounds "
+                        "only)")
     p.add_argument("--tokenizer", type=str, default=None,
                    help="HF tokenizer name/path; default byte-level fallback")
     p.add_argument("--fit-vocab", action=argparse.BooleanOptionalAction,
@@ -223,6 +228,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         tokenizer=args.tokenizer,
         fit_vocab=args.fit_vocab,
         offload_snapshot=args.offload_snapshot,
+        quarantine_nonfinite=args.quarantine_nonfinite,
         fused_rounds=args.fused_rounds,
         measure_comm=measure_comm,
         eval_every=args.eval_every,

@@ -74,6 +74,18 @@ class DilocoConfig:
     # DCN/ICI traffic; pseudo-gradients are noise-tolerant — the reference
     # always reduced in fp32). None = reduce in the snapshot's dtype.
     outer_comm_dtype: str | None = None
+    # Divergence quarantine: a worker whose replica holds any non-finite
+    # value at sync time (exact criterion, checked in _outer_step; a
+    # non-finite inner loss during the round ANDs in as an extra reason)
+    # is masked out of the outer mean (see _pseudograd's worker_mask),
+    # its Adam moments are zeroed (NaN moments never decay, so a reset
+    # without this is permanent W-1 degradation), and it resets — like
+    # every worker — to the healthy survivors' new snapshot: one
+    # replica's blow-up self-heals at the next sync instead of poisoning
+    # the global model. Computed INSIDE the fused round program (no host
+    # round-trip). The reference has no analog: its NaN would all-reduce
+    # into every rank.
+    quarantine_nonfinite: bool = False
 
 
 class DilocoState(struct.PyTreeNode):
@@ -631,10 +643,58 @@ class Diloco:
 
         return jax.tree.map(masked_mean, snapshot, params_w)
 
+    def _replica_finite_mask(self, params_w: Any) -> jax.Array:
+        """[W] bool: worker w's replica contains only finite values.
+        The EXACT quarantine criterion — loss finiteness alone has a
+        one-step hole (per-step losses are computed from PRE-update
+        params, so a gradient spike on the round's final inner update
+        slips past a loss-only mask; found by round-4 review)."""
+        flags = [
+            jnp.all(jnp.isfinite(p), axis=tuple(range(1, p.ndim)))
+            for p in jax.tree.leaves(params_w)
+        ]
+        ok = flags[0]
+        for f in flags[1:]:
+            ok = ok & f
+        return ok
+
+    def _heal_inner_opt(self, inner_opt_state: Any, keep: jax.Array) -> Any:
+        """Zero masked workers' float optimizer leaves (Adam m/v etc.) —
+        a fresh-init equivalent. Without this the quarantined worker's
+        NaN moments re-poison it on the next round's first update (NaN
+        propagates through b1*m + (1-b1)*g forever) and the 'self-heal'
+        is permanent W-1 degradation. Integer leaves (schedule counts)
+        are shared cadence, kept in sync for every worker."""
+        W = self.cfg.num_workers
+
+        def heal(leaf):
+            if (
+                not hasattr(leaf, "dtype")
+                or not jnp.issubdtype(leaf.dtype, jnp.inexact)
+                or leaf.ndim == 0
+                or leaf.shape[0] != W
+            ):
+                return leaf
+            k = keep.reshape((-1,) + (1,) * (leaf.ndim - 1))
+            return jnp.where(k, leaf, jnp.zeros_like(leaf))
+
+        return jax.tree.map(heal, inner_opt_state)
+
     def _outer_step(
         self, state: DilocoState, worker_mask: jax.Array | None = None
     ) -> DilocoState:
         W = self.cfg.num_workers
+        inner_opt_state = state.inner_opt_state
+        if self.cfg.quarantine_nonfinite:
+            # exact criterion, applied in BOTH dispatch paths: replica
+            # params must be finite (any caller-provided loss-based mask
+            # is ANDed in — it can only add reasons to quarantine)
+            pmask = self._replica_finite_mask(state.params)
+            worker_mask = (
+                pmask if worker_mask is None
+                else (worker_mask.astype(bool) & pmask)
+            )
+            inner_opt_state = self._heal_inner_opt(inner_opt_state, worker_mask)
         # pseudo-gradient, pre-averaged (ref diloco.py:48-49)
         delta = self._pseudograd(state.snapshot, state.params, worker_mask)
         delta = self._constrain(delta, worker_axis=False)
@@ -649,7 +709,9 @@ class Diloco:
         )
         params = self._constrain(params, worker_axis=True)
         return state.replace(
-            params=params, snapshot=snapshot, outer_opt_state=outer_opt_state
+            params=params, snapshot=snapshot,
+            inner_opt_state=inner_opt_state,
+            outer_opt_state=outer_opt_state,
         )
 
     def _round_step(self, state: DilocoState, tokens: jax.Array, loss_mask: jax.Array):
@@ -674,7 +736,14 @@ class Diloco:
             return s, loss
 
         state, losses = jax.lax.scan(one, state, (tokens, loss_mask))
-        state = self._outer_step(state)
+        wmask = None
+        if self.cfg.quarantine_nonfinite:
+            # [H, W] -> [W]: a non-finite inner loss is an EXTRA reason
+            # to quarantine; the exact criterion (replica-params
+            # finiteness, which also catches a blow-up on the round's
+            # final update) is applied inside _outer_step
+            wmask = jnp.all(jnp.isfinite(losses), axis=0)
+        state = self._outer_step(state, wmask)
         return state, losses
 
     def _inner_round_step(self, state: DilocoState, tokens, loss_mask):

@@ -138,6 +138,14 @@ class StreamingDiloco(Diloco):
     def __init__(self, model_cfg, cfg: DilocoConfig, mesh, scfg: StreamingConfig,
                  **kwargs):
         super().__init__(model_cfg, cfg, mesh, **kwargs)
+        if cfg.quarantine_nonfinite:
+            raise ValueError(
+                "quarantine_nonfinite is classic-DiLoCo-only: streaming's "
+                "fragment launches are staggered mid-round, so there is no "
+                "single sync point at which a round's [W] finiteness "
+                "verdict exists yet; run classic rounds (or restart via "
+                "--supervise) for fault quarantine"
+            )
         self.scfg = scfg
         H, P = cfg.inner_steps, scfg.num_fragments
         if scfg.delay >= H:

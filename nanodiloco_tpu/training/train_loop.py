@@ -92,6 +92,10 @@ class TrainConfig:
     streaming_delay: int = 1
     merge_alpha: float = 1.0
     outer_comm_dtype: str | None = None  # e.g. "bfloat16": halve sync traffic
+    # mask any worker with a non-finite inner loss out of the outer mean
+    # (parallel/diloco.py::DilocoConfig.quarantine_nonfinite); the reset
+    # self-heals the diverged replica at the same sync
+    quarantine_nonfinite: bool = False
     model: LlamaConfig = dataclasses.field(default_factory=LlamaConfig)
     # initialize weights from an HF Llama checkpoint directory (sharded
     # or single-file safetensors) — continued pretraining. Streams
@@ -219,6 +223,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         pp_schedule=cfg.pp_schedule,
         offload_snapshot=cfg.offload_snapshot,
         outer_comm_dtype=cfg.outer_comm_dtype,
+        quarantine_nonfinite=cfg.quarantine_nonfinite,
     )
 
     tokenizer = get_tokenizer(cfg.tokenizer)
@@ -556,7 +561,24 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 # non-addressable shards (caught by test_multihost.py);
                 # the mean's output is replicated, so every host can
                 # fetch it
-                losses_h = np.asarray(jnp.mean(losses, axis=1))  # [H]
+                quarantine_metrics = {}
+                if cfg.quarantine_nonfinite:
+                    # a quarantined worker's NaN must not flow into the
+                    # logged loss (an operator would kill a run the
+                    # feature just saved) — masked mean + an explicit
+                    # event count instead
+                    fin = jnp.isfinite(losses)
+                    losses_h = np.asarray(
+                        jnp.where(fin, losses, 0.0).sum(axis=1)
+                        / jnp.maximum(fin.sum(axis=1), 1)
+                    )
+                    quarantine_metrics = {
+                        "quarantined_workers": int(
+                            cfg.num_workers - jnp.all(fin, axis=0).sum()
+                        )
+                    }
+                else:
+                    losses_h = np.asarray(jnp.mean(losses, axis=1))  # [H]
                 for i in range(cfg.inner_steps):
                     step = real_step - cfg.inner_steps + 1 + i
                     step_loss = float(losses_h[i])
@@ -571,6 +593,10 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                             "tokens_per_sec": (real_step - start_step) * tokens_per_step
                             / compute_time,
                             "outer_synced": int(i == cfg.inner_steps - 1),
+                            **(
+                                quarantine_metrics
+                                if i == cfg.inner_steps - 1 else {}
+                            ),
                             **fused_sync_metrics,
                         },
                         step=step,
@@ -581,6 +607,8 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 pending.cancel()
             prefetcher.shutdown(wait=False)
 
+    round_ok = None  # per-round device-side [W] finiteness (quarantine)
+    quarantined_last_round = 0
     for real_step in ([] if fused else range(start_step + 1, cfg.total_steps + 1)):
         if cfg.profile_dir and real_step == profile_start:
             jax.profiler.start_trace(cfg.profile_dir)
@@ -605,12 +633,28 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     ckpt.save(real_step, state)
         else:
             state, loss = dl.inner_step(state, dl.feed(tokens), dl.feed(mask))
+            if cfg.quarantine_nonfinite:
+                # accumulate ON DEVICE ([W] stays diloco-sharded; a host
+                # fetch of the raw loss would fail on a pod) — one & per
+                # step, consumed by the sync below
+                round_ok = (
+                    jnp.isfinite(loss) if round_ok is None
+                    else round_ok & jnp.isfinite(loss)
+                )
             synced = real_step % cfg.inner_steps == 0
             if synced:
                 jax.block_until_ready(state.params)
                 compute_time += time.perf_counter() - t0
                 with sync_timer:
-                    state = dl.outer_step(state)
+                    if cfg.quarantine_nonfinite:
+                        # loss-finiteness count for the log; the sync
+                        # itself additionally applies the exact replica-
+                        # params check inside _outer_step
+                        quarantined_last_round = int(
+                            cfg.num_workers - round_ok.sum()
+                        )
+                    state = dl.outer_step(state, round_ok)
+                    round_ok = None
                     jax.block_until_ready(state.params)
                 state = dl._offload(state)
                 if ckpt and (real_step // cfg.inner_steps) % cfg.checkpoint_every == 0:
@@ -638,7 +682,20 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 **moe_probe(state.snapshot, tokens[0, 0]),
             }
 
-        last_loss = float(jnp.mean(loss))
+        if cfg.quarantine_nonfinite:
+            # same masked-mean treatment as the fused path: a healed
+            # worker's NaN step loss must not poison the logged metric
+            fin_l = jnp.isfinite(loss)
+            last_loss = float(
+                jnp.where(fin_l, loss, 0.0).sum() / jnp.maximum(fin_l.sum(), 1)
+            )
+            if synced:
+                eval_metrics = {
+                    **eval_metrics,
+                    "quarantined_workers": quarantined_last_round,
+                }
+        else:
+            last_loss = float(jnp.mean(loss))
         total_time = compute_time + sync_timer.total
         logger.log(
             {

@@ -356,3 +356,27 @@ def test_train_loop_moe_logs_router_stats(tmp_path):
         for l in lines:
             if not l["outer_synced"]:
                 assert "moe_dropped_frac" not in l
+
+
+def test_train_loop_quarantine_logs_and_stays_healthy(tmp_path):
+    """--quarantine-nonfinite on a healthy run: no worker quarantined,
+    the count is logged on sync lines, and the final loss matches the
+    same run without the flag (all-ones mask == unmasked math)."""
+    base = train(small_cfg(tmp_path / "off"))
+    summary = train(small_cfg(tmp_path / "on", quarantine_nonfinite=True))
+    assert np.isfinite(summary["final_loss"])
+    np.testing.assert_allclose(
+        summary["final_loss"], base["final_loss"], rtol=1e-5
+    )
+    runs = os.listdir(tmp_path / "on" / "runs")
+    lines = [json.loads(l) for l in open(tmp_path / "on" / "runs" / runs[0])]
+    synced = [l for l in lines if l["outer_synced"]]
+    assert synced and all(l["quarantined_workers"] == 0 for l in synced)
+    assert all("quarantined_workers" not in l for l in lines if not l["outer_synced"])
+
+
+def test_cli_quarantine_flag():
+    from nanodiloco_tpu.cli import build_parser, config_from_args
+
+    args = build_parser().parse_args(["--quarantine-nonfinite"])
+    assert config_from_args(args).quarantine_nonfinite is True
