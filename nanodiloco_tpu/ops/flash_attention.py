@@ -29,6 +29,22 @@ from jax import lax
 from nanodiloco_tpu.ops.online_softmax import block_update, finalize_grouped
 
 
+def _env_block(name: str) -> int | None:
+    """Validated positive-int env knob, or None when unset/empty."""
+    import os
+
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a positive integer, got {raw!r}")
+    if v <= 0:
+        raise ValueError(f"{name} must be a positive integer, got {raw!r}")
+    return v
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -51,19 +67,27 @@ def flash_attention(
         )
     if impl not in (None, "pallas", "scan"):
         raise ValueError(f"unknown flash attention impl: {impl!r}")
+    # Pallas tile knobs (NANODILOCO_PALLAS_BLOCK_Q/K, default 128x128):
+    # read at trace time, so a block-size sweep (scripts/chip_agenda.py
+    # phase "pallas") retunes without code edits. Each fresh jit closure
+    # (new Diloco / new jit of the caller) picks up the current value;
+    # an already-compiled executable keeps the blocks it was traced with.
+    # Only consulted on pallas-relevant paths; validated so a malformed
+    # value fails with a clear message, not mid-grid-math.
+    if impl != "scan":
+        bq = _env_block("NANODILOCO_PALLAS_BLOCK_Q") or min(128, block_size)
+        bk = _env_block("NANODILOCO_PALLAS_BLOCK_K") or min(128, block_size)
     if impl is None:
         s = q.shape[1]
-        blk = min(128, block_size)
-        pallas_ok = (
-            jax.default_backend() == "tpu" and s % min(blk, s) == 0
+        pallas_ok = jax.default_backend() == "tpu" and (
+            s % min(bq, s) == 0 and s % min(bk, s) == 0
         )
         impl = "pallas" if pallas_ok else "scan"
     if impl == "pallas":
         from nanodiloco_tpu.ops.pallas.flash_attention import pallas_flash_attention
 
-        blk = min(128, block_size)
         return pallas_flash_attention(
-            q, k, v, causal=causal, block_q=blk, block_k=blk
+            q, k, v, causal=causal, block_q=bq, block_k=bk
         )
     return _flash_attention_scan(q, k, v, causal=causal, block_size=block_size)
 

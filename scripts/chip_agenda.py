@@ -188,7 +188,61 @@ def phase_profile() -> None:
     record({"phase": "profile", "trace_dir": trace_dir, **r})
 
 
-PHASES = {"bench": phase_bench, "sweep": phase_sweep, "profile": phase_profile}
+def phase_pallas() -> None:
+    """Pallas flash-attention tile sweep on the mid model (VERDICT r3
+    item 2: the 128x128 default has no measurement behind it). Each
+    (block_q, block_k) point re-runs the workload with the env knobs
+    set; run_workload builds a fresh Diloco per call, so the knobs are
+    re-read at trace time. Records tokens/s per tile; the winner is the
+    evidence for changing the flash_attention defaults."""
+    import bench
+    from nanodiloco_tpu.models import LlamaConfig
+
+    peak, kind = bench._peak_tflops()
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=6, num_attention_heads=16, num_key_value_heads=8,
+        max_position_embeddings=4096, dtype="bfloat16", remat=True,
+        loss_chunk=512, attention_impl="flash",
+    )
+    keys = ("NANODILOCO_PALLAS_BLOCK_Q", "NANODILOCO_PALLAS_BLOCK_K")
+    saved = {k: os.environ.get(k) for k in keys}
+    try:
+        for bq, bk in ((128, 128), (128, 256), (256, 128), (256, 256),
+                       (128, 512), (512, 128), (512, 512)):
+            os.environ["NANODILOCO_PALLAS_BLOCK_Q"] = str(bq)
+            os.environ["NANODILOCO_PALLAS_BLOCK_K"] = str(bk)
+            try:
+                r = bench.run_workload(
+                    cfg, n_dev=1, grad_accum=1, inner_steps=4, rounds=3,
+                    batch=2, seq=4096, peak_tflops=peak, measure_sync=False,
+                )
+                record({
+                    "phase": "pallas", "block_q": bq, "block_k": bk,
+                    "device_kind": kind, **r,
+                })
+            except Exception as e:  # a tile that doesn't fit VMEM is a datum
+                record({
+                    "phase": "pallas", "block_q": bq, "block_k": bk,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                })
+    finally:
+        # restore whatever the operator had exported — later phases in
+        # this process (and phase subprocesses via **os.environ) must see
+        # the operator's tuning, not this sweep's last point
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+PHASES = {
+    "bench": phase_bench,
+    "sweep": phase_sweep,
+    "pallas": phase_pallas,
+    "profile": phase_profile,
+}
 
 
 def main() -> None:

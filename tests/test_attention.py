@@ -308,3 +308,50 @@ def test_gqa_full_model_flash_matches_dense():
         out_d = forward(params, tokens, cfg_d)
     np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_block_env_knobs(monkeypatch):
+    """NANODILOCO_PALLAS_BLOCK_Q/K are read at trace time and reach the
+    kernel; numerics must be identical across tile choices."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nanodiloco_tpu.ops.flash_attention import flash_attention
+
+    q = jax.random.normal(jax.random.key(0), (1, 64, 4, 8), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (1, 64, 2, 8), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (1, 64, 2, 8), jnp.float32)
+    base = flash_attention(q, k, v, impl="pallas")
+
+    # spy: equality alone can't prove the knobs reach the kernel (ignored
+    # knobs would also produce identical numerics)
+    import nanodiloco_tpu.ops.flash_attention as fa
+    from nanodiloco_tpu.ops.pallas.flash_attention import pallas_flash_attention as real
+
+    seen = {}
+
+    def spy(q, k, v, causal=True, block_q=128, block_k=128, interpret=None):
+        seen.update(block_q=block_q, block_k=block_k)
+        return real(q, k, v, causal=causal, block_q=block_q,
+                    block_k=block_k, interpret=interpret)
+
+    monkeypatch.setattr(
+        "nanodiloco_tpu.ops.pallas.flash_attention.pallas_flash_attention", spy
+    )
+    monkeypatch.setenv("NANODILOCO_PALLAS_BLOCK_Q", "16")
+    monkeypatch.setenv("NANODILOCO_PALLAS_BLOCK_K", "32")
+    tuned = fa.flash_attention(q, k, v, impl="pallas")
+    assert seen == {"block_q": 16, "block_k": 32}
+    np.testing.assert_allclose(np.asarray(tuned), np.asarray(base), atol=1e-5)
+
+    # malformed values fail loudly, not mid-grid-math
+    monkeypatch.setenv("NANODILOCO_PALLAS_BLOCK_Q", "abc")
+    with __import__("pytest").raises(ValueError, match="positive integer"):
+        fa.flash_attention(q, k, v, impl="pallas")
+    monkeypatch.setenv("NANODILOCO_PALLAS_BLOCK_Q", "-128")
+    with __import__("pytest").raises(ValueError, match="positive integer"):
+        fa.flash_attention(q, k, v, impl="pallas")
+    # scan path never consults the knobs
+    out = fa.flash_attention(q, k, v, impl="scan")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base), atol=1e-5)
