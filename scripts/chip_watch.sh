@@ -1,0 +1,71 @@
+#!/bin/bash
+# Watch for the wedged TPU claim to clear, then capture the full on-chip
+# evidence set in one sitting (PERF.md round-4 plan). The probe is
+# SIGINT-first with a grace period — never a SIGKILL mid-init (the event
+# that wedges a healthy claim, PERF.md) — and asserts the probed backend
+# is a real accelerator: a CPU fallback (or an env-pinned JAX_PLATFORMS=
+# cpu) reads as NOT live, so the agenda can never silently measure CPU.
+# Probe exit codes: 0 = live accelerator, 2 = wedged/not-live (keep
+# waiting), anything else = hard error (abort — an unattended watcher
+# must not sleep for hours on an ImportError).
+# Usage: bash scripts/chip_watch.sh [max_probes] [sleep_s]
+cd "$(dirname "$0")/.." || exit 1
+max=${1:-60}
+pause=${2:-600}
+for i in $(seq 1 "$max"); do
+  python - <<'EOF'
+import os
+import signal
+import subprocess
+import sys
+
+env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+code = (
+    "import jax, sys; jax.devices(); "
+    "sys.exit(0 if jax.default_backend() != 'cpu' else 3)"
+)
+proc = subprocess.Popen(
+    [sys.executable, "-c", code],
+    stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+)
+try:
+    proc.communicate(timeout=120)
+except subprocess.TimeoutExpired:
+    proc.send_signal(signal.SIGINT)
+    try:
+        proc.communicate(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+    sys.exit(2)  # blocked init: the stuck-claim signature
+if proc.returncode == 0:
+    sys.exit(0)  # live accelerator
+if proc.returncode == 3:
+    sys.exit(2)  # CPU fallback: clean not-live
+sys.exit(1)      # probe itself broke -> hard error
+EOF
+  rc=$?
+  case $rc in
+    0)
+      echo "chip_watch: claim LIVE at $(date -Is); running agenda" >&2
+      # sanitized launch: CPU-repro env (JAX_PLATFORMS + BENCH_* smoke
+      # shapes from PERF.md's reproduce line) must not leak into the
+      # on-chip evidence run
+      env -u JAX_PLATFORMS -u BENCH_SEQ -u BENCH_BATCH -u BENCH_ROUNDS \
+          -u BENCH_INNER_STEPS -u BENCH_GRAD_ACCUM -u BENCH_CPU_DEVICES \
+          -u BENCH_DEVICES -u BENCH_MID -u XLA_FLAGS \
+          python scripts/chip_agenda.py
+      exit $?
+      ;;
+    2)
+      echo "chip_watch: probe $i/$max not live at $(date -Is); sleeping ${pause}s" >&2
+      sleep "$pause"
+      ;;
+    *)
+      echo "chip_watch: probe errored (rc=$rc) — aborting, fix the probe" >&2
+      exit 1
+      ;;
+  esac
+done
+echo "chip_watch: gave up after $max probes" >&2
+exit 1
