@@ -433,6 +433,11 @@ def run_streaming(degraded: bool = False) -> dict:
 
 
 def main() -> None:
+    # opt-in persistent compile cache (see utils.enable_compile_cache):
+    # repeated bench runs skip the 20-40 s first-compiles
+    from nanodiloco_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
     from nanodiloco_tpu.models import LlamaConfig
 
     degraded = _ensure_live_backend()

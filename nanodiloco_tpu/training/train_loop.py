@@ -38,7 +38,13 @@ from nanodiloco_tpu.parallel.diloco import Diloco, DilocoConfig
 from nanodiloco_tpu.parallel.mesh import MeshConfig, build_mesh
 from nanodiloco_tpu.training.metrics import MetricsLogger, SyncTimer
 from nanodiloco_tpu.training.optim import warmup_cosine_schedule
-from nanodiloco_tpu.utils.utils import create_run_name, resolve_run_name, set_seed_all
+from nanodiloco_tpu.utils.utils import (
+    create_run_name,
+    device_memory_stats,
+    enable_compile_cache,
+    resolve_run_name,
+    set_seed_all,
+)
 
 
 @dataclasses.dataclass
@@ -130,6 +136,10 @@ class TrainConfig:
 def train(cfg: TrainConfig) -> dict[str, Any]:
     """Run the full DiLoCo training job; returns a summary dict."""
     set_seed_all(cfg.seed)
+    # opt-in persistent XLA compile cache ($NANODILOCO_COMPILE_CACHE):
+    # first compiles cost 20-40 s each through the tunneled runtime and a
+    # run compiles several programs — later process starts go warm
+    enable_compile_cache()
     # rank-0-only console: on a pod every process runs this function;
     # unguarded prints would interleave N copies of each notice
     # (VERDICT r2 missing #3 — the observability gap the reference also
@@ -555,6 +565,9 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         **eval_metrics,
                         **moe_probe(state.snapshot, toks[-1, 0, 0]),
                     }
+                # per-sync HBM occupancy (empty dict on backends without
+                # memory_stats, e.g. CPU — keys appear only when real)
+                eval_metrics = {**eval_metrics, **device_memory_stats()}
                 # reduce the worker axis ON DEVICE first: losses is [H, W]
                 # sharded over `diloco`, which spans other processes on a
                 # pod — np.asarray of the raw array would raise on
@@ -681,6 +694,8 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 **eval_metrics,
                 **moe_probe(state.snapshot, tokens[0, 0]),
             }
+        if synced:
+            eval_metrics = {**eval_metrics, **device_memory_stats()}
 
         if cfg.quarantine_nonfinite:
             # same masked-mean treatment as the fused path: a healed

@@ -1,5 +1,7 @@
 from nanodiloco_tpu.utils.utils import (
     create_run_name,
+    device_memory_stats,
+    enable_compile_cache,
     ensure_live_backend,
     force_virtual_cpu_devices,
     set_seed_all,
@@ -7,6 +9,8 @@ from nanodiloco_tpu.utils.utils import (
 
 __all__ = [
     "create_run_name",
+    "device_memory_stats",
+    "enable_compile_cache",
     "ensure_live_backend",
     "force_virtual_cpu_devices",
     "set_seed_all",

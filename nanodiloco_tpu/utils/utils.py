@@ -21,6 +21,60 @@ def set_seed_all(seed: int = 42) -> None:
     np.random.seed(seed)
 
 
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``path`` (or
+    ``$NANODILOCO_COMPILE_CACHE``; no-op when neither is set). First
+    compiles through the tunneled TPU runtime cost 20-40 s per program
+    (PERF.md) and a DiLoCo run compiles several (inner round, full
+    round, eval, probes) — the on-disk cache makes every later process
+    start warm. Returns the cache dir in effect, or None. Safe to call
+    more than once; failures degrade to no cache (never fatal)."""
+    import jax
+
+    path = path or os.environ.get("NANODILOCO_COMPILE_CACHE")
+    if not path:
+        return None
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache every compilation, however fast: the tunnel's dispatch
+        # overhead dominates tiny programs too
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return path
+    except Exception as e:
+        # degraded, never fatal — but an operator who SET the env var
+        # must see why it had no effect (never-silent standard)
+        try:
+            rank0 = jax.process_index() == 0
+        except Exception:
+            rank0 = True
+        if rank0:
+            print(f"[nanodiloco] compile cache at {path!r} disabled: {e}")
+        return None
+
+
+def device_memory_stats() -> dict[str, int]:
+    """{"hbm_bytes_in_use": ..., "hbm_peak_bytes": ...} from the first
+    addressable device, or {} where the backend has no memory_stats
+    (CPU). The per-sync observability line the reference never had —
+    an OOM trajectory is visible in the JSONL before it kills the run."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return {}
+    if not stats:
+        return {}
+    out = {}
+    if "bytes_in_use" in stats:
+        out["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
+    if "peak_bytes_in_use" in stats:
+        out["hbm_peak_bytes"] = int(stats["peak_bytes_in_use"])
+    return out
+
+
 def force_virtual_cpu_devices(n: int, strict: bool = True) -> bool:
     """Reconfigure JAX to expose ``n`` virtual CPU devices for sharding
     dev/debug. Must run before ANYTHING initializes a backend (even

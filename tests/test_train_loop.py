@@ -380,3 +380,37 @@ def test_cli_quarantine_flag():
 
     args = build_parser().parse_args(["--quarantine-nonfinite"])
     assert config_from_args(args).quarantine_nonfinite is True
+
+
+def test_compile_cache_and_memory_stats(tmp_path, monkeypatch):
+    """enable_compile_cache honors $NANODILOCO_COMPILE_CACHE (no-op when
+    unset); device_memory_stats returns {} on backends without
+    memory_stats (CPU) so no fake HBM keys ever reach the JSONL."""
+    from nanodiloco_tpu.utils import device_memory_stats, enable_compile_cache
+
+    monkeypatch.delenv("NANODILOCO_COMPILE_CACHE", raising=False)
+    assert enable_compile_cache() is None
+    # save the conftest-configured session cache settings; restore them
+    # even on assert failure so no later test compiles cache-disabled
+    saved = {
+        k: getattr(jax.config, k)
+        for k in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes",
+        )
+    }
+    try:
+        cache = tmp_path / "xla-cache"
+        monkeypatch.setenv("NANODILOCO_COMPILE_CACHE", str(cache))
+        assert enable_compile_cache() == str(cache)
+        assert cache.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(cache)
+    finally:
+        for k, v in saved.items():
+            jax.config.update(k, v)
+
+    stats = device_memory_stats()
+    assert isinstance(stats, dict)
+    for k in stats:
+        assert k in ("hbm_bytes_in_use", "hbm_peak_bytes")
