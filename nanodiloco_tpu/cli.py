@@ -151,8 +151,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval-batches", type=int, default=8,
                    help="number of held-out eval batches to reserve")
     p.add_argument("--profile-dir", type=str, default=None,
-                   help="write a jax.profiler trace of a few steady-state "
-                        "steps to this directory")
+                   help="write a jax.profiler trace to this directory: one "
+                        "whole warm round under fused dispatch (the "
+                        "default), a few steady-state steps under "
+                        "--no-fused-rounds/streaming")
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--checkpoint-every", type=int, default=1,
                    help="checkpoint cadence in outer syncs")

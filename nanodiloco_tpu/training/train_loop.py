@@ -86,7 +86,9 @@ class TrainConfig:
     # end on a v5e chip); per-step losses are still logged. Default ON:
     # this is the fast path a TPU user should get without asking; it
     # falls back to stepwise dispatch (with a printed notice) for
-    # streaming, profiling, and mid-round resume.
+    # streaming and mid-round resume. Profiling works in BOTH modes:
+    # fused traces one whole warm round, stepwise traces a per-step
+    # window.
     fused_rounds: bool = True
     # estimate the outer sync's real wall-clock share in fused mode by
     # differencing a warm full round against a warm inner-only round.
@@ -116,7 +118,9 @@ class TrainConfig:
     offload_snapshot: bool = False
     eval_every: int = 0       # evaluate the snapshot every N outer syncs (0=off)
     eval_batches: int = 8     # held-out batches (never trained on)
-    profile_dir: str | None = None  # write a jax.profiler trace of a few steps
+    # jax.profiler trace target: one whole warm round (fused mode) or a
+    # few steady-state steps (stepwise mode)
+    profile_dir: str | None = None
     checkpoint_dir: str | None = None
     checkpoint_every: int = 1        # in outer syncs
     resume: bool = True
@@ -456,9 +460,11 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
 
     compute_time = 0.0
     last_loss = float("nan")
-    # jax.profiler trace of a few steady-state steps (the subsystem the
-    # reference stubbed but never built, SURVEY §5 "Tracing / profiling").
-    # Clamped so a resume close to total_steps still produces a trace.
+    # jax.profiler tracing (the subsystem the reference stubbed but never
+    # built, SURVEY §5 "Tracing / profiling"): fused runs trace ONE warm
+    # round (see the fused loop); stepwise runs trace a few steady-state
+    # steps via the window below, clamped so a resume close to
+    # total_steps still produces a trace.
     profile_start = min(start_step + 3, cfg.total_steps)
     profile_stop = min(profile_start + 3, cfg.total_steps)
     profiling = False
@@ -467,15 +473,12 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     fused = (
         cfg.fused_rounds
         and start_step % cfg.inner_steps == 0  # mid-round resume -> stepwise
-        and not cfg.profile_dir  # per-step tracing needs stepwise dispatch
     )
     if cfg.fused_rounds and not fused and not quiet:
-        reasons = []
-        if start_step % cfg.inner_steps:
-            reasons.append(f"resume at step {start_step} is mid-round")
-        if cfg.profile_dir:
-            reasons.append("per-step profiler traces need stepwise dispatch")
-        print(f"[nanodiloco] fused rounds disabled: {'; '.join(reasons)}")
+        print(
+            "[nanodiloco] fused rounds disabled: resume at step "
+            f"{start_step} is mid-round"
+        )
     # fused-mode comm estimate (the sync is compiled into the round
     # program, so its cost is measured by differencing against an
     # inner-only round — not reported as a fake 0.0)
@@ -506,6 +509,13 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             if first_round <= last_round
             else None
         )
+        # trace ONE warm fused round — the real training cadence (H inner
+        # steps + the outer sync in a single program), which a per-step
+        # stepwise trace cannot show. The second round where possible so
+        # compile and the comm-measurement pause stay out of the capture.
+        profile_round = (
+            min(first_round + 1, last_round) if cfg.profile_dir else None
+        )
         try:
             for rnd in range(first_round, last_round + 1):
                 toks, masks = pending.result()
@@ -513,10 +523,20 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 measuring = cfg.measure_comm and est_inner_s is None
                 if rnd < last_round and not measuring:
                     pending = prefetcher.submit(dl.stack_round_batches, batches)
-                t0 = time.perf_counter()
-                state, losses = dl.round_step(state, toks, masks)
-                jax.block_until_ready(losses)
-                round_s = time.perf_counter() - t0
+                tracing = rnd == profile_round
+                if tracing:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                try:
+                    t0 = time.perf_counter()
+                    state, losses = dl.round_step(state, toks, masks)
+                    jax.block_until_ready(losses)
+                    round_s = time.perf_counter() - t0
+                finally:
+                    # a failing traced round must still flush/stop the
+                    # global profiler or every later train() hits
+                    # "profiling is already in progress"
+                    if tracing:
+                        jax.profiler.stop_trace()
                 compute_time += round_s
                 state = dl._offload(state)
                 if cfg.measure_comm:
@@ -539,7 +559,11 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                             jax.block_until_ready(probe_loss)
                             best_full_s = time.perf_counter() - t0
                             del probe
-                    else:
+                    elif not tracing:
+                        # the traced round's wall clock carries profiler
+                        # collection overhead — feeding it into the min
+                        # would overstate sync cost on short runs whose
+                        # only warm round is the traced one
                         best_full_s = min(best_full_s or round_s, round_s)
                     if best_full_s is not None:
                         sync_s = max(0.0, best_full_s - est_inner_s)

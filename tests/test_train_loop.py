@@ -207,8 +207,15 @@ def test_train_loop_eval_and_profile(tmp_path):
     sync_lines = [l for l in lines if l["outer_synced"]]
     assert all("eval_loss" in l for l in sync_lines)
     assert not any("eval_loss" in l for l in lines if not l["outer_synced"])
-    # profiler artifacts exist
+    # profiler artifacts exist — this run used the fused default, so the
+    # trace captured a whole warm round (H steps + sync in one program)
     assert any((tmp_path / "prof").rglob("*.xplane.pb"))
+    # stepwise dispatch traces its per-step window too
+    train(small_cfg(
+        tmp_path / "sw", fused_rounds=False,
+        profile_dir=str(tmp_path / "prof-sw"),
+    ))
+    assert any((tmp_path / "prof-sw").rglob("*.xplane.pb"))
 
 
 def test_evaluator_matches_direct_loss(tmp_path):
