@@ -14,6 +14,7 @@ import os
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
 from nanodiloco_tpu.parallel.diloco import DilocoState
@@ -105,6 +106,103 @@ class CheckpointManager:
             )
         finally:
             mngr.close()
+
+    def saved_worker_count(self, step: int | None = None) -> int:
+        """Leading (worker) dimension of the checkpoint's stacked params,
+        read from metadata only — no array data touched."""
+        step = self.latest_step if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        # the training manager's explicit StandardCheckpointHandler makes
+        # item_metadata work without a save in this process (see __init__)
+        meta = self._mngr.item_metadata(step).tree
+        return int(jax.tree.leaves(meta["params"])[0].shape[0])
+
+    def restore_elastic(
+        self, fresh_state: DilocoState, step: int | None = None
+    ) -> DilocoState:
+        """Restore into a DIFFERENT worker count — the capacity-change
+        story the fault path needs (a permanently lost slice must not
+        strand the checkpoint; the reference's stacked NCCL world can
+        only ever come back at the same size).
+
+        Valid because checkpoints are written at outer-sync boundaries,
+        where every worker equals the snapshot: the restored snapshot,
+        outer optimizer state, and step count are exact, and the new
+        worker stacking is rebuilt by re-broadcasting the snapshot —
+        precisely what ``_outer_step``'s reset would produce. The cost,
+        stated honestly: inner Adam MOMENTS restart at zero for every
+        worker (they are per-worker state with the old W and cannot be
+        reshaped meaningfully); the schedule count is advanced to the
+        restored step so the LR does NOT re-warm — with zeroed moments
+        and a warm count, the first post-resume updates are damped and
+        recover within tens of steps. Same-W resumes keep using
+        ``restore`` (bit-exact, moments included).
+
+        ``fresh_state``: a freshly initialized state at the NEW worker
+        count whose leaves carry the target shardings. Like
+        ``restore_raw``, the snapshot materializes on one device before
+        re-sharding — fine below ~8B; shard the restore for bigger."""
+        raw = self.restore_raw(
+            step, only={"snapshot", "outer_opt_state", "inner_step_count"}
+        )
+        count = jax.device_put(
+            jnp.asarray(raw["inner_step_count"], jnp.int32),
+            fresh_state.inner_step_count.sharding,  # replicate on the mesh
+        )
+
+        def put_tree(raw_tree, target_tree, what):
+            # restore_raw returns plain nested dicts (orbax flattens
+            # optax NamedTuples to keyed dicts), so map by FLATTENED
+            # leaf order against the live target structure, with a
+            # shape guard against any ordering mismatch
+            raw_leaves = jax.tree.leaves(raw_tree)
+            tgt_leaves, treedef = jax.tree.flatten(target_tree)
+            if len(raw_leaves) != len(tgt_leaves):
+                raise ValueError(
+                    f"elastic restore: {what} has {len(raw_leaves)} saved "
+                    f"leaves vs {len(tgt_leaves)} in the target (different "
+                    "optimizer?)"
+                )
+            placed = []
+            for r, t in zip(raw_leaves, tgt_leaves):
+                r = jnp.asarray(r)
+                if r.shape != t.shape:
+                    raise ValueError(
+                        f"elastic restore: {what} leaf shape {r.shape} != "
+                        f"target {t.shape} (leaf-order mismatch or "
+                        "different model config)"
+                    )
+                placed.append(jax.device_put(r, t.sharding))
+            return jax.tree.unflatten(treedef, placed)
+
+        snapshot = put_tree(raw["snapshot"], fresh_state.snapshot, "snapshot")
+        outer = put_tree(
+            raw["outer_opt_state"], fresh_state.outer_opt_state,
+            "outer_opt_state",
+        )
+        params = jax.tree.map(
+            lambda t, s: jax.device_put(
+                jnp.broadcast_to(s[None], t.shape), t.sharding
+            ),
+            fresh_state.params, snapshot,
+        )
+
+        def advance(leaf):
+            # integer leaves are optimizer step counts (schedule + Adam
+            # bias correction): advance them to the restored step; float
+            # moments stay at fresh-init zero
+            if jnp.issubdtype(leaf.dtype, jnp.integer):
+                return jax.device_put(
+                    jnp.full(leaf.shape, count, leaf.dtype), leaf.sharding
+                )
+            return leaf
+
+        inner = jax.tree.map(advance, fresh_state.inner_opt_state)
+        return fresh_state.replace(
+            params=params, snapshot=snapshot, inner_opt_state=inner,
+            outer_opt_state=outer, inner_step_count=count,
+        )
 
     def close(self) -> None:
         self._mngr.close()

@@ -391,7 +391,38 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     f, indent=1,
                 )
         if cfg.resume and ckpt.latest_step is not None:
-            state = ckpt.restore(abstract_state_like(state))
+            saved_w = ckpt.saved_worker_count()
+            if saved_w == cfg.num_workers:
+                state = ckpt.restore(abstract_state_like(state))
+            elif streaming:
+                raise ValueError(
+                    f"checkpoint was written with {saved_w} workers but "
+                    f"this run has {cfg.num_workers}, and elastic resume "
+                    "is classic-DiLoCo-only: a streaming checkpoint's "
+                    "params != snapshot mid-stagger and its per-fragment "
+                    "outer states don't re-broadcast; resume streaming at "
+                    "the saved worker count"
+                )
+            elif jax.process_count() > 1:
+                raise ValueError(
+                    "elastic resume is single-controller-only for now: "
+                    "restore_elastic materializes the snapshot on one "
+                    "device, which a multi-process pod cannot address; "
+                    "run the one-off elastic restore single-process, "
+                    "checkpoint, then launch the pod at the new size"
+                )
+            else:
+                # elastic resume: capacity changed across the restart (a
+                # lost slice, a grown deployment). Exact at the sync
+                # boundary; inner Adam moments restart (restore_elastic).
+                if not quiet:
+                    print(
+                        f"[nanodiloco] elastic resume: checkpoint has "
+                        f"{saved_w} workers, run has {cfg.num_workers}; "
+                        "snapshot/outer state restored exactly, inner "
+                        "moments reset (LR schedule continues)"
+                    )
+                state = ckpt.restore_elastic(state)
 
     # resolve_run_name broadcasts process 0's name so a pod produces ONE
     # run identity (an explicit --run-name is already identical on all

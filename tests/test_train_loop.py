@@ -421,3 +421,62 @@ def test_compile_cache_and_memory_stats(tmp_path, monkeypatch):
     assert isinstance(stats, dict)
     for k in stats:
         assert k in ("hbm_bytes_in_use", "hbm_peak_bytes")
+
+
+def test_elastic_resume_across_worker_counts(tmp_path):
+    """A checkpoint saved at W=4 resumes at W=2 (a permanently lost
+    slice must not strand the checkpoint): snapshot/outer state restore
+    exactly, every new worker re-broadcasts from the snapshot, the LR
+    schedule continues (integer opt leaves advanced), and training runs
+    on to completion. The reference's NCCL world can only come back at
+    the same size."""
+    from nanodiloco_tpu.training.checkpoint import CheckpointManager
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    train(small_cfg(tmp_path / "a", num_workers=4, total_steps=3,
+                    checkpoint_dir=ckpt_dir))
+    mngr = CheckpointManager(ckpt_dir)
+    assert mngr.saved_worker_count() == 4
+    saved_snap = mngr.restore_raw(only={"snapshot"})["snapshot"]
+    mngr.close()
+
+    # unit-level: restore into a fresh W=2 state
+    from nanodiloco_tpu.parallel import Diloco, DilocoConfig, MeshConfig, build_mesh
+
+    dl = Diloco(SMALL_MODEL, DilocoConfig(
+        num_workers=2, inner_steps=3, warmup_steps=2, total_steps=6, lr=1e-3,
+        grad_accum=2,
+    ), build_mesh(MeshConfig(diloco=2)))
+    fresh = dl.init_state(jax.random.key(7))
+    mngr = CheckpointManager(ckpt_dir)
+    state = mngr.restore_elastic(fresh)
+    mngr.close()
+    assert int(state.inner_step_count) == 3
+    for a, b in zip(jax.tree.leaves(state.snapshot), jax.tree.leaves(saved_snap)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for w in range(2):
+        worker = jax.tree.map(lambda p: np.asarray(p[w]), state.params)
+        for a, b in zip(jax.tree.leaves(worker), jax.tree.leaves(state.snapshot)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ints = [l for l in jax.tree.leaves(state.inner_opt_state)
+            if np.issubdtype(np.asarray(l).dtype, np.integer)]
+    assert ints and all((np.asarray(l) == 3).all() for l in ints)
+
+    # end-to-end: the W=2 run picks the checkpoint up and finishes
+    summary = train(small_cfg(tmp_path / "b", num_workers=2, total_steps=6,
+                              checkpoint_dir=ckpt_dir))
+    assert np.isfinite(summary["final_loss"])
+    runs = os.listdir(tmp_path / "b" / "runs")
+    lines = [json.loads(l) for l in open(tmp_path / "b" / "runs" / runs[0])]
+    assert [l["step"] for l in lines] == [4, 5, 6]  # resumed, not replayed
+
+
+def test_elastic_resume_rejected_for_streaming(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    train(small_cfg(tmp_path / "a", num_workers=2, total_steps=3,
+                    streaming_fragments=2, streaming_delay=1,
+                    checkpoint_dir=ckpt_dir))
+    with pytest.raises(ValueError, match="classic-DiLoCo-only"):
+        train(small_cfg(tmp_path / "b", num_workers=4, total_steps=6,
+                        streaming_fragments=2, streaming_delay=1,
+                        checkpoint_dir=ckpt_dir))
