@@ -263,6 +263,9 @@ def build_generate_parser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=0.8,
                    help="0 = greedy decoding")
     p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=1.0,
+                   help="nucleus sampling: keep the smallest token set "
+                        "with probability mass >= p (1.0 = off)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--step", type=int, default=None,
                    help="checkpoint step to load (default: latest)")
@@ -307,7 +310,7 @@ def generate_main(argv: list[str]) -> None:
     stop = getattr(tokenizer, "eos_id", None) if args.stop_at_eos else None
     out = generate(
         params, prompt, model_cfg, args.max_new_tokens,
-        temperature=args.temperature, top_k=args.top_k,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         key=jax.random.key(args.seed),
         stop_token=stop,
     )

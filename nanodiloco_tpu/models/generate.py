@@ -179,14 +179,30 @@ def _auto_decode_block(context_len: int) -> int:
     return 512 if context_len >= 1024 else 0
 
 
-def _sample(logits, key, temperature: float, top_k: int):
-    """[B, V] logits -> [B] int32. temperature 0 = greedy (key unused)."""
+def _sample(logits, key, temperature: float, top_k: int, top_p: float = 1.0):
+    """[B, V] logits -> [B] int32. temperature 0 = greedy (key unused);
+    ``top_k`` keeps the k best logits; ``top_p`` < 1 keeps the smallest
+    set of tokens whose probability mass reaches p (nucleus sampling;
+    applied after top_k, both post-temperature)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, MASK_VALUE, logits)
+    if 0.0 < top_p < 1.0:
+        # a sorted token is IN the nucleus iff the mass strictly before
+        # it is < p (so the best token always survives, and when float
+        # rounding keeps the cumsum below p — top_p ~ 1.0 on a big
+        # vocab — the filter gracefully removes nothing instead of
+        # collapsing to greedy)
+        sl = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+        probs = jax.nn.softmax(sl, axis=-1)
+        keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+        thresh = jnp.min(
+            jnp.where(keep, sl, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < thresh, MASK_VALUE, logits)
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
@@ -194,7 +210,7 @@ def _sample(logits, key, temperature: float, top_k: int):
 def _build_generate(
     cfg: LlamaConfig, batch: int, prompt_len: int, max_new_tokens: int,
     temperature: float, top_k: int, mesh=None, stop_token: int | None = None,
-    decode_block: int = 0,
+    decode_block: int = 0, top_p: float = 1.0,
 ):
     s_max = prompt_len + max_new_tokens
     # blockwise attention needs a block-aligned cache; the extra slots are
@@ -229,7 +245,7 @@ def _build_generate(
             block=decode_block,
         )
         key, k0 = jax.random.split(key)
-        tok0 = _sample(logits, k0, temperature, top_k)
+        tok0 = _sample(logits, k0, temperature, top_k, top_p)
         if max_new_tokens == 1:
             return tok0[:, None]
 
@@ -248,7 +264,7 @@ def _build_generate(
                 params, cfg, tok[:, None], cache, pos, key_valid, dec_valid,
                 block=decode_block,
             )
-            nxt = _sample(logits, step_key, temperature, top_k)
+            nxt = _sample(logits, step_key, temperature, top_k, top_p)
             if stop_token is not None:
                 nxt = jnp.where(done, jnp.int32(stop_token), nxt)
                 done = done | (nxt == stop_token)
@@ -275,6 +291,7 @@ def generate(
     prompt_valid: jax.Array | None = None,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     key: jax.Array | None = None,
     mesh=None,
     stop_token: int | None = None,
@@ -283,8 +300,8 @@ def generate(
     """Sample ``max_new_tokens`` continuations of ``prompt`` [B, P].
 
     Returns the new tokens [B, max_new_tokens] (int32). ``temperature=0``
-    is greedy decoding; otherwise pass ``key`` (and optionally ``top_k``)
-    for stochastic sampling. ``prompt_valid`` [B, P] marks real prompt
+    is greedy decoding; otherwise pass ``key`` (and optionally ``top_k``
+    and/or nucleus ``top_p``) for stochastic sampling. ``prompt_valid`` [B, P] marks real prompt
     tokens for left-padded variable-length prompts (default: all real).
     ``mesh`` shards the decode over its ``tp``/``fsdp`` axes (the
     training sharding rules, parallel/sharding.py) — for models too big
@@ -324,6 +341,8 @@ def generate(
         raise ValueError(f"temperature must be >= 0; got {temperature}")
     if top_k < 0:
         raise ValueError(f"top_k must be >= 0; got {top_k}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
     top_k = min(int(top_k), cfg.vocab_size)  # top-k over everything == no cut
     if temperature > 0.0 and key is None:
         raise ValueError("stochastic sampling (temperature > 0) requires a PRNG key")
@@ -339,6 +358,7 @@ def generate(
     fn = _build_generate(
         cfg, b, p, int(max_new_tokens), float(temperature), int(top_k), mesh,
         None if stop_token is None else int(stop_token), int(decode_block),
+        float(top_p),
     )
     if mesh is not None:
         with jax.set_mesh(mesh):

@@ -225,3 +225,71 @@ def test_sharded_blockwise_decode_matches_single_device():
         single = generate(params, prompt, cfg, 8, decode_block=8)
         sharded = generate(params, prompt, cfg, 8, mesh=mesh, decode_block=8)
     np.testing.assert_array_equal(np.asarray(single), np.asarray(sharded))
+
+
+def test_top_p_nucleus_sampling():
+    """top_p semantics at the _sample level: the nucleus always contains
+    the best token (tiny p == near-greedy), excluded tokens are never
+    drawn, and top_p=1.0 is a no-op against plain temperature sampling."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nanodiloco_tpu.models.generate import _sample
+
+    # logits with a clear ranking: token 0 holds ~57% of the mass
+    logits = jnp.log(jnp.asarray([[0.57, 0.23, 0.1, 0.06, 0.04]]))
+    keys = jax.random.split(jax.random.key(0), 200)
+
+    # tiny p: nucleus = {best token} -> deterministic despite temperature
+    draws = np.asarray([_sample(logits, k, 1.0, 0, 0.05)[0] for k in keys[:20]])
+    assert (draws == 0).all()
+
+    # p=0.7: nucleus = {0, 1} (0.57+0.23 >= 0.7) -> tokens 2-4 never drawn
+    draws = np.asarray([int(_sample(logits, k, 1.0, 0, 0.7)[0]) for k in keys])
+    assert set(draws) == {0, 1}
+
+    # p=1.0 must be bit-identical to the unfiltered path
+    for k in keys[:20]:
+        a = _sample(logits, k, 1.0, 0, 1.0)
+        b = _sample(logits, k, 1.0, 0)
+        assert int(a[0]) == int(b[0])
+
+
+def test_generate_top_p_runs_end_to_end():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nanodiloco_tpu.models import LlamaConfig, generate, init_params
+
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_attention_heads=4, num_hidden_layers=2, max_position_embeddings=64,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, 64)
+    out = generate(params, prompt, cfg, 8, temperature=0.8, top_p=0.9,
+                   key=jax.random.key(2))
+    assert out.shape == (2, 8)
+    assert np.asarray((out >= 0) & (out < 64)).all()
+    import pytest
+
+    with pytest.raises(ValueError, match="top_p"):
+        generate(params, prompt, cfg, 4, temperature=0.8, top_p=0.0,
+                 key=jax.random.key(2))
+
+
+def test_top_p_near_one_degrades_gracefully():
+    """top_p within float rounding of 1.0 must remove (almost) nothing,
+    never collapse to greedy (cumsum may never reach p in float32)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nanodiloco_tpu.models.generate import _sample
+
+    logits = jnp.zeros((1, 50_000))  # uniform: worst case for the cumsum
+    keys = jax.random.split(jax.random.key(3), 50)
+    draws = {int(_sample(logits, k, 1.0, 0, 0.99999)[0]) for k in keys}
+    assert len(draws) > 10  # still sampling broadly, not pinned to idx 0
