@@ -398,6 +398,27 @@ def export_hf_main(argv: list[str]) -> None:
     print(f"exported {', '.join(written)} to {args.out}")
 
 
+def report_main(argv: list[str]) -> None:
+    """``nanodiloco_tpu report RUN.jsonl``: one-screen operator summary
+    of a training run's metrics stream (the JSONL is the source of
+    truth, metrics.py) — loss/eval trend, throughput, sync share,
+    quarantine events, HBM peak, MoE router health."""
+    p = argparse.ArgumentParser(prog="nanodiloco_tpu report")
+    p.add_argument("jsonl", help="metrics JSONL written by training")
+    p.add_argument("--json", action="store_true",
+                   help="print the summary as one JSON object")
+    args = p.parse_args(argv)
+
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    summary = summarize_run(args.jsonl)
+    if args.json:
+        print(json.dumps(summary))
+        return
+    for k, v in summary.items():
+        print(f"{k:>24}: {v}")
+
+
 def main(argv: list[str] | None = None) -> None:
     import sys
 
@@ -407,6 +428,9 @@ def main(argv: list[str] | None = None) -> None:
         return
     if argv and argv[0] == "export-hf":
         export_hf_main(argv[1:])
+        return
+    if argv and argv[0] == "report":
+        report_main(argv[1:])
         return
     args = build_parser().parse_args(argv)
     if args.force_cpu_devices:

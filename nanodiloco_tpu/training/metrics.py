@@ -119,3 +119,68 @@ class MetricsLogger:
             self._file = None
         if self._wandb:
             self._wandb.finish()
+
+
+def summarize_run(path: str) -> dict[str, Any]:
+    """One-screen summary of a training JSONL (the ``report`` CLI): loss
+    and eval trajectory, throughput, sync share, and — when the run
+    recorded them — quarantine events, HBM peak, and MoE router health.
+    Keys appear only when the underlying metric was logged, mirroring
+    the logger's own never-fake-zeros schema."""
+    recs = []
+    torn = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                # a live writer mid-append (or a crash) leaves a torn
+                # trailing line; an operator report must summarize the
+                # valid records, not traceback
+                torn += 1
+    if not recs:
+        raise ValueError(f"no metric records in {path}")
+
+    def series(key):
+        return [r[key] for r in recs if r.get(key) is not None]
+
+    losses = series("loss")
+    out: dict[str, Any] = {
+        "steps": recs[-1].get("step", len(recs)),
+        "records": len(recs),
+        **({"torn_lines_skipped": torn} if torn else {}),
+        "first_loss": round(losses[0], 4) if losses else None,
+        "final_loss": round(losses[-1], 4) if losses else None,
+        "best_loss": round(min(losses), 4) if losses else None,
+    }
+    evals = series("eval_loss")
+    if evals:
+        out["first_eval_loss"] = round(evals[0], 4)
+        out["final_eval_loss"] = round(evals[-1], 4)
+    tps = series("tokens_per_sec")
+    if tps:
+        out["tokens_per_sec_last"] = round(tps[-1], 1)
+    shares = series("comm_share")
+    if shares:
+        out["comm_share_last"] = round(shares[-1], 5)
+    syncs = [r for r in recs if r.get("outer_synced")]
+    out["outer_syncs"] = len(syncs)
+    quar = series("quarantined_workers")
+    if quar:
+        out["quarantine_events"] = int(sum(1 for q in quar if q > 0))
+        out["max_quarantined_workers"] = int(max(quar))
+    hbm = series("hbm_peak_bytes")
+    if hbm:
+        out["hbm_peak_gib"] = round(max(hbm) / 2**30, 3)
+    drop = series("moe_dropped_frac")
+    if drop:
+        out["moe_dropped_frac_last"] = round(drop[-1], 5)
+        out["moe_dropped_frac_max"] = round(max(drop), 5)
+    ent = series("moe_router_entropy")
+    if ent:
+        out["moe_router_entropy_last"] = round(ent[-1], 4)
+        out["moe_router_entropy_min"] = round(min(ent), 4)
+    return out

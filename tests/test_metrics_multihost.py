@@ -151,3 +151,60 @@ def test_wandb_rank_gated_on_pod(tmp_path, monkeypatch):
     logger.log({"loss": 1.0}, step=1)
     logger.finish()
     assert fake.calls == []
+
+
+def test_summarize_run(tmp_path):
+    """The report CLI's summary: trajectory + conditional keys mirror
+    exactly what the run logged (no fake zeros for absent metrics)."""
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    path = tmp_path / "run.jsonl"
+    recs = [
+        {"loss": 5.0, "tokens_per_sec": 100.0, "outer_synced": 0, "step": 1},
+        {"loss": 4.0, "tokens_per_sec": 120.0, "outer_synced": 1, "step": 2,
+         "eval_loss": 4.5, "comm_share": 0.01, "quarantined_workers": 0,
+         "moe_dropped_frac": 0.0, "moe_router_entropy": 1.3},
+        {"loss": 3.5, "tokens_per_sec": 130.0, "outer_synced": 1, "step": 3,
+         "eval_loss": 4.1, "comm_share": 0.02, "quarantined_workers": 2,
+         "moe_dropped_frac": 0.1, "moe_router_entropy": 1.1},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    s = summarize_run(str(path))
+    assert s["steps"] == 3 and s["outer_syncs"] == 2
+    assert s["first_loss"] == 5.0 and s["final_loss"] == 3.5 == s["best_loss"]
+    assert s["final_eval_loss"] == 4.1
+    assert s["quarantine_events"] == 1 and s["max_quarantined_workers"] == 2
+    assert s["moe_dropped_frac_max"] == 0.1
+    assert s["moe_router_entropy_min"] == 1.1
+    assert "hbm_peak_gib" not in s  # never logged -> never summarized
+
+    # dense run: no MoE/quarantine keys at all
+    path2 = tmp_path / "dense.jsonl"
+    with open(path2, "w") as f:
+        f.write(json.dumps({"loss": 2.0, "outer_synced": 1, "step": 1}) + "\n")
+    s2 = summarize_run(str(path2))
+    assert "moe_dropped_frac_last" not in s2 and "quarantine_events" not in s2
+
+
+def test_report_cli(tmp_path, capsys):
+    from nanodiloco_tpu.cli import main
+
+    path = tmp_path / "r.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"loss": 2.0, "outer_synced": 1, "step": 1}) + "\n")
+    main(["report", str(path), "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["final_loss"] == 2.0 and out["outer_syncs"] == 1
+
+
+def test_summarize_run_tolerates_torn_trailing_line(tmp_path):
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    path = tmp_path / "torn.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"loss": 2.0, "outer_synced": 1, "step": 1}) + "\n")
+        f.write('{"loss": 1.9, "outer_syn')  # writer killed mid-append
+    s = summarize_run(str(path))
+    assert s["final_loss"] == 2.0 and s["torn_lines_skipped"] == 1
