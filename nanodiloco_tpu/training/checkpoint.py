@@ -140,47 +140,78 @@ class CheckpointManager:
         ``restore`` (bit-exact, moments included).
 
         ``fresh_state``: a freshly initialized state at the NEW worker
-        count whose leaves carry the target shardings. Like
-        ``restore_raw``, the snapshot materializes on one device before
-        re-sharding — fine below ~8B; shard the restore for bigger."""
-        raw = self.restore_raw(
-            step, only={"snapshot", "outer_opt_state", "inner_step_count"}
+        count whose leaves carry the target shardings. The restore is
+        SHARDED end to end: orbax reads each leaf straight into the
+        fresh state's sharding (no single-device staging), so elastic
+        resume works at 8B scale and from every process of a pod."""
+        step = self.latest_step if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        only = {"snapshot", "outer_opt_state", "inner_step_count"}
+        fresh_map = {
+            "snapshot": fresh_state.snapshot,
+            "outer_opt_state": fresh_state.outer_opt_state,
+            "inner_step_count": fresh_state.inner_step_count,
+        }
+        mngr = ocp.CheckpointManager(
+            self.directory, item_handlers=ocp.PyTreeCheckpointHandler()
         )
-        count = jax.device_put(
-            jnp.asarray(raw["inner_step_count"], jnp.int32),
-            fresh_state.inner_step_count.sharding,  # replicate on the mesh
-        )
-
-        def put_tree(raw_tree, target_tree, what):
-            # restore_raw returns plain nested dicts (orbax flattens
-            # optax NamedTuples to keyed dicts), so map by FLATTENED
-            # leaf order against the live target structure, with a
-            # shape guard against any ordering mismatch
-            raw_leaves = jax.tree.leaves(raw_tree)
-            tgt_leaves, treedef = jax.tree.flatten(target_tree)
-            if len(raw_leaves) != len(tgt_leaves):
-                raise ValueError(
-                    f"elastic restore: {what} has {len(raw_leaves)} saved "
-                    f"leaves vs {len(tgt_leaves)} in the target (different "
-                    "optimizer?)"
+        try:
+            meta = mngr.item_metadata(step).tree
+            missing = only - set(meta)
+            if missing:
+                raise KeyError(
+                    f"checkpoint has no field(s) {sorted(missing)}; "
+                    f"available: {sorted(meta)} (streaming checkpoints "
+                    "have no single outer_opt_state — elastic resume is "
+                    "classic-only)"
                 )
-            placed = []
-            for r, t in zip(raw_leaves, tgt_leaves):
-                r = jnp.asarray(r)
-                if r.shape != t.shape:
+            # graft the fresh state's shardings onto the SAVED tree
+            # structure (orbax stores optax NamedTuples as keyed dicts),
+            # mapping by flattened leaf order with a shape guard
+            item: dict = {}
+            rargs: dict = {}
+            for k, v in meta.items():
+                if k not in only:
+                    item[k] = jax.tree.map(lambda _: ocp.PLACEHOLDER, v)
+                    rargs[k] = jax.tree.map(lambda _: ocp.RestoreArgs(), v)
+                    continue
+                meta_leaves, treedef = jax.tree.flatten(v)
+                tgt_leaves = jax.tree.leaves(fresh_map[k])
+                if len(meta_leaves) != len(tgt_leaves):
                     raise ValueError(
-                        f"elastic restore: {what} leaf shape {r.shape} != "
-                        f"target {t.shape} (leaf-order mismatch or "
-                        "different model config)"
+                        f"elastic restore: {k} has {len(meta_leaves)} "
+                        f"saved leaves vs {len(tgt_leaves)} in the target "
+                        "(different optimizer?)"
                     )
-                placed.append(jax.device_put(r, t.sharding))
-            return jax.tree.unflatten(treedef, placed)
+                structs, args_ = [], []
+                for m, t in zip(meta_leaves, tgt_leaves):
+                    if tuple(m.shape) != tuple(t.shape):
+                        raise ValueError(
+                            f"elastic restore: {k} leaf shape {m.shape} != "
+                            f"target {t.shape} (leaf-order mismatch or "
+                            "different model config)"
+                        )
+                    structs.append(
+                        jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=t.sharding)
+                    )
+                    args_.append(ocp.ArrayRestoreArgs(sharding=t.sharding))
+                item[k] = jax.tree.unflatten(treedef, structs)
+                rargs[k] = jax.tree.unflatten(treedef, args_)
+            raw = mngr.restore(
+                step, args=ocp.args.PyTreeRestore(item=item, restore_args=rargs)
+            )
+        finally:
+            mngr.close()
 
-        snapshot = put_tree(raw["snapshot"], fresh_state.snapshot, "snapshot")
-        outer = put_tree(
-            raw["outer_opt_state"], fresh_state.outer_opt_state,
-            "outer_opt_state",
-        )
+        def to_fresh(raw_tree, target_tree):
+            return jax.tree.unflatten(
+                jax.tree.structure(target_tree), jax.tree.leaves(raw_tree)
+            )
+
+        snapshot = to_fresh(raw["snapshot"], fresh_state.snapshot)
+        outer = to_fresh(raw["outer_opt_state"], fresh_state.outer_opt_state)
+        count = jnp.asarray(raw["inner_step_count"], jnp.int32)
         params = jax.tree.map(
             lambda t, s: jax.device_put(
                 jnp.broadcast_to(s[None], t.shape), t.sharding

@@ -220,6 +220,20 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         diloco=cfg.num_workers, fsdp=cfg.fsdp, tp=cfg.tp, sp=cfg.sp,
         pp=cfg.pp, ep=cfg.ep,
     )
+    # strictly < : an OVERSIZED mesh falls through to build_mesh's
+    # accurate "mesh needs N devices, only M available" error
+    if jax.process_count() > 1 and mesh_cfg.num_devices < jax.device_count():
+        # a partial mesh on a pod is a HANG, not an error: processes whose
+        # devices fall outside the mesh sail through dispatches and exit
+        # while participating processes block on them (observed with the
+        # 2-process elastic-resume test) — fail loudly instead
+        raise ValueError(
+            f"mesh ({mesh_cfg.num_devices} devices: diloco={cfg.num_workers}"
+            f" x fsdp={cfg.fsdp} x tp={cfg.tp} x sp={cfg.sp} x pp={cfg.pp}"
+            f" x ep={cfg.ep}) must span ALL {jax.device_count()} global "
+            "devices on a multi-process run — idle devices would desync "
+            "the pod; raise --fsdp (or another axis) to cover them"
+        )
     if cfg.dcn_slices > 1:
         from nanodiloco_tpu.parallel.mesh import build_hybrid_mesh
 
@@ -402,14 +416,6 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     "params != snapshot mid-stagger and its per-fragment "
                     "outer states don't re-broadcast; resume streaming at "
                     "the saved worker count"
-                )
-            elif jax.process_count() > 1:
-                raise ValueError(
-                    "elastic resume is single-controller-only for now: "
-                    "restore_elastic materializes the snapshot on one "
-                    "device, which a multi-process pod cannot address; "
-                    "run the one-off elastic restore single-process, "
-                    "checkpoint, then launch the pod at the new size"
                 )
             else:
                 # elastic resume: capacity changed across the restart (a

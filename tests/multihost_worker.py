@@ -30,6 +30,10 @@ def main() -> None:
     ap.add_argument("--port", default="29431")
     ap.add_argument("--out", required=True)
     ap.add_argument("--local-devices", type=int, default=2)
+    ap.add_argument("--workers", type=int, default=0,
+                    help="num DiLoCo workers (default: one per device)")
+    ap.add_argument("--fsdp", type=int, default=1)
+    ap.add_argument("--total-steps", type=int, default=4)
     args = ap.parse_args()
 
     import jax
@@ -61,10 +65,11 @@ def main() -> None:
         per_device_batch_size=2,
         seq_length=32,
         warmup_steps=2,
-        total_steps=4,
+        total_steps=args.total_steps,
         inner_steps=2,
         lr=1e-3,
-        num_workers=args.nproc * args.local_devices,
+        num_workers=args.workers or args.nproc * args.local_devices,
+        fsdp=args.fsdp,
         model=model,
         log_dir=os.path.join(args.out, "runs"),
         checkpoint_dir=os.path.join(args.out, "ckpt"),
