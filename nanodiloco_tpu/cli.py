@@ -111,8 +111,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fragment merge blend: 1 = hard reset to global, "
                         "0.5 = half local/global mix")
     p.add_argument("--outer-comm-dtype", type=str, default=None,
-                   help="wire dtype of the outer all-reduce payload "
-                        "(e.g. bfloat16 halves sync traffic)")
+                   help="quantization of the outer-sync pseudo-gradient: "
+                        "a float dtype casts (bfloat16), a signed-int "
+                        "dtype uses per-tensor absmax scaling (int8). "
+                        "Controls the sync's NUMERICS (each worker's "
+                        "delta is coarsened before averaging, the "
+                        "robustness arXiv:2501.18512 relies on); whether "
+                        "the all-reduce itself moves the narrow dtype is "
+                        "up to XLA's lowering of the f32-accumulated "
+                        "mean — see Diloco._wire_quantize")
     p.add_argument("--quarantine-nonfinite", action="store_true",
                    help="mask any worker with a non-finite inner loss out "
                         "of the outer sync's mean; the sync's reset then "

@@ -99,7 +99,11 @@ class TrainConfig:
     streaming_fragments: int = 0
     streaming_delay: int = 1
     merge_alpha: float = 1.0
-    outer_comm_dtype: str | None = None  # e.g. "bfloat16": halve sync traffic
+    # outer-sync pseudo-gradient quantization: float dtype = cast (e.g.
+    # "bfloat16"), signed-int = per-tensor absmax quantization (e.g.
+    # "int8"); numerics knob — see Diloco._wire_quantize's honest-scope
+    # note on what actually travels the wire
+    outer_comm_dtype: str | None = None
     # mask any worker with a non-finite inner loss out of the outer mean
     # (parallel/diloco.py::DilocoConfig.quarantine_nonfinite); the reset
     # self-heals the diverged replica at the same sync

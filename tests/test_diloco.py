@@ -376,3 +376,74 @@ def test_quarantine_rejected_for_streaming():
     cfg = DilocoConfig(num_workers=2, inner_steps=4, quarantine_nonfinite=True)
     with pytest.raises(ValueError, match="classic-DiLoCo-only"):
         StreamingDiloco(TINY, cfg, mesh, StreamingConfig(num_fragments=2, delay=1))
+
+
+def test_outer_comm_dtype_int8():
+    """int8 wire: symmetric per-(worker, tensor) absmax quantization —
+    the outer update must match hand-math on the quantized deltas, and
+    sub-resolution values must round away (the low-bit outer sync of
+    arXiv:2501.18512; pseudo-gradients tolerate coarse wires)."""
+    mesh = build_mesh(MeshConfig(diloco=2))
+    outer_lr, mu = 0.7, 0.9
+    cfg = DilocoConfig(num_workers=2, outer_lr=outer_lr, outer_momentum=mu,
+                       outer_comm_dtype="int8")
+    dl = Diloco(TINY, cfg, mesh, loss_fn=lambda p, t, m: (jnp.sum(p["w"] ** 2), {}))
+    from nanodiloco_tpu.parallel.diloco import DilocoState
+
+    # worker deltas: [1.27, 0.004] and [1.27, 0.004]; absmax 1.27 ->
+    # scale 0.01 exactly, so dim0 -> q=127 -> 1.27 exact, dim1 ->
+    # round(0.4)=0 -> vanishes
+    snapshot = {"w": jnp.asarray([2.27, 1.004])}
+    params = {"w": jnp.asarray([[1.0, 1.0], [1.0, 1.0]])}
+    state = DilocoState(
+        params=params,
+        inner_opt_state=dl.inner_tx.init(snapshot),
+        snapshot=snapshot,
+        outer_opt_state=dl.outer_tx.init(snapshot),
+        inner_step_count=jnp.zeros((), jnp.int32),
+    )
+    new = dl.outer_step(state)
+    delta = np.asarray([1.27, 0.0])
+    expect = np.asarray([2.27, 1.004]) - outer_lr * (1 + mu) * delta
+    np.testing.assert_allclose(np.asarray(new.snapshot["w"]), expect, rtol=1e-5)
+
+
+def test_int8_wire_bounded_error_and_mask_compat():
+    """Random deltas: int8 round-trip error <= scale/2 per element; the
+    masked path with an all-ones mask matches the unmasked quantized
+    mean; garbage dtypes are rejected."""
+    mesh = build_mesh(MeshConfig(diloco=4))
+    cfg = DilocoConfig(num_workers=4, outer_comm_dtype="int8")
+    dl = Diloco(TINY, cfg, mesh)
+    d = jax.random.normal(jax.random.key(0), (4, 16, 8)) * 3.0
+    q = dl._wire_quantize(d)
+    scale = (np.abs(np.asarray(d)).max(axis=(1, 2), keepdims=True) / 127.0)
+    assert (np.abs(np.asarray(q) - np.asarray(d)) <= scale / 2 + 1e-7).all()
+
+    snapshot = {"w": jax.random.normal(jax.random.key(1), (16,))}
+    params = {"w": snapshot["w"][None] + jax.random.normal(jax.random.key(2), (4, 16)) * 0.1}
+    um = dl._pseudograd(snapshot, params)
+    mm = dl._pseudograd(snapshot, params, jnp.ones(4))
+    np.testing.assert_allclose(np.asarray(um["w"]), np.asarray(mm["w"]), atol=1e-6)
+
+    with pytest.raises(ValueError, match="float .* or signed-int"):
+        Diloco(TINY, DilocoConfig(num_workers=2, outer_comm_dtype="uint8"),
+               build_mesh(MeshConfig(diloco=2)))
+
+
+def test_int8_wire_nan_worker_masked_scales():
+    """Per-worker scales are the quarantine-compat contract: one NaN
+    (masked) worker must not poison the survivors' quantization — a
+    refactor to a global absmax scale would break exactly this."""
+    mesh = build_mesh(MeshConfig(diloco=4))
+    cfg = DilocoConfig(num_workers=4, outer_comm_dtype="int8")
+    dl = Diloco(TINY, cfg, mesh)
+    snapshot = {"w": jax.random.normal(jax.random.key(1), (16,))}
+    params = {"w": snapshot["w"][None] + jax.random.normal(jax.random.key(2), (4, 16)) * 0.1}
+    poisoned = {"w": params["w"].at[2].set(jnp.nan)}
+    healthy_masked = dl._pseudograd(snapshot, params, jnp.asarray([1, 1, 0, 1], bool))
+    nan_masked = dl._pseudograd(snapshot, poisoned, jnp.asarray([1, 1, 0, 1], bool))
+    np.testing.assert_array_equal(
+        np.asarray(nan_masked["w"]), np.asarray(healthy_masked["w"])
+    )
+    assert np.isfinite(np.asarray(nan_masked["w"])).all()
