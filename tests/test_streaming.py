@@ -95,13 +95,17 @@ def test_stagger_cadence():
 
 # -- classic equivalence -----------------------------------------------------
 
-def test_p1_delay0_equals_classic_diloco():
+@pytest.mark.parametrize("wire", [None, "int8"])
+def test_p1_delay0_equals_classic_diloco(wire):
     """num_fragments=1, delay=0, merge_alpha=1 must reproduce classic
-    DiLoCo exactly: same inner math, same outer math, same ordering."""
+    DiLoCo exactly: same inner math, same outer math, same ordering —
+    including under a quantized wire (int8 absmax): streaming's fragment
+    launches share Diloco._pseudograd, so outer_comm_dtype applies to
+    each fragment (the setting arXiv:2501.18512 ships low-bit)."""
     W, H = 4, 2
     mesh = build_mesh(MeshConfig(diloco=W))
     cfg = DilocoConfig(num_workers=W, inner_steps=H, warmup_steps=2,
-                       total_steps=20, lr=1e-3)
+                       total_steps=20, lr=1e-3, outer_comm_dtype=wire)
     batches = [make_batch(jax.random.key(i), W) for i in range(1, 2 * H + 1)]
 
     classic = Diloco(TINY, cfg, mesh)
@@ -338,3 +342,4 @@ def test_streaming_sp_trains():
         tok, m = make_batch(jax.random.key(t), 2, B=2, S=8)
         state, loss = sd.step(state, tok, m, t)
     assert np.isfinite(np.asarray(loss)).all()
+
