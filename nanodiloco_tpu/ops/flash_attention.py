@@ -20,6 +20,7 @@ sequences (data/), so padding masks are not needed on the hot path. Use
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 
 import jax
@@ -31,8 +32,6 @@ from nanodiloco_tpu.ops.online_softmax import block_update, finalize_grouped
 
 def _env_block(name: str) -> int | None:
     """Validated positive-int env knob, or None when unset/empty."""
-    import os
-
     raw = os.environ.get(name)
     if not raw:
         return None

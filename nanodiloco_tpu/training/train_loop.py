@@ -141,6 +141,14 @@ class TrainConfig:
         return self.batch_size // self.per_device_batch_size
 
 
+def _finite_worker_mean(losses: jax.Array) -> jax.Array:
+    """Mean over the trailing (worker) axis, restricted to finite
+    entries — the logged loss under quarantine (a healed worker's NaN
+    must not reach the dashboard). All-non-finite rows read 0.0."""
+    fin = jnp.isfinite(losses)
+    return jnp.where(fin, losses, 0.0).sum(-1) / jnp.maximum(fin.sum(-1), 1)
+
+
 def train(cfg: TrainConfig) -> dict[str, Any]:
     """Run the full DiLoCo training job; returns a summary dict."""
     set_seed_all(cfg.seed)
@@ -645,14 +653,11 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     # logged loss (an operator would kill a run the
                     # feature just saved) — masked mean + an explicit
                     # event count instead
-                    fin = jnp.isfinite(losses)
-                    losses_h = np.asarray(
-                        jnp.where(fin, losses, 0.0).sum(axis=1)
-                        / jnp.maximum(fin.sum(axis=1), 1)
-                    )
+                    losses_h = np.asarray(_finite_worker_mean(losses))
                     quarantine_metrics = {
                         "quarantined_workers": int(
-                            cfg.num_workers - jnp.all(fin, axis=0).sum()
+                            cfg.num_workers
+                            - jnp.all(jnp.isfinite(losses), axis=0).sum()
                         )
                     }
                 else:
@@ -765,10 +770,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         if cfg.quarantine_nonfinite:
             # same masked-mean treatment as the fused path: a healed
             # worker's NaN step loss must not poison the logged metric
-            fin_l = jnp.isfinite(loss)
-            last_loss = float(
-                jnp.where(fin_l, loss, 0.0).sum() / jnp.maximum(fin_l.sum(), 1)
-            )
+            last_loss = float(_finite_worker_mean(loss))
             if synced:
                 eval_metrics = {
                     **eval_metrics,
