@@ -266,6 +266,11 @@ def build_generate_parser() -> argparse.ArgumentParser:
                         "self-describing")
     p.add_argument("--prompt", type=str, default="The",
                    help="prompt text (encoded with the training tokenizer)")
+    p.add_argument("--prompts-file", type=str, default=None,
+                   help="file with one prompt per line — the whole batch "
+                        "samples in ONE compiled prefill+decode program "
+                        "(variable lengths left-padded via pad_prompts); "
+                        "overrides --prompt")
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--temperature", type=float, default=0.8,
                    help="0 = greedy decoding")
@@ -295,7 +300,6 @@ def generate_main(argv: list[str]) -> None:
 
         force_virtual_cpu_devices(args.force_cpu_devices)
     import jax
-    import jax.numpy as jnp
 
     from nanodiloco_tpu.data import get_tokenizer
     from nanodiloco_tpu.models import generate
@@ -305,27 +309,38 @@ def generate_main(argv: list[str]) -> None:
     )
     tokenizer = get_tokenizer(args.tokenizer or sidecar.get("tokenizer"))
 
-    ids = tokenizer.encode(args.prompt)
-    if not ids:
-        raise SystemExit("empty prompt after tokenization")
-    if any(i >= model_cfg.vocab_size for i in ids):
-        raise SystemExit(
-            "prompt tokenizes outside the model vocabulary "
-            f"({model_cfg.vocab_size}); pass the training --tokenizer"
-        )
-    prompt = jnp.asarray([ids], jnp.int32)
+    if args.prompts_file:
+        with open(args.prompts_file) as f:
+            prompts = [line for line in f.read().splitlines() if line.strip()]
+        if not prompts:
+            raise SystemExit(f"no prompts in {args.prompts_file}")
+    else:
+        prompts = [args.prompt]
+    encoded = [tokenizer.encode(p) for p in prompts]
+    for n, (p_text, ids) in enumerate(zip(prompts, encoded), start=1):
+        if not ids:
+            raise SystemExit(f"prompt {n} ({p_text!r}) is empty after tokenization")
+        if any(i >= model_cfg.vocab_size for i in ids):
+            raise SystemExit(
+                f"prompt {n} ({p_text!r}) tokenizes outside the model "
+                f"vocabulary ({model_cfg.vocab_size}); pass the training "
+                "--tokenizer"
+            )
+    from nanodiloco_tpu.models.generate import pad_prompts
+
+    prompt, valid = pad_prompts(encoded)
     stop = getattr(tokenizer, "eos_id", None) if args.stop_at_eos else None
     out = generate(
-        params, prompt, model_cfg, args.max_new_tokens,
+        params, prompt, model_cfg, args.max_new_tokens, prompt_valid=valid,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         key=jax.random.key(args.seed),
         stop_token=stop,
     )
-    ids_out = [int(t) for t in out[0]]
-    if stop is not None and stop in ids_out:
-        ids_out = ids_out[: ids_out.index(stop)]
-    text = tokenizer.decode(ids_out)
-    print(args.prompt + text)
+    for row, text_in in zip(out, prompts):
+        ids_out = [int(t) for t in row]
+        if stop is not None and stop in ids_out:
+            ids_out = ids_out[: ids_out.index(stop)]
+        print(text_in + tokenizer.decode(ids_out))
 
 
 def _load_checkpoint_snapshot(checkpoint_dir: str, step: int | None):

@@ -278,6 +278,18 @@ def test_generate_cli_from_checkpoint(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "ab" in out and len(out.strip()) > 2
 
+    # batch sampling: --prompts-file runs the variable-length batch
+    # through ONE compiled program (left-padded via pad_prompts)
+    pf = tmp_path / "prompts.txt"
+    pf.write_text("abc\nz\n")
+    cli_main([
+        "generate", "--checkpoint-dir", ckpt_dir,
+        "--prompts-file", str(pf),
+        "--max-new-tokens", "5", "--temperature", "0",
+    ])
+    out = capsys.readouterr().out
+    assert "abc" in out and "z" in out
+
 
 def test_export_hf_cli_roundtrip(tmp_path, capsys):
     """Train -> export-hf -> transformers.from_pretrained loads it and
