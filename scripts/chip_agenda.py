@@ -258,9 +258,30 @@ def main() -> None:
     if not chip_is_live():
         record({"phase": "abort", "reason": "accelerator claim not available"})
         raise SystemExit(1)
+    failed = []
     for name in names:
         record({"phase": name, "status": "start"})
-        PHASES[name]()
+        try:
+            PHASES[name]()
+        except Exception as e:
+            # an unattended recovery window must not lose the remaining
+            # phases to one phase's crash — record (with traceback: the
+            # JSONL is the only diagnostic hours later) and continue.
+            # NOTE the ordering constraint above still binds: bench runs
+            # first because the in-process phases hold the claim; a
+            # crashed in-process phase keeps holding it, so later
+            # in-process phases still run while a bench child would not.
+            import traceback
+
+            failed.append(name)
+            record({
+                "phase": name,
+                "status": "crashed",  # distinguishes from per-config errors
+                "error": f"{type(e).__name__}: {e}"[:400],
+                "traceback": traceback.format_exc()[-1200:],
+            })
+    if failed:
+        raise SystemExit(f"phases failed: {failed} (see {OUT})")
 
 
 if __name__ == "__main__":
