@@ -293,3 +293,19 @@ def test_top_p_near_one_degrades_gracefully():
     keys = jax.random.split(jax.random.key(3), 50)
     draws = {int(_sample(logits, k, 1.0, 0, 0.99999)[0]) for k in keys}
     assert len(draws) > 10  # still sampling broadly, not pinned to idx 0
+
+
+def test_top_k_and_top_p_compose():
+    """top_k cuts first, then top_p renormalizes over the survivors:
+    with k=3 and p=0.8 over re-softmaxed {0.57,0.23,0.1} -> renorm
+    {0.633,0.256,0.111}, the nucleus is {0,1} (0.633+0.256 >= 0.8)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nanodiloco_tpu.models.generate import _sample
+
+    logits = jnp.log(jnp.asarray([[0.57, 0.23, 0.1, 0.06, 0.04]]))
+    keys = jax.random.split(jax.random.key(7), 300)
+    draws = np.asarray([int(_sample(logits, k, 1.0, 3, 0.8)[0]) for k in keys])
+    assert set(draws) == {0, 1}
