@@ -141,16 +141,16 @@ def run_workload(
         state_i = jax.tree.map(jnp.copy, state)
         key, k = jax.random.split(key)
         tok, mask = make_round(k)
-        state_i, _ = dl.inner_round_step(state_i, tok, mask)
+        state_i, _, _ = dl.inner_round_step(state_i, tok, mask)
     key, k = jax.random.split(key)
     tok, mask = make_round(k)
-    state, loss = dl.round_step(state, tok, mask)
+    state, loss, _ = dl.round_step(state, tok, mask)
     jax.block_until_ready(loss)
 
     # timed: full rounds (the real training cadence, sync included)
     t0 = time.perf_counter()
     for tok, mask in staged:
-        state, loss = dl.round_step(state, tok, mask)
+        state, loss, _ = dl.round_step(state, tok, mask)
     jax.block_until_ready(loss)
     round_time = time.perf_counter() - t0
 
@@ -183,10 +183,10 @@ def run_workload(
         def best_of(step_fn, st, n=3):
             best = float("inf")
             for _ in range(n):
-                st, l = step_fn(st, tok, mask)
+                st, l, _ = step_fn(st, tok, mask)
                 jax.block_until_ready(l)
                 t0 = time.perf_counter()
-                st, l = step_fn(st, tok, mask)
+                st, l, _ = step_fn(st, tok, mask)
                 jax.block_until_ready(l)
                 best = min(best, time.perf_counter() - t0)
             return best, st
@@ -396,12 +396,12 @@ def run_streaming(degraded: bool = False) -> dict:
     jax.block_until_ready(tok)
 
     def best_round(dl, state, n=3):
-        state, loss = dl.round_step(state, tok, mask)  # compile + warm
+        state, loss, _ = dl.round_step(state, tok, mask)  # compile + warm
         jax.block_until_ready(loss)
         best = float("inf")
         for _ in range(n):
             t0 = time.perf_counter()
-            state, loss = dl.round_step(state, tok, mask)
+            state, loss, _ = dl.round_step(state, tok, mask)
             jax.block_until_ready(loss)
             best = min(best, time.perf_counter() - t0)
         return best, state

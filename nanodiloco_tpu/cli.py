@@ -119,7 +119,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "robustness arXiv:2501.18512 relies on); whether "
                         "the all-reduce itself moves the narrow dtype is "
                         "up to XLA's lowering of the f32-accumulated "
-                        "mean — see Diloco._wire_quantize")
+                        "mean — see Diloco._wire_quantize, or pass "
+                        "--outer-wire-collective to pin it")
+    p.add_argument("--outer-wire-collective", action="store_true",
+                   help="carry the quantized payload ON the outer "
+                        "all-reduce: shared absmax scale, integer psum, "
+                        "dequant after — the collective's operand dtype "
+                        "is guaranteed narrow (requires a signed-int "
+                        "--outer-comm-dtype)")
     p.add_argument("--quarantine-nonfinite", action="store_true",
                    help="mask any worker with a non-finite inner loss out "
                         "of the outer sync's mean; the sync's reset then "
@@ -233,6 +240,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         streaming_delay=args.streaming_delay,
         merge_alpha=args.merge_alpha,
         outer_comm_dtype=args.outer_comm_dtype,
+        outer_wire_collective=args.outer_wire_collective,
         model=model,
         tokenizer=args.tokenizer,
         fit_vocab=args.fit_vocab,

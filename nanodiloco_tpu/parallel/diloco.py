@@ -74,6 +74,20 @@ class DilocoConfig:
     # DCN/ICI traffic; pseudo-gradients are noise-tolerant — the reference
     # always reduced in fp32). None = reduce in the snapshot's dtype.
     outer_comm_dtype: str | None = None
+    # Carry the quantized payload ON the collective (requires a
+    # signed-int outer_comm_dtype): the outer mean runs as a
+    # shard_map-manual region over ``diloco`` where workers quantize
+    # against a SHARED scale (one pmax'd scalar per tensor), the
+    # all-reduce operand is an integer tensor (int16 when W*q_max fits,
+    # else int32), and dequantization happens after the collective — so
+    # the bytes that travel ICI/DCN are the quantized payload, matching
+    # what the reference's wire actually carries
+    # (ref nanodiloco/diloco/diloco.py:49). Default off: the default
+    # path keeps per-(worker, tensor) scales (finer quantization) at the
+    # cost of an f32 reduce. Trade-off: the shared scale is the max over
+    # surviving workers, so a worker with an outsized delta coarsens
+    # everyone's bins by up to W× vs per-worker scales.
+    outer_wire_collective: bool = False
     # Divergence quarantine: a worker whose replica holds any non-finite
     # value at sync time (exact criterion, checked in _outer_step; a
     # non-finite inner loss during the round ANDs in as an extra reason)
@@ -170,6 +184,32 @@ class Diloco:
                     "float (cast wire) or signed-int (absmax-quantized "
                     "wire) dtype"
                 )
+        if cfg.outer_wire_collective:
+            if cfg.outer_comm_dtype is None or not jnp.issubdtype(
+                jnp.dtype(cfg.outer_comm_dtype), jnp.signedinteger
+            ):
+                raise ValueError(
+                    "outer_wire_collective requires a signed-int "
+                    f"outer_comm_dtype (got {cfg.outer_comm_dtype!r}): the "
+                    "integer collective carries a quantized payload"
+                )
+            wire = jnp.dtype(cfg.outer_comm_dtype)
+            if wire.itemsize > 2:
+                # a >=4-byte "narrow" wire is no narrower than f32 AND
+                # W * q_max would overflow the int32 accumulator
+                # (int32 wire: clip(±2^31-1) wraps on the very cast)
+                raise ValueError(
+                    f"outer_wire_collective wire dtype {wire.name} is not "
+                    "narrow: use int8 or int16 (int32 would match f32's "
+                    "width and overflow the psum accumulator)"
+                )
+            if cfg.num_workers * float(jnp.iinfo(wire).max) > float(
+                jnp.iinfo(jnp.int32).max
+            ):
+                raise ValueError(
+                    f"num_workers={cfg.num_workers} with wire {wire.name} "
+                    "overflows the int32 psum accumulator"
+                )
         self.loss_fn = loss_fn or (
             lambda p, t, m: causal_lm_loss(p, t, model_cfg, loss_mask=m)
         )
@@ -208,7 +248,9 @@ class Diloco:
                 self._host_shardings = None
 
         self.inner_step = self._with_mesh(jax.jit(self._inner_step, donate_argnums=(0,)))
-        self.outer_step = self._with_mesh(jax.jit(self._outer_step, donate_argnums=(0,)))
+        self.outer_step = self._with_mesh(
+            jax.jit(self._outer_step_state, donate_argnums=(0,))
+        )
         self.round_step = self._with_mesh(jax.jit(self._round_step, donate_argnums=(0,)))
         # H inner steps with NO outer sync: same dispatch count as
         # round_step, so differencing the two isolates the outer
@@ -619,6 +661,10 @@ class Diloco:
         kills its NCCL all-reduce outright, SURVEY §5). All-dead is
         guarded to a zero pseudo-gradient (denominator clamped to 1), so
         the outer step degenerates to momentum-only rather than NaN."""
+        if self.cfg.outer_wire_collective:
+            return self._pseudograd_integer_wire(
+                snapshot, params_w, worker_mask
+            )
         cdt = self.cfg.outer_comm_dtype
         if worker_mask is None:
             if cdt is None:
@@ -652,6 +698,112 @@ class Diloco:
 
         return jax.tree.map(masked_mean, snapshot, params_w)
 
+    def _pseudograd_integer_wire(
+        self, snapshot: Any, params_w: Any, worker_mask: jax.Array | None = None
+    ) -> Any:
+        """Worker-averaged pseudo-gradient where the cross-worker
+        collective carries an INTEGER payload (``outer_wire_collective``).
+
+        The default quantized path (`_wire_quantize`) dequantizes to f32
+        before the mean, so XLA's all-reduce moves f32 — the quantization
+        bounds numerics, not bytes. This path makes the wire itself
+        narrow, matching the reference's contract that the all-reduce
+        payload IS the wire dtype (ref nanodiloco/diloco/diloco.py:49):
+
+        1. each worker zeroes masked rows, then computes its local
+           per-tensor absmax;
+        2. ONE f32 ``pmax`` over ``diloco`` of the [num_tensors] absmax
+           vector yields a scale shared by every worker (collective
+           payload: one scalar per tensor — negligible);
+        3. workers quantize ``round(delta/scale)`` into the configured
+           signed-int dtype and sum locally into an accumulator wide
+           enough for W summands (int16 when ``W * q_max`` fits, else
+           int32);
+        4. the all-reduce (``psum`` over ``diloco``) carries that
+           integer tensor — the narrow wire;
+        5. dequantize ``psum * scale / survivors`` in f32 after.
+
+        Runs as a shard_map partial-manual region over ``diloco`` only,
+        so fsdp/tp/pp shardings inside each tensor stay with the auto
+        partitioner; streaming's per-fragment launches reuse this path
+        unchanged (fragment subtrees are just smaller pytrees). Max
+        per-element error is scale/2 with scale = global absmax / q_max —
+        coarser than per-worker scales by at most the spread in worker
+        absmaxes; pseudo-gradients tolerate this (arXiv:2501.18512 runs
+        4-bit outer wires)."""
+        dt = jnp.dtype(self.cfg.outer_comm_dtype)
+        q_max = float(jnp.iinfo(dt).max)
+        W = self.cfg.num_workers
+        acc_dt = (
+            jnp.int16
+            if W * q_max <= float(jnp.iinfo(jnp.int16).max)
+            else jnp.int32
+        )
+        snap_leaves, treedef = jax.tree.flatten(snapshot)
+        pw_leaves = jax.tree.leaves(params_w)
+        mask = (
+            jnp.ones((W,), jnp.float32)
+            if worker_mask is None
+            else worker_mask.astype(jnp.float32)
+        )
+
+        def region(snaps, pws, w):
+            keepf = w > 0
+
+            def masked_delta(s, p):
+                d = (s[None] - p).astype(jnp.float32)
+                keep = keepf.reshape((-1,) + (1,) * (d.ndim - 1))
+                # zero masked rows BEFORE absmax/quantize: a dead
+                # worker's NaN must poison neither the shared scale nor
+                # the integer cast (NaN->int is undefined)
+                return jnp.where(keep, d, 0.0)
+
+            # deltas are recomputed per loop rather than kept across the
+            # pmax barrier: holding every leaf's f32 [W_local, ...] copy
+            # live simultaneously would spike peak HBM by a full f32
+            # replica-set during each sync (one subtract+where per leaf
+            # is cheaper than that on the 8B-scale runs this wire is for)
+            absmaxes = [
+                jnp.max(jnp.abs(masked_delta(s, p)))
+                for s, p in zip(snaps, pws)
+            ]
+            amax = jax.lax.pmax(jnp.stack(absmaxes), "diloco")
+            scales = jnp.maximum(
+                amax / q_max, jnp.finfo(jnp.float32).tiny
+            )
+            if worker_mask is None:
+                denom = jnp.float32(W)
+            else:
+                denom = jnp.maximum(
+                    jax.lax.psum(jnp.sum(w), "diloco"), 1.0
+                )
+            outs = []
+            for i, (s, p) in enumerate(zip(snaps, pws)):
+                d = masked_delta(s, p)
+                q = jnp.clip(
+                    jnp.round(d / scales[i]), -q_max, q_max
+                ).astype(dt)
+                local = jnp.sum(q.astype(acc_dt), axis=0, dtype=acc_dt)
+                total = jax.lax.psum(local, "diloco")  # the narrow wire
+                outs.append(
+                    (total.astype(jnp.float32) * scales[i] / denom)
+                    .astype(s.dtype)
+                )
+            return tuple(outs)
+
+        out = jax.shard_map(
+            region,
+            mesh=self.mesh,
+            in_specs=(
+                tuple(P() for _ in snap_leaves),
+                tuple(P("diloco") for _ in pw_leaves),
+                P("diloco"),
+            ),
+            out_specs=tuple(P() for _ in snap_leaves),
+            axis_names={"diloco"},
+        )(tuple(snap_leaves), tuple(pw_leaves), mask)
+        return jax.tree.unflatten(treedef, out)
+
     def _wire_quantize(self, d: jax.Array) -> jax.Array:
         """Quantize-dequantize a stacked worker delta [W, ...] to the
         configured wire format, returning float32.
@@ -669,11 +821,11 @@ class Diloco:
         back to float32 happens before the cross-worker mean so rounding
         error does not grow with worker count, which also means XLA is
         free to move f32 over the wire when it lowers the mean's
-        all-reduce. Guaranteed narrow-dtype traffic would need the
-        collective itself to carry the quantized payload (a shared
-        global scale + integer psum, or a custom collective); the knob
-        validates the low-bit TRAINING behavior now and keeps the wire
-        format pluggable for that follow-up."""
+        all-reduce. For guaranteed narrow-dtype traffic set
+        ``outer_wire_collective``: `_pseudograd_integer_wire` carries
+        the quantized payload on the collective itself (shared pmax'd
+        scale, integer psum, dequant after), at the cost of a scale
+        shared across workers instead of per-worker."""
         dt = jnp.dtype(self.cfg.outer_comm_dtype)
         if jnp.issubdtype(dt, jnp.integer):
             q_max = float(jnp.iinfo(dt).max)
@@ -704,31 +856,53 @@ class Diloco:
             ok = ok & f
         return ok
 
-    def _heal_inner_opt(self, inner_opt_state: Any, keep: jax.Array) -> Any:
+    def _heal_inner_opt(
+        self, inner_opt_state: Any, keep: jax.Array, params_w: Any
+    ) -> Any:
         """Zero masked workers' float optimizer leaves (Adam m/v etc.) —
         a fresh-init equivalent. Without this the quarantined worker's
         NaN moments re-poison it on the next round's first update (NaN
         propagates through b1*m + (1-b1)*g forever) and the 'self-heal'
         is permanent W-1 degradation. Integer leaves (schedule counts)
-        are shared cadence, kept in sync for every worker."""
-        W = self.cfg.num_workers
+        are shared cadence, kept in sync for every worker.
 
-        def heal(leaf):
+        Worker-stacked leaves are identified EXACTLY against the
+        optimizer's own shape signature: ``inner_tx.init`` on one
+        worker's (unstacked) param shapes says what each leaf looks like
+        without the worker axis, so a leaf is per-worker iff its shape
+        is ``(W,) + unstacked``. (The previous ``shape[0] == W``
+        heuristic could silently zero a future non-stacked float leaf
+        whose leading dim coincidentally equals W — round-4 advisor
+        finding.)"""
+        W = self.cfg.num_workers
+        unstacked = jax.eval_shape(
+            self.inner_tx.init,
+            jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), params_w
+            ),
+        )
+
+        def heal(leaf, u):
             if (
                 not hasattr(leaf, "dtype")
                 or not jnp.issubdtype(leaf.dtype, jnp.inexact)
-                or leaf.ndim == 0
-                or leaf.shape[0] != W
+                or leaf.shape != (W,) + u.shape
             ):
                 return leaf
             k = keep.reshape((-1,) + (1,) * (leaf.ndim - 1))
             return jnp.where(k, leaf, jnp.zeros_like(leaf))
 
-        return jax.tree.map(heal, inner_opt_state)
+        return jax.tree.map(heal, inner_opt_state, unstacked)
 
     def _outer_step(
         self, state: DilocoState, worker_mask: jax.Array | None = None
-    ) -> DilocoState:
+    ) -> tuple[DilocoState, jax.Array]:
+        """Returns ``(state, effective_mask)``: the [W] bool mask of
+        workers that actually contributed to the outer mean — the EXACT
+        quarantine criterion (caller's loss mask AND replica-params
+        finiteness), so logging can report the true quarantine count
+        instead of re-deriving a loss-only approximation (round-4
+        advisor finding). All-ones when quarantine is off."""
         W = self.cfg.num_workers
         inner_opt_state = state.inner_opt_state
         if self.cfg.quarantine_nonfinite:
@@ -740,7 +914,9 @@ class Diloco:
                 pmask if worker_mask is None
                 else (worker_mask.astype(bool) & pmask)
             )
-            inner_opt_state = self._heal_inner_opt(inner_opt_state, worker_mask)
+            inner_opt_state = self._heal_inner_opt(
+                inner_opt_state, worker_mask, state.params
+            )
         # pseudo-gradient, pre-averaged (ref diloco.py:48-49)
         delta = self._pseudograd(state.snapshot, state.params, worker_mask)
         delta = self._constrain(delta, worker_axis=False)
@@ -754,17 +930,31 @@ class Diloco:
             lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), snapshot
         )
         params = self._constrain(params, worker_axis=True)
+        eff = (
+            jnp.ones((W,), bool) if worker_mask is None
+            else worker_mask.astype(bool)
+        )
         return state.replace(
             params=params, snapshot=snapshot,
             inner_opt_state=inner_opt_state,
             outer_opt_state=outer_opt_state,
-        )
+        ), eff
+
+    def _outer_step_state(
+        self, state: DilocoState, worker_mask: jax.Array | None = None
+    ) -> DilocoState:
+        """Public stepwise entry: just the new state (the stepwise train
+        loop derives the exact quarantine count itself — pre-reset params
+        are still host-reachable there, unlike in the fused round)."""
+        new, _ = self._outer_step(state, worker_mask)
+        return new
 
     def _round_step(self, state: DilocoState, tokens: jax.Array, loss_mask: jax.Array):
         """One FULL DiLoCo round — ``inner_steps`` inner updates
         (``lax.scan``) plus the outer sync — as a single XLA executable.
         tokens/loss_mask: [H, W, accum, B, S]. Returns (state, [H, W]
-        losses).
+        losses, [W] effective sync mask — the workers whose replicas
+        entered the outer mean; all ones when quarantine is off).
 
         One program per round is the TPU-native shape of the training
         loop: no host round-trips between steps, no executable switching
@@ -789,18 +979,21 @@ class Diloco:
             # finiteness, which also catches a blow-up on the round's
             # final update) is applied inside _outer_step
             wmask = jnp.all(jnp.isfinite(losses), axis=0)
-        state = self._outer_step(state, wmask)
-        return state, losses
+        state, eff = self._outer_step(state, wmask)
+        return state, losses, eff
 
     def _inner_round_step(self, state: DilocoState, tokens, loss_mask):
         """``_round_step`` minus the outer sync — the differencing baseline
-        for measuring the fused outer step's marginal cost."""
+        for measuring the fused outer step's marginal cost. Same return
+        structure as ``_round_step`` (the all-ones mask stands in) so the
+        two dispatch identically."""
 
         def one(s, batch):
             s, loss = self._inner_step(s, batch[0], batch[1])
             return s, loss
 
-        return jax.lax.scan(one, state, (tokens, loss_mask))
+        state, losses = jax.lax.scan(one, state, (tokens, loss_mask))
+        return state, losses, jnp.ones((self.cfg.num_workers,), bool)
 
     def measure_inner_round_time(
         self, state: DilocoState, tokens, loss_mask, repeats: int = 1
@@ -817,7 +1010,7 @@ class Diloco:
         for i in range(repeats + 1):  # +1 warmup/compile call
             probe = jax.tree.map(jnp.copy, state)
             t0 = time.perf_counter()
-            probe, loss = self.inner_round_step(probe, tokens, loss_mask)
+            probe, loss, _ = self.inner_round_step(probe, tokens, loss_mask)
             jax.block_until_ready(loss)
             if i > 0:
                 best = min(best, time.perf_counter() - t0)
@@ -856,5 +1049,5 @@ class Diloco:
         the reference accepted ``inner_steps`` and ignored it
         (ref diloco.py:8-25, SURVEY §2 quirks)."""
         toks, masks = self.stack_round_batches(batches)
-        state, losses = self.round_step(state, toks, masks)
+        state, losses, _ = self.round_step(state, toks, masks)
         return self._offload(state), losses

@@ -303,7 +303,11 @@ class StreamingDiloco(Diloco):
                 s = jax.lax.cond(pred, branch, lambda s: s, s)
             return s, loss
 
-        return jax.lax.scan(one, state, (tokens, loss_mask))
+        state, losses = jax.lax.scan(one, state, (tokens, loss_mask))
+        # all-ones effective mask: matches Diloco._round_step's return
+        # structure (quarantine_nonfinite is rejected at __init__, so
+        # every worker always contributes to fragment launches)
+        return state, losses, jnp.ones((self.cfg.num_workers,), bool)
 
     def _launch_fragment(self, state: StreamingState, p: int) -> StreamingState:
         """Fragment pseudo-gradient all-reduce + outer Nesterov step →

@@ -104,6 +104,10 @@ class TrainConfig:
     # "int8"); numerics knob — see Diloco._wire_quantize's honest-scope
     # note on what actually travels the wire
     outer_comm_dtype: str | None = None
+    # carry the quantized payload on the collective itself (integer
+    # psum with a shared scale — guaranteed-narrow wire; requires a
+    # signed-int outer_comm_dtype): Diloco._pseudograd_integer_wire
+    outer_wire_collective: bool = False
     # mask any worker with a non-finite inner loss out of the outer mean
     # (parallel/diloco.py::DilocoConfig.quarantine_nonfinite); the reset
     # self-heals the diverged replica at the same sync
@@ -263,6 +267,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         pp_schedule=cfg.pp_schedule,
         offload_snapshot=cfg.offload_snapshot,
         outer_comm_dtype=cfg.outer_comm_dtype,
+        outer_wire_collective=cfg.outer_wire_collective,
         quarantine_nonfinite=cfg.quarantine_nonfinite,
     )
 
@@ -577,7 +582,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     jax.profiler.start_trace(cfg.profile_dir)
                 try:
                     t0 = time.perf_counter()
-                    state, losses = dl.round_step(state, toks, masks)
+                    state, losses, eff_mask = dl.round_step(state, toks, masks)
                     jax.block_until_ready(losses)
                     round_s = time.perf_counter() - t0
                 finally:
@@ -604,7 +609,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         if rnd == last_round:  # no warm round 2 will come
                             probe = jax.tree.map(jnp.copy, state)
                             t0 = time.perf_counter()
-                            probe, probe_loss = dl.round_step(probe, toks, masks)
+                            probe, probe_loss, _ = dl.round_step(probe, toks, masks)
                             jax.block_until_ready(probe_loss)
                             best_full_s = time.perf_counter() - t0
                             del probe
@@ -652,12 +657,17 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     # a quarantined worker's NaN must not flow into the
                     # logged loss (an operator would kill a run the
                     # feature just saved) — masked mean + an explicit
-                    # event count instead
+                    # event count from the round's EFFECTIVE sync mask
+                    # (loss finiteness AND replica-params finiteness —
+                    # a blow-up on the round's final inner update is
+                    # quarantined by _outer_step and must be counted;
+                    # the loss-only recount here missed it, round-4
+                    # advisor finding). eff_mask is [W] diloco-sharded;
+                    # reduce on device before the host fetch.
                     losses_h = np.asarray(_finite_worker_mean(losses))
                     quarantine_metrics = {
                         "quarantined_workers": int(
-                            cfg.num_workers
-                            - jnp.all(jnp.isfinite(losses), axis=0).sum()
+                            cfg.num_workers - eff_mask.sum()
                         )
                     }
                 else:
@@ -730,11 +740,16 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 compute_time += time.perf_counter() - t0
                 with sync_timer:
                     if cfg.quarantine_nonfinite:
-                        # loss-finiteness count for the log; the sync
-                        # itself additionally applies the exact replica-
-                        # params check inside _outer_step
+                        # EXACT count for the log: same criterion the
+                        # sync applies (loss finiteness AND replica-
+                        # params finiteness — params are still pre-reset
+                        # here, so the check is host-drivable; round-4
+                        # advisor finding on the loss-only recount)
+                        eff = round_ok & dl._replica_finite_mask(
+                            state.params
+                        )
                         quarantined_last_round = int(
-                            cfg.num_workers - round_ok.sum()
+                            cfg.num_workers - eff.sum()
                         )
                     state = dl.outer_step(state, round_ok)
                     round_ok = None

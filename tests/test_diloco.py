@@ -447,3 +447,157 @@ def test_int8_wire_nan_worker_masked_scales():
         np.asarray(nan_masked["w"]), np.asarray(healthy_masked["w"])
     )
     assert np.isfinite(np.asarray(nan_masked["w"])).all()
+
+
+# -- integer-collective wire (outer_wire_collective) --------------------------
+
+def _int_wire_dl(W=4, dtype="int8"):
+    mesh = build_mesh(MeshConfig(diloco=W))
+    cfg = DilocoConfig(num_workers=W, outer_comm_dtype=dtype,
+                       outer_wire_collective=True)
+    return Diloco(TINY, cfg, mesh), mesh
+
+
+def test_integer_wire_numerics_and_mask():
+    """outer_wire_collective: result within shared-scale tolerance of the
+    exact f32 mean (scale = global absmax / q_max — coarser than the
+    default per-worker scales, documented trade); all-ones mask matches
+    no-mask; a NaN (masked) worker poisons neither the shared scale nor
+    the integer cast."""
+    dl, _ = _int_wire_dl()
+    snapshot = {"w": jax.random.normal(jax.random.key(1), (16,)),
+                "b": jax.random.normal(jax.random.key(3), (4, 4)) * 5.0}
+    params = jax.tree.map(
+        lambda s, k: s[None] + jax.random.normal(jax.random.key(k), (4,) + s.shape) * 0.1,
+        snapshot, {"w": 2, "b": 4},
+    )
+    got = dl._pseudograd(snapshot, params)
+    for k in snapshot:
+        exact = np.asarray(snapshot[k]) - np.asarray(params[k]).mean(axis=0)
+        scale = np.abs(np.asarray(snapshot[k])[None] - np.asarray(params[k])).max() / 127.0
+        assert (np.abs(np.asarray(got[k]) - exact) <= scale + 1e-7).all(), k
+
+    allmask = dl._pseudograd(snapshot, params, jnp.ones(4))
+    for k in snapshot:
+        np.testing.assert_allclose(
+            np.asarray(got[k]), np.asarray(allmask[k]), atol=1e-7
+        )
+
+    poisoned = jax.tree.map(lambda p: p.at[2].set(jnp.nan), params)
+    healthy = dl._pseudograd(snapshot, params, jnp.asarray([1, 1, 0, 1], bool))
+    masked = dl._pseudograd(snapshot, poisoned, jnp.asarray([1, 1, 0, 1], bool))
+    for k in snapshot:
+        np.testing.assert_array_equal(np.asarray(masked[k]), np.asarray(healthy[k]))
+        assert np.isfinite(np.asarray(masked[k])).all()
+
+
+def test_integer_wire_hlo_operand_dtype():
+    """The contract the default quantized path cannot make (its docstring
+    concedes XLA may move f32): under outer_wire_collective the compiled
+    all-reduce that carries the payload has an INTEGER operand, and every
+    f32 all-reduce left is the per-tensor scale pmax / survivor count —
+    O(num_tensors) elements, not O(params). Mirrors the reference's wire
+    carrying its payload dtype (ref nanodiloco/diloco/diloco.py:49)."""
+    import re
+
+    dl, mesh = _int_wire_dl()
+    # non-trivial data: all-zero deltas would let XLA constant-fold the
+    # integer psum out of the program entirely
+    snapshot = {"w": jax.random.normal(jax.random.key(1), (64,)),
+                "b": jax.random.normal(jax.random.key(2), (8, 8))}
+    params = jax.tree.map(
+        lambda s, k: s[None] + jax.random.normal(jax.random.key(k), (4,) + s.shape),
+        snapshot, {"w": 3, "b": 4},
+    )
+    fn = jax.jit(lambda s, p: dl._pseudograd(s, p, jnp.ones(4)))
+    with jax.set_mesh(mesh):
+        txt = fn.lower(snapshot, params).compile().as_text()
+    ars = [l for l in txt.splitlines() if " all-reduce(" in l and "=" in l]
+    assert ars, "no all-reduce in compiled HLO"
+    # the result type may be a tuple — XLA's combiner merges the
+    # per-leaf psums into one all-reduce like (s16[64], s16[64])
+    results = [l.split(" all-reduce(")[0] for l in ars]
+    int_payload = [r for r in results if re.search(r"s(8|16|32)\[", r)]
+    assert int_payload, "no integer-operand all-reduce:\n" + "\n".join(ars)
+    for r in results:
+        for m in re.finditer(r"(f64|f32|f16|bf16)\[([0-9,]*)\]", r):
+            dims = [int(d) for d in m.group(2).split(",") if d]
+            n = int(np.prod(dims)) if dims else 1
+            assert n <= 16, f"wide float all-reduce leaked onto the wire: {r}"
+
+
+def test_integer_wire_requires_int_dtype():
+    for bad in [None, "bfloat16", "float32"]:
+        with pytest.raises(ValueError, match="outer_wire_collective requires"):
+            Diloco(TINY, DilocoConfig(num_workers=2, outer_comm_dtype=bad,
+                                      outer_wire_collective=True),
+                   build_mesh(MeshConfig(diloco=2)))
+    # int32 is no narrower than f32 AND clip(±2^31-1) wraps on the int32
+    # cast, wrecking the psum (found by round-5 review: W identical
+    # deltas of 1.0 came back as ~0)
+    with pytest.raises(ValueError, match="not narrow"):
+        Diloco(TINY, DilocoConfig(num_workers=2, outer_comm_dtype="int32",
+                                  outer_wire_collective=True),
+               build_mesh(MeshConfig(diloco=2)))
+
+
+def test_integer_wire_outer_step_matches_default_within_tolerance():
+    """End-to-end outer step under the integer wire stays within
+    quantization tolerance of the default (per-worker scale) int8 path:
+    same model, same state, outer updates differ by at most
+    outer_lr*(1+momentum)*2*scale per element."""
+    mesh = build_mesh(MeshConfig(diloco=4))
+    base = dict(num_workers=4, outer_lr=0.7, outer_momentum=0.9,
+                outer_comm_dtype="int8")
+    dl_int = Diloco(TINY, DilocoConfig(**base, outer_wire_collective=True), mesh)
+    dl_def = Diloco(TINY, DilocoConfig(**base), mesh)
+    from nanodiloco_tpu.parallel.diloco import DilocoState
+
+    snapshot = {"w": jax.random.normal(jax.random.key(1), (32,))}
+    params = {"w": snapshot["w"][None]
+              + jax.random.normal(jax.random.key(2), (4, 32)) * 0.05}
+
+    def mk(dl):
+        # fresh copies: outer_step donates its input state
+        return DilocoState(
+            params=jax.tree.map(jnp.copy, params),
+            inner_opt_state=dl.inner_tx.init(snapshot),
+            snapshot=jax.tree.map(jnp.copy, snapshot),
+            outer_opt_state=dl.outer_tx.init(snapshot),
+            inner_step_count=jnp.zeros((), jnp.int32),
+        )
+
+    s_int = dl_int.outer_step(mk(dl_int))
+    s_def = dl_def.outer_step(mk(dl_def))
+    scale = np.abs(np.asarray(snapshot["w"][None] - params["w"])).max() / 127.0
+    tol = 0.7 * 1.9 * 2 * scale + 1e-7
+    assert (np.abs(np.asarray(s_int.snapshot["w"])
+                   - np.asarray(s_def.snapshot["w"])) <= tol).all()
+
+
+def test_outer_step_effective_mask_counts_param_blowup():
+    """_outer_step's returned effective mask applies the EXACT criterion:
+    a worker whose replica params are non-finite is excluded even when
+    its losses looked fine (the one-step hole the loss-only log recount
+    missed — round-4 advisor finding)."""
+    mesh = build_mesh(MeshConfig(diloco=4))
+    cfg = DilocoConfig(num_workers=4, quarantine_nonfinite=True)
+    dl = Diloco(TINY, cfg, mesh)
+    from nanodiloco_tpu.parallel.diloco import DilocoState
+
+    snapshot = {"w": jax.random.normal(jax.random.key(1), (16,))}
+    params = {"w": snapshot["w"][None]
+              + jax.random.normal(jax.random.key(2), (4, 16)) * 0.1}
+    params = {"w": params["w"].at[2].set(jnp.inf)}
+    state = DilocoState(
+        params=params,
+        inner_opt_state=dl.inner_tx.init(snapshot),
+        snapshot=snapshot,
+        outer_opt_state=dl.outer_tx.init(snapshot),
+        inner_step_count=jnp.zeros((), jnp.int32),
+    )
+    # caller's loss-based mask is all-healthy; the replica check must
+    # still quarantine worker 2
+    new, eff = dl._outer_step(state, jnp.ones(4, bool))
+    np.testing.assert_array_equal(np.asarray(eff), [True, True, False, True])
+    assert np.isfinite(np.asarray(new.snapshot["w"])).all()

@@ -95,17 +95,23 @@ def test_stagger_cadence():
 
 # -- classic equivalence -----------------------------------------------------
 
-@pytest.mark.parametrize("wire", [None, "int8"])
-def test_p1_delay0_equals_classic_diloco(wire):
+@pytest.mark.parametrize("wire,collective", [
+    (None, False), ("int8", False), ("int8", True),
+])
+def test_p1_delay0_equals_classic_diloco(wire, collective):
     """num_fragments=1, delay=0, merge_alpha=1 must reproduce classic
     DiLoCo exactly: same inner math, same outer math, same ordering —
     including under a quantized wire (int8 absmax): streaming's fragment
     launches share Diloco._pseudograd, so outer_comm_dtype applies to
-    each fragment (the setting arXiv:2501.18512 ships low-bit)."""
+    each fragment (the setting arXiv:2501.18512 ships low-bit), and the
+    integer-collective wire (outer_wire_collective — shard_map psum of
+    the quantized payload) composes with per-fragment launches the same
+    way."""
     W, H = 4, 2
     mesh = build_mesh(MeshConfig(diloco=W))
     cfg = DilocoConfig(num_workers=W, inner_steps=H, warmup_steps=2,
-                       total_steps=20, lr=1e-3, outer_comm_dtype=wire)
+                       total_steps=20, lr=1e-3, outer_comm_dtype=wire,
+                       outer_wire_collective=collective)
     batches = [make_batch(jax.random.key(i), W) for i in range(1, 2 * H + 1)]
 
     classic = Diloco(TINY, cfg, mesh)
@@ -252,10 +258,10 @@ def test_streaming_fused_round_matches_stepwise():
     state_b = sd_b.init_state(jax.random.key(0))
     toks = jnp.stack([b[0] for b in batches[:H]])
     masks = jnp.stack([b[1] for b in batches[:H]])
-    state_b, loss_r1 = sd_b.round_step(state_b, toks, masks)
+    state_b, loss_r1, _ = sd_b.round_step(state_b, toks, masks)
     toks = jnp.stack([b[0] for b in batches[H:]])
     masks = jnp.stack([b[1] for b in batches[H:]])
-    state_b, loss_r2 = sd_b.round_step(state_b, toks, masks)
+    state_b, loss_r2, _ = sd_b.round_step(state_b, toks, masks)
 
     losses_b = np.concatenate([np.asarray(loss_r1), np.asarray(loss_r2)])
     np.testing.assert_array_equal(np.stack(losses_a), losses_b)
