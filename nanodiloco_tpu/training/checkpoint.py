@@ -20,6 +20,30 @@ import orbax.checkpoint as ocp
 from nanodiloco_tpu.parallel.diloco import DilocoState
 
 
+def _path_names(path) -> tuple:
+    """Normalize a jax key path to comparable name strings: orbax's
+    keyed-dict layout (DictKey('mu'), DictKey('0')) must match the live
+    optax NamedTuple/tuple layout (GetAttrKey('mu'), SequenceKey(0))."""
+    out = []
+    for e in path:
+        if hasattr(e, "key"):        # DictKey / FlattenedIndexKey
+            out.append(str(e.key))
+        elif hasattr(e, "name"):     # GetAttrKey (NamedTuple fields)
+            out.append(str(e.name))
+        elif hasattr(e, "idx"):      # SequenceKey (tuples/lists)
+            out.append(str(e.idx))
+        else:
+            out.append(str(e))
+    return tuple(out)
+
+
+def _path_leaf_map(tree) -> dict:
+    return {
+        _path_names(p): leaf
+        for p, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
+
+
 class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3) -> None:
         self.directory = os.path.abspath(directory)
@@ -147,12 +171,27 @@ class CheckpointManager:
         step = self.latest_step if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {self.directory}")
-        only = {"snapshot", "outer_opt_state", "inner_step_count"}
-        fresh_map = {
-            "snapshot": fresh_state.snapshot,
-            "outer_opt_state": fresh_state.outer_opt_state,
-            "inner_step_count": fresh_state.inner_step_count,
-        }
+        # streaming states carry per-fragment outer opt states + pending
+        # merges instead of the single outer_opt_state — both are
+        # unstacked (no worker axis), so they re-broadcast across a
+        # worker-count change exactly like the classic snapshot
+        is_streaming = hasattr(fresh_state, "outer_opt_states")
+        if is_streaming:
+            only = {"snapshot", "outer_opt_states", "pending",
+                    "inner_step_count"}
+            fresh_map = {
+                "snapshot": fresh_state.snapshot,
+                "outer_opt_states": fresh_state.outer_opt_states,
+                "pending": fresh_state.pending,
+                "inner_step_count": fresh_state.inner_step_count,
+            }
+        else:
+            only = {"snapshot", "outer_opt_state", "inner_step_count"}
+            fresh_map = {
+                "snapshot": fresh_state.snapshot,
+                "outer_opt_state": fresh_state.outer_opt_state,
+                "inner_step_count": fresh_state.inner_step_count,
+            }
         mngr = ocp.CheckpointManager(
             self.directory, item_handlers=ocp.PyTreeCheckpointHandler()
         )
@@ -160,15 +199,21 @@ class CheckpointManager:
             meta = mngr.item_metadata(step).tree
             missing = only - set(meta)
             if missing:
+                kind = "streaming" if is_streaming else "classic"
                 raise KeyError(
                     f"checkpoint has no field(s) {sorted(missing)}; "
-                    f"available: {sorted(meta)} (streaming checkpoints "
-                    "have no single outer_opt_state — elastic resume is "
-                    "classic-only)"
+                    f"available: {sorted(meta)} (target state is {kind} — "
+                    "a classic checkpoint cannot elastic-restore into a "
+                    "streaming run or vice versa; match "
+                    "streaming_fragments to the checkpoint)"
                 )
             # graft the fresh state's shardings onto the SAVED tree
             # structure (orbax stores optax NamedTuples as keyed dicts),
-            # mapping by flattened leaf order with a shape guard
+            # matching leaves BY KEY PATH — flattened order is not
+            # trustworthy across orbax's key-sorted dict layout vs the
+            # optax NamedTuple layout (Adam's mu/nu only align by order
+            # because 'mu' < 'nu' alphabetically; round-4 advisor
+            # finding) — with a shape guard per matched pair
             item: dict = {}
             rargs: dict = {}
             for k, v in meta.items():
@@ -176,21 +221,35 @@ class CheckpointManager:
                     item[k] = jax.tree.map(lambda _: ocp.PLACEHOLDER, v)
                     rargs[k] = jax.tree.map(lambda _: ocp.RestoreArgs(), v)
                     continue
-                meta_leaves, treedef = jax.tree.flatten(v)
-                tgt_leaves = jax.tree.leaves(fresh_map[k])
-                if len(meta_leaves) != len(tgt_leaves):
+                meta_paths, treedef = jax.tree_util.tree_flatten_with_path(v)
+                tgt_map = _path_leaf_map(fresh_map[k])
+                if len(meta_paths) != len(tgt_map):
+                    hint = (
+                        "streaming_fragments differs from the checkpoint?"
+                        if k in ("outer_opt_states", "pending")
+                        else "different optimizer?"
+                    )
                     raise ValueError(
-                        f"elastic restore: {k} has {len(meta_leaves)} "
-                        f"saved leaves vs {len(tgt_leaves)} in the target "
-                        "(different optimizer?)"
+                        f"elastic restore: {k} has {len(meta_paths)} "
+                        f"saved leaves vs {len(tgt_map)} in the target "
+                        f"({hint})"
                     )
                 structs, args_ = [], []
-                for m, t in zip(meta_leaves, tgt_leaves):
+                for p, m in meta_paths:
+                    t = tgt_map.get(_path_names(p))
+                    if t is None:
+                        raise ValueError(
+                            f"elastic restore: {k} saved leaf at "
+                            f"{jax.tree_util.keystr(p)} has no same-keyed "
+                            "leaf in the target (different optimizer or "
+                            "model config?)"
+                        )
                     if tuple(m.shape) != tuple(t.shape):
                         raise ValueError(
-                            f"elastic restore: {k} leaf shape {m.shape} != "
-                            f"target {t.shape} (leaf-order mismatch or "
-                            "different model config)"
+                            f"elastic restore: {k} leaf "
+                            f"{jax.tree_util.keystr(p)} shape {m.shape} "
+                            f"!= target {t.shape} (different model "
+                            "config?)"
                         )
                     structs.append(
                         jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=t.sharding)
@@ -205,12 +264,15 @@ class CheckpointManager:
             mngr.close()
 
         def to_fresh(raw_tree, target_tree):
+            # reorder raw leaves into the target structure by key path
+            # (same rationale as above: container layouts differ)
+            raw_map = _path_leaf_map(raw_tree)
+            paths, tgt_def = jax.tree_util.tree_flatten_with_path(target_tree)
             return jax.tree.unflatten(
-                jax.tree.structure(target_tree), jax.tree.leaves(raw_tree)
+                tgt_def, [raw_map[_path_names(p)] for p, _ in paths]
             )
 
         snapshot = to_fresh(raw["snapshot"], fresh_state.snapshot)
-        outer = to_fresh(raw["outer_opt_state"], fresh_state.outer_opt_state)
         count = jnp.asarray(raw["inner_step_count"], jnp.int32)
         params = jax.tree.map(
             lambda t, s: jax.device_put(
@@ -218,18 +280,25 @@ class CheckpointManager:
             ),
             fresh_state.params, snapshot,
         )
-
-        def advance(leaf):
-            # integer leaves are optimizer step counts (schedule + Adam
-            # bias correction): advance them to the restored step; float
-            # moments stay at fresh-init zero
-            if jnp.issubdtype(leaf.dtype, jnp.integer):
-                return jax.device_put(
-                    jnp.full(leaf.shape, count, leaf.dtype), leaf.sharding
-                )
-            return leaf
-
-        inner = jax.tree.map(advance, fresh_state.inner_opt_state)
+        if is_streaming:
+            # per-fragment outer momentum and pending merges are global
+            # (unstacked) state: restored exactly. Worker replicas reset
+            # to the snapshot — the last globally-merged model — so a
+            # restored pending fragment applying on schedule merges into
+            # coherent params (the same state an apply-at-launch would
+            # have produced under merge_alpha=1).
+            outer_states = to_fresh(
+                raw["outer_opt_states"], fresh_state.outer_opt_states
+            )
+            pending = to_fresh(raw["pending"], fresh_state.pending)
+            inner = jax.tree.map(_advance_counts(count), fresh_state.inner_opt_state)
+            return fresh_state.replace(
+                params=params, snapshot=snapshot, inner_opt_state=inner,
+                outer_opt_states=outer_states, pending=pending,
+                inner_step_count=count,
+            )
+        outer = to_fresh(raw["outer_opt_state"], fresh_state.outer_opt_state)
+        inner = jax.tree.map(_advance_counts(count), fresh_state.inner_opt_state)
         return fresh_state.replace(
             params=params, snapshot=snapshot, inner_opt_state=inner,
             outer_opt_state=outer, inner_step_count=count,
@@ -237,6 +306,21 @@ class CheckpointManager:
 
     def close(self) -> None:
         self._mngr.close()
+
+
+def _advance_counts(count):
+    """Fresh inner-optimizer state with integer leaves (schedule + Adam
+    bias-correction counts) advanced to the restored step, so the LR does
+    not re-warm; float moments stay at fresh-init zero."""
+
+    def advance(leaf):
+        if jnp.issubdtype(leaf.dtype, jnp.integer):
+            return jax.device_put(
+                jnp.full(leaf.shape, count, leaf.dtype), leaf.sharding
+            )
+        return leaf
+
+    return advance
 
 
 def abstract_state_like(state: DilocoState) -> DilocoState:

@@ -425,19 +425,16 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             saved_w = ckpt.saved_worker_count()
             if saved_w == cfg.num_workers:
                 state = ckpt.restore(abstract_state_like(state))
-            elif streaming:
-                raise ValueError(
-                    f"checkpoint was written with {saved_w} workers but "
-                    f"this run has {cfg.num_workers}, and elastic resume "
-                    "is classic-DiLoCo-only: a streaming checkpoint's "
-                    "params != snapshot mid-stagger and its per-fragment "
-                    "outer states don't re-broadcast; resume streaming at "
-                    "the saved worker count"
-                )
             else:
                 # elastic resume: capacity changed across the restart (a
                 # lost slice, a grown deployment). Exact at the sync
                 # boundary; inner Adam moments restart (restore_elastic).
+                # Streaming states elastic-restore too: per-fragment
+                # outer momentum and pending merges are unstacked global
+                # state, restored exactly; workers reset to the
+                # last-merged snapshot (restore_elastic's streaming
+                # branch). A restored pending fragment still applies on
+                # schedule after the restart.
                 if not quiet:
                     print(
                         f"[nanodiloco] elastic resume: checkpoint has "

@@ -33,6 +33,9 @@ def main() -> None:
     ap.add_argument("--workers", type=int, default=0,
                     help="num DiLoCo workers (default: one per device)")
     ap.add_argument("--fsdp", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--streaming-fragments", type=int, default=0)
+    ap.add_argument("--streaming-delay", type=int, default=1)
     ap.add_argument("--total-steps", type=int, default=4)
     args = ap.parse_args()
 
@@ -68,8 +71,13 @@ def main() -> None:
         total_steps=args.total_steps,
         inner_steps=2,
         lr=1e-3,
-        num_workers=args.workers or args.nproc * args.local_devices,
+        num_workers=args.workers or (
+            args.nproc * args.local_devices // (args.fsdp * args.tp)
+        ),
         fsdp=args.fsdp,
+        tp=args.tp,
+        streaming_fragments=args.streaming_fragments,
+        streaming_delay=args.streaming_delay,
         model=model,
         log_dir=os.path.join(args.out, "runs"),
         checkpoint_dir=os.path.join(args.out, "ckpt"),

@@ -55,27 +55,9 @@ def test_two_process_train_matches_single(tmp_path):
     single_out = str(tmp_path / "single")
     env = _clean_env()
 
-    procs = [
-        subprocess.Popen(
-            [sys.executable, WORKER, "--mode", "dist", "--pid", str(pid),
-             "--nproc", "2", "--port", str(port), "--out", dist_out],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
-        )
-        for pid in range(2)
-    ]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=600)
-        outs.append(out)
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"dist worker {pid} failed:\n{out[-3000:]}"
+    outs = _run_pod(dist_out, port, env, [])
     assert "WORKER_OK" in outs[0]
-
-    single = subprocess.run(
-        [sys.executable, WORKER, "--mode", "single", "--out", single_out],
-        capture_output=True, text=True, timeout=600, env=env,
-    )
-    assert single.returncode == 0, f"single worker failed:\n{(single.stdout + single.stderr)[-3000:]}"
+    _run_single(single_out, env, [])
 
     # ONE metrics stream for the whole pod: the run name is broadcast
     # from process 0 and non-zero ranks are write-gated
@@ -88,17 +70,7 @@ def test_two_process_train_matches_single(tmp_path):
     # the pod's final snapshot equals the single-process run's (same
     # seed, same deterministic data order on every host; tolerance for
     # cross-process Gloo vs in-process reduction order)
-    snap_d = _snapshot(dist_out)
-    snap_s = _snapshot(single_out)
-    import jax
-
-    ld = jax.tree.leaves(snap_d)
-    ls = jax.tree.leaves(snap_s)
-    assert len(ld) == len(ls)
-    for a, b in zip(ld, ls):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
-        )
+    _assert_snapshots_match(dist_out, single_out)
 
 
 @pytest.mark.slow
@@ -111,26 +83,91 @@ def test_elastic_resume_on_pod(tmp_path):
     out = str(tmp_path / "pod")
     env = _clean_env()
 
-    def run_pod(workers, total_steps, fsdp=1):
-        procs = [
-            subprocess.Popen(
-                [sys.executable, WORKER, "--mode", "dist", "--pid", str(pid),
-                 "--nproc", "2", "--port", str(port), "--out", out,
-                 "--workers", str(workers), "--fsdp", str(fsdp),
-                 "--total-steps", str(total_steps)],
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-                env=env,
-            )
-            for pid in range(2)
-        ]
-        outs = [p.communicate(timeout=600)[0] for p in procs]
-        for pid, (p, o) in enumerate(zip(procs, outs)):
-            assert p.returncode == 0, f"pod worker {pid} (W={workers}) failed:\n{o[-3000:]}"
-        return outs
-
-    run_pod(workers=4, total_steps=2)
+    _run_pod(out, port, env, ["--workers", "4", "--total-steps", "2"])
     # the shrunk-W mesh must still span every pod device (train() rejects
     # a partial mesh on a pod — it would hang): W=2 x fsdp=2 = 4 devices
-    outs = run_pod(workers=2, total_steps=4, fsdp=2)
+    outs = _run_pod(out, _free_port(), env,
+                    ["--workers", "2", "--fsdp", "2", "--total-steps", "4"])
+    assert any("elastic resume" in o for o in outs), outs[0][-1500:]
+    assert "WORKER_OK" in outs[0]
+
+
+def _run_pod(out: str, port: int, env: dict, extra: list[str]) -> list[str]:
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, "--mode", "dist", "--pid", str(pid),
+             "--nproc", "2", "--port", str(port), "--out", out, *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for pid, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"pod worker {pid} failed:\n{o[-3000:]}"
+    return outs
+
+
+def _run_single(out: str, env: dict, extra: list[str]) -> None:
+    single = subprocess.run(
+        [sys.executable, WORKER, "--mode", "single", "--out", out, *extra],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert single.returncode == 0, (
+        f"single worker failed:\n{(single.stdout + single.stderr)[-3000:]}"
+    )
+
+
+def _assert_snapshots_match(dist_out: str, single_out: str) -> None:
+    import jax
+
+    ld = jax.tree.leaves(_snapshot(dist_out))
+    ls = jax.tree.leaves(_snapshot(single_out))
+    assert len(ld) == len(ls)
+    for a, b in zip(ld, ls):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+        )
+
+
+@pytest.mark.slow
+def test_pod_shape_worker_spans_processes(tmp_path):
+    """The 8B pod topology, driven by a REAL 2-process group: ONE DiLoCo
+    worker sharded fsdp=2 x tp=2 over all 4 devices — the fsdp axis
+    spans the process boundary (devices 0-1 on proc 0, 2-3 on proc 1),
+    so the inner step's gradient reductions and the feed path's
+    per-process batch slicing (parallel/feed.py) cross hosts. Must match
+    the identical single-process 4-device run. (Round-4 verdict: only
+    pure-diloco sharding was driven multi-process.)"""
+    port = _free_port()
+    env = _clean_env()
+    extra = ["--workers", "1", "--fsdp", "2", "--tp", "2"]
+    outs = _run_pod(str(tmp_path / "dist"), port, env, extra)
+    assert "WORKER_OK" in outs[0]
+    _run_single(str(tmp_path / "single"), env, extra)
+    _assert_snapshots_match(str(tmp_path / "dist"), str(tmp_path / "single"))
+
+
+@pytest.mark.slow
+def test_streaming_multiprocess_matches_single(tmp_path):
+    """Streaming DiLoCo under REAL multi-process coordination: fragment
+    launch/apply collectives ride the same 2-process Gloo group, and the
+    pod's final snapshot matches the single-process control. Also covers
+    streaming x elastic: the pod then resumes the streaming checkpoint
+    at W=2 x fsdp=2 (worker count changed — restore_elastic's streaming
+    branch restores per-fragment outer states + pending across hosts)."""
+    port = _free_port()
+    env = _clean_env()
+    stream = ["--streaming-fragments", "2", "--streaming-delay", "1"]
+    outs = _run_pod(str(tmp_path / "dist"), port, env, stream)
+    assert "WORKER_OK" in outs[0]
+    _run_single(str(tmp_path / "single"), env, stream)
+    _assert_snapshots_match(str(tmp_path / "dist"), str(tmp_path / "single"))
+
+    # streaming elastic resume on the same pod: W=4 checkpoint -> W=2
+    outs = _run_pod(
+        str(tmp_path / "dist"), _free_port(), env,
+        stream + ["--workers", "2", "--fsdp", "2", "--total-steps", "8"],
+    )
     assert any("elastic resume" in o for o in outs), outs[0][-1500:]
     assert "WORKER_OK" in outs[0]

@@ -483,12 +483,69 @@ def test_elastic_resume_across_worker_counts(tmp_path):
     assert [l["step"] for l in lines] == [4, 5, 6]  # resumed, not replayed
 
 
-def test_elastic_resume_rejected_for_streaming(tmp_path):
+def test_elastic_resume_streaming_across_worker_counts(tmp_path):
+    """A STREAMING checkpoint saved at W=4 resumes at W=2 (round-4
+    verdict item: per-fragment outer states and pending merges are
+    unstacked global state — exactly as re-broadcastable as the classic
+    snapshot): fragment outer momentum + pending restore exactly, every
+    new worker re-broadcasts from the last-merged snapshot, the LR
+    schedule continues, and training runs on to completion."""
+    from nanodiloco_tpu.training.checkpoint import CheckpointManager
+
     ckpt_dir = str(tmp_path / "ckpt")
-    train(small_cfg(tmp_path / "a", num_workers=2, total_steps=3,
+    train(small_cfg(tmp_path / "a", num_workers=4, total_steps=3,
                     streaming_fragments=2, streaming_delay=1,
                     checkpoint_dir=ckpt_dir))
-    with pytest.raises(ValueError, match="classic-DiLoCo-only"):
-        train(small_cfg(tmp_path / "b", num_workers=4, total_steps=6,
+    mngr = CheckpointManager(ckpt_dir)
+    assert mngr.saved_worker_count() == 4
+    saved = mngr.restore_raw(only={"snapshot", "outer_opt_states", "pending"})
+    mngr.close()
+
+    # unit-level: restore into a fresh W=2 streaming state
+    from nanodiloco_tpu.parallel import DilocoConfig, MeshConfig, build_mesh
+    from nanodiloco_tpu.parallel.streaming import StreamingConfig, StreamingDiloco
+
+    sd = StreamingDiloco(SMALL_MODEL, DilocoConfig(
+        num_workers=2, inner_steps=3, warmup_steps=2, total_steps=6, lr=1e-3,
+        grad_accum=2,
+    ), build_mesh(MeshConfig(diloco=2)),
+        StreamingConfig(num_fragments=2, delay=1))
+    fresh = sd.init_state(jax.random.key(7))
+    mngr = CheckpointManager(ckpt_dir)
+    state = mngr.restore_elastic(fresh)
+    mngr.close()
+    assert int(state.inner_step_count) == 3
+    for field in ("snapshot", "outer_opt_states", "pending"):
+        for a, b in zip(jax.tree.leaves(getattr(state, field)),
+                        jax.tree.leaves(saved[field])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for w in range(2):
+        worker = jax.tree.map(lambda p: np.asarray(p[w]), state.params)
+        for a, b in zip(jax.tree.leaves(worker), jax.tree.leaves(state.snapshot)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ints = [l for l in jax.tree.leaves(state.inner_opt_state)
+            if np.issubdtype(np.asarray(l).dtype, np.integer)]
+    assert ints and all((np.asarray(l) == 3).all() for l in ints)
+
+    # end-to-end: the W=2 streaming run picks the checkpoint up, applies
+    # restored pendings on schedule, and finishes
+    summary = train(small_cfg(tmp_path / "b", num_workers=2, total_steps=6,
+                              streaming_fragments=2, streaming_delay=1,
+                              checkpoint_dir=ckpt_dir))
+    assert np.isfinite(summary["final_loss"])
+    runs = os.listdir(tmp_path / "b" / "runs")
+    lines = [json.loads(l) for l in open(tmp_path / "b" / "runs" / runs[0])]
+    assert [l["step"] for l in lines] == [4, 5, 6]  # resumed, not replayed
+
+
+def test_elastic_resume_rejects_kind_mismatch(tmp_path):
+    """A classic checkpoint cannot elastic-restore into a streaming run:
+    the field sets differ and silently dropping fragment state would be
+    wrong — the error must say which fields are missing."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    train(small_cfg(tmp_path / "a", num_workers=4, total_steps=3,
+                    checkpoint_dir=ckpt_dir))
+    with pytest.raises(KeyError, match="outer_opt_states"):
+        train(small_cfg(tmp_path / "b", num_workers=2, total_steps=6,
                         streaming_fragments=2, streaming_delay=1,
                         checkpoint_dir=ckpt_dir))
