@@ -123,9 +123,12 @@ def pack_corpus_to_shard(
         raise ValueError(f"flush_rows must be >= 1; got {flush_rows}")
     buf: list[int] = []
     rows = 0
+    total_tokens = 0  # all tokens seen, not just the unflushed remainder
     limit = flush_rows * seq_length
     for t in texts:
-        buf.extend(tokenizer.encode(t, add_eos=True))
+        enc = tokenizer.encode(t, add_eos=True)
+        total_tokens += len(enc)
+        buf.extend(enc)
         if len(buf) >= limit:
             n = len(buf) // seq_length
             block = np.asarray(buf[: n * seq_length], dtype=np.int32)
@@ -139,7 +142,8 @@ def pack_corpus_to_shard(
         rows += n
     if rows == 0:
         raise ValueError(
-            f"corpus too small: {len(buf)} tokens < seq_length {seq_length}"
+            f"corpus too small: {total_tokens} tokens < seq_length "
+            f"{seq_length}"
         )
     return rows
 

@@ -48,6 +48,10 @@ class LlamaConfig:
     # only mode). Experts shard over the ``ep`` mesh axis.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # 1.25 justified by measurement (scripts/moe_evidence.py "cf",
+    # runs/moe_evidence_r5.jsonl): loss flat across cf 1.0-2.0 at the
+    # 120-step pylib budget while drops fall 0.34->0.09 — see the
+    # models/moe.py design note before trusting this at larger scale
     expert_capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
     # "tokens_choose": Switch-style top-k experts per token + load-balance

@@ -17,6 +17,34 @@ Mesh-TF / Switch-Transformer shape:
 - **Router in float32** with the Switch load-balance auxiliary loss
   ``E · Σ_e f_e · P_e`` (fraction of tokens routed to e × mean router
   probability of e), scaled by ``router_aux_coef`` in the LM loss.
+
+Where dense dispatch stops scaling (measured, round 5 —
+``scripts/moe_evidence.py`` phase "scale", ``runs/moe_evidence_r5.jsonl``):
+the ``[T, E, C]`` dispatch/combine tensors have ``E·C ≈ k·T·cf``
+elements regardless of E, so their MEMORY is O(T²) per layer, not
+O(E); what grows with E is router math and einsum padding. On the CPU
+mesh at fixed per-expert width, tokens/s degrades gently through E=32
+(−10% vs E=8) and visibly at E=64 (−40%). Past that scale the known
+alternative is sorted/ragged dispatch (argsort tokens by expert, then
+``jax.lax.ragged_dot`` / grouped matmul over contiguous runs — the
+shape used by Mixtral-style megablocks kernels): it replaces the
+one-hot einsums' padded FLOPs with exact-sized grouped matmuls at the
+cost of a data-dependent permutation. Not implemented: every config
+this repo ships (E ≤ 8, and the 8B MoE preset's E=8) sits well inside
+the dense-dispatch regime; the design seam is ``moe_mlp``'s
+dispatch/combine pair, which a ragged implementation would replace
+one-for-one.
+
+Capacity factor (measured, round 5 — phase "cf", fixed 120-step budget
+on the pylib corpus, 8 experts top-2, ``runs/moe_evidence_r5.jsonl``):
+final train loss is FLAT across cf ∈ {1.0, 1.25, 1.5, 2.0}
+(2.357–2.383, within run noise) while mean dropped_frac falls
+0.34 → 0.21 → 0.15 → 0.09 — the residual path really does carry
+dropped tokens at no measured quality cost at this scale/budget, and
+cf=2.0's +60% expert FLOPs buy nothing. The 1.25 default is therefore
+kept as a cheap safety margin over 1.0, not because drops were shown
+to hurt; re-run the sweep before trusting that at larger scale or
+longer budgets (capacity pressure grows with batch·seq).
 """
 
 from __future__ import annotations

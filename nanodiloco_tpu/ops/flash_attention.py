@@ -44,6 +44,54 @@ def _env_block(name: str) -> int | None:
     return v
 
 
+_POD_BLOCKS: tuple[int, int] | None = None
+
+
+def _tile_knobs() -> tuple[int, int]:
+    """(block_q, block_k) env overrides, 0 = unset.
+
+    Single-process: re-read from the environment at every trace, so the
+    in-process tile sweep (scripts/chip_agenda.py phase "pallas") retunes
+    without code edits. Multi-process pod: process 0's first read is
+    broadcast to every host and cached — per-process env divergence
+    would compile different programs per process, and multi-controller
+    SPMD answers that with a hang, not an error (round-4 advisor
+    finding; same treatment as resolve_run_name)."""
+    global _POD_BLOCKS
+    import jax
+
+    if jax.process_count() == 1:
+        return (
+            _env_block("NANODILOCO_PALLAS_BLOCK_Q") or 0,
+            _env_block("NANODILOCO_PALLAS_BLOCK_K") or 0,
+        )
+    if _POD_BLOCKS is None:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        if jax.process_index() == 0:
+            vals = [_env_block("NANODILOCO_PALLAS_BLOCK_Q") or 0,
+                    _env_block("NANODILOCO_PALLAS_BLOCK_K") or 0]
+        else:
+            # non-zero processes MUST reach the broadcast: their local
+            # values are discarded anyway, and raising on a malformed
+            # env var here would strand process 0 inside the collective
+            # — the exact hang class this broadcast exists to prevent
+            def safe(name):
+                try:
+                    return _env_block(name) or 0
+                except ValueError:
+                    return 0
+
+            vals = [safe("NANODILOCO_PALLAS_BLOCK_Q"),
+                    safe("NANODILOCO_PALLAS_BLOCK_K")]
+        agreed = np.asarray(
+            multihost_utils.broadcast_one_to_all(np.asarray(vals, np.int32))
+        )
+        _POD_BLOCKS = (int(agreed[0]), int(agreed[1]))
+    return _POD_BLOCKS
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -71,11 +119,13 @@ def flash_attention(
     # phase "pallas") retunes without code edits. Each fresh jit closure
     # (new Diloco / new jit of the caller) picks up the current value;
     # an already-compiled executable keeps the blocks it was traced with.
-    # Only consulted on pallas-relevant paths; validated so a malformed
+    # On a pod the values are broadcast from process 0 (_tile_knobs) so
+    # every host compiles the same program. Validated so a malformed
     # value fails with a clear message, not mid-grid-math.
     if impl != "scan":
-        bq = _env_block("NANODILOCO_PALLAS_BLOCK_Q") or min(128, block_size)
-        bk = _env_block("NANODILOCO_PALLAS_BLOCK_K") or min(128, block_size)
+        env_bq, env_bk = _tile_knobs()
+        bq = env_bq or min(128, block_size)
+        bk = env_bk or min(128, block_size)
     if impl is None:
         s = q.shape[1]
         pallas_ok = jax.default_backend() == "tpu" and (

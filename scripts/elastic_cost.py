@@ -1,0 +1,166 @@
+"""Measure the recovery cost of elastic resume's zeroed inner moments.
+
+``restore_elastic`` resets every worker's Adam moments (per-worker state
+at the old W cannot be reshaped meaningfully) and argues the first
+post-resume updates are merely damped (training/checkpoint.py). This
+script replaces that argument with a measurement (VERDICT r4 item 7):
+from ONE checkpoint, continue training two ways at the SAME worker
+count —
+
+  exact:   bit-exact ``restore`` (moments included) — the control;
+  elastic: ``restore_elastic`` into a fresh same-W state (moments
+           zeroed, schedule count advanced) — what a worker-count
+           change pays, isolated from the worker-count change itself;
+
+then run the same deterministic data through both for N rounds and
+record per-round losses to ``runs/elastic_cost_r5.jsonl``. The headline
+is steps-to-parity: the first inner step after which the elastic
+branch's loss stays within ``tol`` (relative) of the control's.
+
+Task: learnable synthetic next-token (+1 mod V) sequences — random-token
+data would plateau at ln(V) immediately and hide recovery dynamics.
+
+Runs on the virtual CPU mesh by default (no chip required):
+    python scripts/elastic_cost.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# Pin CPU BEFORE any backend query: calling jax.default_backend() here
+# would initialize the axon TPU plugin, which blocks forever while the
+# chip claim is wedged (PERF.md). Opt into a real-chip run explicitly.
+if os.environ.get("ELASTIC_COST_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+import jax.numpy as jnp
+import numpy as np
+
+from nanodiloco_tpu.models import LlamaConfig
+from nanodiloco_tpu.parallel import Diloco, DilocoConfig, MeshConfig, build_mesh
+from nanodiloco_tpu.training.checkpoint import CheckpointManager, abstract_state_like
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "runs", "elastic_cost_r5.jsonl",
+)
+
+W, H, ACCUM, B, S, V = 4, 5, 1, 4, 64, 128
+WARM_ROUNDS = 10    # rounds before the checkpoint
+CONT_ROUNDS = 24    # rounds after, per branch
+TOL = 0.01          # relative loss-gap for "recovered"
+
+MODEL = LlamaConfig(
+    vocab_size=V, hidden_size=64, intermediate_size=128,
+    num_attention_heads=4, num_hidden_layers=2, max_position_embeddings=S,
+)
+
+
+def make_round(key):
+    """[H, W, accum, B, S] arithmetic sequences with a RANDOM per-sequence
+    stride: the model must infer the stride from context, so loss
+    descends over many rounds instead of collapsing to ~0 immediately
+    (a +1-only task converges before the checkpoint and leaves no
+    recovery dynamics to measure)."""
+    ks, kt = jax.random.split(key)
+    start = jax.random.randint(ks, (H, W, ACCUM, B, 1), 0, V)
+    stride = jax.random.randint(kt, (H, W, ACCUM, B, 1), 1, 17)
+    tok = (start + stride * jnp.arange(S)[None, None, None, None, :]) % V
+    return tok.astype(jnp.int32), jnp.ones((H, W, ACCUM, B, S), jnp.int32)
+
+
+def run_branch(dl, state, key, n_rounds, tag, rec):
+    import time
+
+    for r in range(n_rounds):
+        key, k = jax.random.split(key)
+        tok, mask = make_round(k)
+        t0 = time.time()
+        state, losses, _ = dl.round_step(state, tok, mask)
+        rec.append({"branch": tag, "round": r,
+                    "losses": np.asarray(jnp.mean(losses, axis=1)).tolist()})
+        print(f"[{tag}] round {r} {time.time()-t0:.1f}s "
+              f"loss {rec[-1]['losses'][-1]:.4f}", flush=True)
+    return state
+
+
+def main() -> None:
+    import tempfile
+
+    mesh = build_mesh(MeshConfig(diloco=W))
+    cfg = DilocoConfig(num_workers=W, inner_steps=H, warmup_steps=10,
+                       total_steps=WARM_ROUNDS * H + CONT_ROUNDS * H,
+                       lr=3e-3, grad_accum=ACCUM)
+    dl = Diloco(MODEL, cfg, mesh)
+    state = dl.init_state(jax.random.key(0))
+    key = jax.random.key(1)
+    for _ in range(WARM_ROUNDS):
+        key, k = jax.random.split(key)
+        tok, mask = make_round(k)
+        state, _, _ = dl.round_step(state, tok, mask)
+
+    ckpt_dir = tempfile.mkdtemp(prefix="elastic_cost_")
+    mngr = CheckpointManager(ckpt_dir)
+    mngr.save(WARM_ROUNDS * H, state, force=True)
+    mngr.wait()
+
+    # the two branches see IDENTICAL post-checkpoint data
+    cont_key = jax.random.fold_in(jax.random.key(2), 0)
+    records: list[dict] = []
+
+    exact = mngr.restore(abstract_state_like(state))
+    run_branch(dl, exact, cont_key, CONT_ROUNDS, "exact", records)
+
+    fresh = dl.init_state(jax.random.key(99))  # different seed: nothing
+    # of the fresh init may survive the restore but shapes/shardings
+    elastic = mngr.restore_elastic(fresh)
+    mngr.close()
+    run_branch(dl, elastic, cont_key, CONT_ROUNDS, "elastic", records)
+
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+    ex = [l for r in records if r["branch"] == "exact" for l in r["losses"]]
+    el = [l for r in records if r["branch"] == "elastic" for l in r["losses"]]
+    # SIGNED relative gap (elastic - exact)/exact: positive = elastic
+    # behind. Per-step gaps are batch-noise dominated after the first
+    # few steps, so report windowed means plus a rolling-mean recovery
+    # step: the first step from which every 10-step rolling mean of the
+    # signed gap stays below TOL.
+    sg = [(b - a) / max(a, 1e-9) for a, b in zip(ex, el)]
+
+    def mean(xs):
+        return sum(xs) / max(len(xs), 1)
+
+    roll = [mean(sg[i:i + 10]) for i in range(len(sg) - 9)]
+    recovered = next(
+        (i for i in range(len(roll)) if all(r < TOL for r in roll[i:])), None
+    )
+    summary = {
+        "branch": "summary",
+        "steps_to_recovery_rolling10": recovered,
+        "tol": TOL,
+        "mean_gap_steps_1_10": round(mean(sg[1:11]), 4),
+        "mean_gap_steps_11_40": round(mean(sg[11:41]), 4),
+        "mean_gap_steps_41_end": round(mean(sg[41:]), 4),
+        "max_gap": round(max(sg), 4),
+        "exact_first_last": [round(ex[0], 4), round(ex[-1], 4)],
+        "elastic_first_last": [round(el[0], 4), round(el[-1], 4)],
+    }
+    with open(OUT, "a") as f:
+        f.write(json.dumps(summary) + "\n")
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Long-context training demonstration: sp=2 ring attention at seq 8192
+end to end through ``train()`` (VERDICT r4 item 8 — ring attention was
+parity-tested but no training artifact exercised seq > 1024; the
+reference caps sequence at 1024, ref training_utils/utils.py:45,50).
+
+Runs the full driver — data pipeline (packed synthetic corpus at seq
+8192), cross-shard label shift, chunked CE, fused DiLoCo rounds — on a
+diloco=2 x sp=2 virtual CPU mesh and records the JSONL artifact to
+``runs/longctx-sp2-r5/``. On real hardware the same config scales by
+swapping the mesh (the sp axis rides ICI); the chip-side number is a
+chip-agenda follow-up once multi-chip hardware exists (sp=2 needs 2
+devices; the tunnel exposes 1).
+
+    python scripts/longctx_demo.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# pin CPU before any backend query (the axon plugin blocks on a wedged
+# chip claim — PERF.md); opt into a real-chip run explicitly
+if os.environ.get("LONGCTX_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+from nanodiloco_tpu.models import LlamaConfig
+from nanodiloco_tpu.training.train_loop import TrainConfig, train
+
+
+def main() -> None:
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "runs", "longctx-sp2-r5",
+    )
+    model = LlamaConfig(
+        vocab_size=384, hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, num_key_value_heads=2, num_hidden_layers=2,
+        max_position_embeddings=8192, loss_chunk=512,
+        attention_impl="ring",
+    )
+    cfg = TrainConfig(
+        seed=1337,
+        batch_size=2,
+        per_device_batch_size=1,
+        seq_length=8192,
+        warmup_steps=2,
+        total_steps=6,
+        inner_steps=2,
+        lr=1e-3,
+        num_workers=2,
+        sp=2,
+        model=model,
+        log_dir=out,
+        run_name="longctx-sp2-seq8192",
+        quiet=False,
+        measure_comm=False,
+    )
+    summary = train(cfg)
+    print(f"LONGCTX_OK final_loss={summary['final_loss']:.4f}")
+
+
+if __name__ == "__main__":
+    main()

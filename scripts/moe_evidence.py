@@ -1,0 +1,168 @@
+"""MoE evidence capture (VERDICT r4 item 5): where dense dispatch stops
+scaling, and what capacity factor buys.
+
+(a) ``scale``: tokens/s vs num_experts E in {8, 16, 32, 64} at fixed
+    hidden size and per-expert width, on the CPU mesh. The [T, E, C]
+    one-hot dispatch/combine einsums (models/moe.py design note) grow
+    as O(T*E*C) with C ~ k*T*cf/E — so the dispatch TENSOR is O(T^2)
+    per layer regardless of E, but the einsum FLOPs and the router
+    softmax/top-k grow with E. This phase puts the measured curve on
+    record; the design note in models/moe.py cites it.
+
+(b) ``cf``: capacity factor in {1.0, 1.25, 1.5, 2.0} at a fixed step
+    budget on the REAL pylib corpus (data/pylib.tshrd, the round-3
+    materialization) with the same 8x-top2 MoE shape as
+    configs/llama_moe.json — final train loss, eval loss, and
+    dropped_frac per point, justifying (or indicting) the 1.25 default
+    that showed 0.18-0.29 drop rates in runs/moe-pylib-r4.jsonl.
+
+Appends JSON lines to ``runs/moe_evidence_r5.jsonl``.
+
+    python scripts/moe_evidence.py            # both phases
+    python scripts/moe_evidence.py scale      # one phase
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# pin CPU before any backend query (a wedged chip claim blocks axon
+# init forever — PERF.md); opt into a chip run explicitly
+if os.environ.get("MOE_EVIDENCE_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+import jax.numpy as jnp
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "runs", "moe_evidence_r5.jsonl")
+
+
+def record(rec: dict) -> None:
+    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), **rec}
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def phase_scale() -> None:
+    """Tokens/s vs E at fixed hidden/per-expert width (CPU mesh, smoke
+    shapes — the curve SHAPE is the datum, not the absolute numbers)."""
+    from nanodiloco_tpu.models import LlamaConfig
+    from nanodiloco_tpu.parallel import Diloco, DilocoConfig, MeshConfig, build_mesh
+
+    B, S, STEPS = 2, 256, 4
+    for E in (8, 16, 32, 64):
+        cfg = LlamaConfig(
+            vocab_size=1024, hidden_size=128, intermediate_size=256,
+            num_attention_heads=4, num_hidden_layers=2,
+            max_position_embeddings=S, loss_chunk=128,
+            num_experts=E, num_experts_per_tok=2,
+        )
+        mesh = build_mesh(MeshConfig(diloco=1))
+        dl = Diloco(cfg, DilocoConfig(
+            num_workers=1, inner_steps=STEPS, warmup_steps=2,
+            total_steps=100, lr=1e-3,
+        ), mesh)
+        state = dl.init_state(jax.random.key(0))
+        key = jax.random.key(1)
+
+        def mk(key):
+            tok = jax.random.randint(key, (STEPS, 1, 1, B, S), 0, 1024)
+            return tok.astype(jnp.int32), jnp.ones_like(tok)
+
+        key, k = jax.random.split(key)
+        tok, mask = mk(k)
+        state, loss, _ = dl.round_step(state, tok, mask)  # compile+warm
+        jax.block_until_ready(loss)
+        best = float("inf")
+        for _ in range(3):
+            key, k = jax.random.split(key)
+            tok, mask = mk(k)
+            t0 = time.perf_counter()
+            state, loss, _ = dl.round_step(state, tok, mask)
+            jax.block_until_ready(loss)
+            best = min(best, time.perf_counter() - t0)
+        toks_per_s = STEPS * B * S / best
+        T = B * S
+        k_, cf_ = cfg.num_experts_per_tok, cfg.expert_capacity_factor
+        C = -(-k_ * T * cf_ // E)  # ceil(k*T*cf/E), from the cfg itself
+        record({
+            "phase": "scale", "num_experts": E,
+            "tokens_per_sec": round(toks_per_s, 1),
+            "best_round_s": round(best, 4),
+            "dispatch_elems_per_layer": int(T * E * C),
+            "params": cfg.num_params(),
+        })
+
+
+def phase_cf() -> None:
+    """Capacity-factor sweep at fixed budget on the pylib corpus."""
+    import dataclasses
+
+    from nanodiloco_tpu.models import LlamaConfig
+    from nanodiloco_tpu.training.train_loop import TrainConfig, train
+
+    data = os.path.join(REPO, "data", "pylib.tshrd")
+    if not os.path.exists(data):
+        record({"phase": "cf", "skipped": f"{data} missing — run "
+                "scripts/prepare_data.py --text-dir first"})
+        return
+    base = LlamaConfig(
+        vocab_size=384, hidden_size=256, intermediate_size=512,
+        num_attention_heads=8, num_hidden_layers=6,
+        max_position_embeddings=256, loss_chunk=128,
+        num_experts=8, num_experts_per_tok=2,
+    )
+    for cf in (1.0, 1.25, 1.5, 2.0):
+        model = dataclasses.replace(base, expert_capacity_factor=cf)
+        out = os.path.join(REPO, "runs", "moe-cf-sweep-r5")
+        name = f"moe-cf{cf}"
+        log = os.path.join(out, f"{name}.jsonl")
+        if os.path.exists(log):
+            # the metrics sink appends; a stale log from a previous
+            # invocation would contaminate the stats read below
+            os.remove(log)
+        summary = train(TrainConfig(
+            seed=1337, batch_size=8, per_device_batch_size=4,
+            seq_length=256, warmup_steps=20, total_steps=120,
+            inner_steps=20, lr=1e-3, num_workers=1,
+            dataset_path=data, model=model, fit_vocab=True,
+            eval_every=1, log_dir=out, run_name=name, quiet=True,
+            measure_comm=False,
+        ))
+        lines = [json.loads(l) for l in open(log)]
+        evals = [l["eval_loss"] for l in lines if "eval_loss" in l]
+        drops = [l["moe_dropped_frac"] for l in lines
+                 if "moe_dropped_frac" in l]
+        record({
+            "phase": "cf", "capacity_factor": cf,
+            "final_loss": round(summary["final_loss"], 4),
+            "final_eval_loss": round(evals[-1], 4) if evals else None,
+            "dropped_frac_first_last": (
+                [round(drops[0], 4), round(drops[-1], 4)] if drops else None
+            ),
+            "mean_dropped_frac": round(float(np.mean(drops)), 4) if drops else None,
+        })
+
+
+PHASES = {"scale": phase_scale, "cf": phase_cf}
+
+
+def main() -> None:
+    names = sys.argv[1:] or ["scale", "cf"]
+    for n in names:
+        PHASES[n]()
+
+
+if __name__ == "__main__":
+    main()
