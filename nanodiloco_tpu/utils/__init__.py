@@ -1,4 +1,5 @@
 from nanodiloco_tpu.utils.utils import (
+    allreduce_wire_report,
     create_run_name,
     device_memory_stats,
     enable_compile_cache,
@@ -8,6 +9,7 @@ from nanodiloco_tpu.utils.utils import (
 )
 
 __all__ = [
+    "allreduce_wire_report",
     "create_run_name",
     "device_memory_stats",
     "enable_compile_cache",

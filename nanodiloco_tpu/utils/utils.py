@@ -211,3 +211,39 @@ def resolve_run_name(local_name: str, max_len: int = 128) -> str:
     buf[: len(enc)] = np.frombuffer(enc, np.uint8)
     out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
     return bytes(out).rstrip(b"\x00").decode(errors="replace")
+
+
+def allreduce_wire_report(hlo_text: str) -> tuple[list[str], list[str]]:
+    """Classify a compiled module's all-reduce operands for wire audits.
+
+    Returns ``(integer_results, wide_float_results)``: the result-type
+    strings (possibly tuples — XLA's combiner merges per-leaf psums)
+    of all-reduce ops that carry a signed-int payload, and of those
+    that carry a float tensor wider than 16 elements. Used by the
+    integer-wire HLO test (tests/test_diloco.py) and the multichip
+    dryrun (__graft_entry__.py) so the parsing lives in ONE place —
+    if XLA's text format changes (e.g. all-reduce-start/done pairs),
+    fix it here."""
+    import re
+
+    import numpy as np
+
+    results = [
+        l.split(" all-reduce(")[0]
+        for l in hlo_text.splitlines()
+        if " all-reduce(" in l and "=" in l
+    ] + [
+        l.split(" all-reduce-start(")[0]
+        for l in hlo_text.splitlines()
+        if " all-reduce-start(" in l and "=" in l
+    ]
+    int_payload = [r for r in results if re.search(r"s(8|16|32)\[", r)]
+    wide_float = []
+    for r in results:
+        for m in re.finditer(r"(f64|f32|f16|bf16)\[([0-9,]*)\]", r):
+            dims = [int(d) for d in m.group(2).split(",") if d]
+            n = int(np.prod(dims)) if dims else 1
+            if n > 16:
+                wide_float.append(r)
+                break
+    return int_payload, wide_float

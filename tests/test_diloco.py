@@ -512,18 +512,13 @@ def test_integer_wire_hlo_operand_dtype():
     fn = jax.jit(lambda s, p: dl._pseudograd(s, p, jnp.ones(4)))
     with jax.set_mesh(mesh):
         txt = fn.lower(snapshot, params).compile().as_text()
-    ars = [l for l in txt.splitlines() if " all-reduce(" in l and "=" in l]
-    assert ars, "no all-reduce in compiled HLO"
-    # the result type may be a tuple — XLA's combiner merges the
-    # per-leaf psums into one all-reduce like (s16[64], s16[64])
-    results = [l.split(" all-reduce(")[0] for l in ars]
-    int_payload = [r for r in results if re.search(r"s(8|16|32)\[", r)]
-    assert int_payload, "no integer-operand all-reduce:\n" + "\n".join(ars)
-    for r in results:
-        for m in re.finditer(r"(f64|f32|f16|bf16)\[([0-9,]*)\]", r):
-            dims = [int(d) for d in m.group(2).split(",") if d]
-            n = int(np.prod(dims)) if dims else 1
-            assert n <= 16, f"wide float all-reduce leaked onto the wire: {r}"
+    from nanodiloco_tpu.utils import allreduce_wire_report
+
+    int_payload, wide_float = allreduce_wire_report(txt)
+    assert int_payload, "no integer-operand all-reduce in compiled HLO"
+    assert not wide_float, (
+        f"wide float all-reduce leaked onto the wire: {wide_float}"
+    )
 
 
 def test_integer_wire_requires_int_dtype():
