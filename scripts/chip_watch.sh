@@ -5,57 +5,56 @@
 # that wedges a healthy claim, PERF.md) — and asserts the probed backend
 # is a real accelerator: a CPU fallback (or an env-pinned JAX_PLATFORMS=
 # cpu) reads as NOT live, so the agenda can never silently measure CPU.
+#
+# Round-5 hardening (PERF.md 2026-07-31 ledger): the probe runs a jitted
+# MATMUL, not just jax.devices() — that day's wedge acquired the claim
+# and then hung inside the first compile, which an init-only probe calls
+# healthy. And an agenda that comes back wedged/failed no longer ends the
+# watch: the chip flapped live->wedged within ~2 minutes once, so the
+# watcher returns to probing (up to max_agenda attempts) instead of
+# spending its one shot.
 # Probe exit codes: 0 = live accelerator, 2 = wedged/not-live (keep
 # waiting), anything else = hard error (abort — an unattended watcher
 # must not sleep for hours on an ImportError).
-# Usage: bash scripts/chip_watch.sh [max_probes] [sleep_s]
+# Usage: bash scripts/chip_watch.sh [max_probes] [sleep_s] [max_agenda]
 cd "$(dirname "$0")/.." || exit 1
 max=${1:-60}
 pause=${2:-600}
+max_agenda=${3:-5}
+agenda_runs=0
 for i in $(seq 1 "$max"); do
-  python - <<'EOF'
-import os
-import signal
-import subprocess
-import sys
-
-env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
-code = (
-    "import jax, sys; jax.devices(); "
-    "sys.exit(0 if jax.default_backend() != 'cpu' else 3)"
-)
-proc = subprocess.Popen(
-    [sys.executable, "-c", code],
-    stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
-)
-try:
-    proc.communicate(timeout=120)
-except subprocess.TimeoutExpired:
-    proc.send_signal(signal.SIGINT)
-    try:
-        proc.communicate(timeout=30)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        proc.communicate()
-    sys.exit(2)  # blocked init: the stuck-claim signature
-if proc.returncode == 0:
-    sys.exit(0)  # live accelerator
-if proc.returncode == 3:
-    sys.exit(2)  # CPU fallback: clean not-live
-sys.exit(1)      # probe itself broke -> hard error
-EOF
+  # single shared probe implementation (chip_agenda.chip_is_live): the
+  # watcher and the agenda must never disagree about chip health
+  python scripts/chip_agenda.py --probe
   rc=$?
   case $rc in
     0)
-      echo "chip_watch: claim LIVE at $(date -Is); running agenda" >&2
+      agenda_runs=$((agenda_runs + 1))
+      echo "chip_watch: claim LIVE at $(date -Is); agenda attempt ${agenda_runs}/${max_agenda}" >&2
       # sanitized launch: CPU-repro env (JAX_PLATFORMS + BENCH_* smoke
       # shapes from PERF.md's reproduce line) must not leak into the
       # on-chip evidence run
+      # ASSUME_LIVE: the watcher's probe (the identical shared one) just
+      # passed — a second initial probe would only cycle the claim.
+      # --resume on attempt 2+: never re-burn succeeded phases.
+      resume_flag=""
+      [ "$agenda_runs" -gt 1 ] && resume_flag="--resume"
       env -u JAX_PLATFORMS -u BENCH_SEQ -u BENCH_BATCH -u BENCH_ROUNDS \
           -u BENCH_INNER_STEPS -u BENCH_GRAD_ACCUM -u BENCH_CPU_DEVICES \
           -u BENCH_DEVICES -u BENCH_MID -u XLA_FLAGS \
-          python scripts/chip_agenda.py
-      exit $?
+          NANODILOCO_AGENDA_ASSUME_LIVE=1 \
+          python scripts/chip_agenda.py $resume_flag
+      arc=$?
+      if [ "$arc" -eq 0 ]; then
+        echo "chip_watch: agenda complete at $(date -Is)" >&2
+        exit 0
+      fi
+      echo "chip_watch: agenda exited rc=$arc at $(date -Is)" >&2
+      if [ "$agenda_runs" -ge "$max_agenda" ]; then
+        echo "chip_watch: agenda budget spent; giving up" >&2
+        exit 1
+      fi
+      sleep "$pause"
       ;;
     2)
       echo "chip_watch: probe $i/$max not live at $(date -Is); sleeping ${pause}s" >&2

@@ -25,6 +25,7 @@ import os
 import socket
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -122,24 +123,51 @@ def main() -> None:
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_NUM_CPU_DEVICES")}
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--role", "worker",
-             "--pid", str(pid), "--port", port],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env,
-        )
-        for pid in range(2)
-    ]
+    # worker output goes to FILES, not pipes: the workers are interlocked
+    # by Gloo collectives, so a worker blocked writing a full pipe while
+    # the launcher drains the OTHER worker is a three-way deadlock
+    # (round-5 review finding); files make draining unconditional
+    logs = [tempfile.NamedTemporaryFile("w+", suffix=f"-w{pid}.log",
+                                        delete=False) for pid in range(2)]
     try:
-        outs = [p.communicate(timeout=1800)[0] for p in procs]
+        procs = []
+        try:
+            # append one at a time: if the SECOND Popen raises (fork
+            # ENOMEM, fd exhaustion), worker 0 must still reach the
+            # kill-on-exit cleanup below — a comprehension would leave
+            # `procs` unbound and leak it holding the coordinator port
+            for pid in range(2):
+                procs.append(subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__), "--role",
+                     "worker", "--pid", str(pid), "--port", port],
+                    stdout=logs[pid], stderr=subprocess.STDOUT, text=True,
+                    env=env,
+                ))
+            deadline = time.monotonic() + 1800
+            for p in procs:
+                p.wait(timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            pass
+        finally:
+            # one worker dying strands the other at the distributed
+            # barrier; never leave a hung pair holding the coordinator
+            # port
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        outs = []
+        for lf in logs:
+            lf.flush()
+            lf.seek(0)
+            outs.append(lf.read())
     finally:
-        # one worker dying strands the other at the distributed barrier;
-        # never leave a hung pair holding the coordinator port
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.communicate()
+        for lf in logs:
+            lf.close()
+            try:
+                os.unlink(lf.name)
+            except FileNotFoundError:
+                pass
     for pid, (p, o) in enumerate(zip(procs, outs)):
         if p.returncode != 0:
             print(f"worker {pid} failed:\n{o[-3000:]}", file=sys.stderr)
