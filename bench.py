@@ -351,6 +351,17 @@ def run_moe(peak_tflops: float | None, degraded: bool = False) -> dict:
             batch=batch, seq=seq, peak_tflops=peak_tflops, measure_sync=False,
         ),
     }
+    # sorted grouped-matmul dispatch (models/moe.py, round 5): same model
+    # and routing, no [T, E, C] padding — the dense-vs-ragged delta on
+    # real hardware is the datum scripts/moe_evidence.py can only
+    # approximate on CPU
+    import dataclasses
+
+    out["single_ragged"] = run_workload(
+        dataclasses.replace(cfg, moe_dispatch="ragged"), n_dev=1,
+        grad_accum=1, inner_steps=steps, rounds=rounds, batch=batch,
+        seq=seq, peak_tflops=peak_tflops, measure_sync=False,
+    )
     if len(jax.devices()) >= 2:
         out["ep2"] = run_workload(
             cfg, n_dev=1, ep=2, grad_accum=1, inner_steps=steps,

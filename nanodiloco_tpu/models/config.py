@@ -65,6 +65,17 @@ class LlamaConfig:
     # num_experts_per_tok is the AVERAGE number of experts per token
     # (set 1 for Switch-equivalent compute).
     router_type: str = "tokens_choose"
+    # "dense": static one-hot dispatch/combine einsums [T, E, C] with
+    # capacity-overflow drops — the XLA-friendly default, right through
+    # E<=32 (measured, models/moe.py design note). "ragged": sort
+    # token-slot assignments by expert and run exact-sized grouped
+    # matmuls (jax.lax.ragged_dot, the Mixtral/megablocks shape) — no
+    # capacity, NO dropped tokens, FLOPs exact rather than padded; the
+    # large-E regime where the [T, E, C] einsum padding dominates.
+    # tokens_choose + replicated experts only (ep=1): the sorted
+    # permutation is sequence-global, and sharding experts over ep would
+    # need the all-to-all a megablocks-style kernel provides.
+    moe_dispatch: str = "dense"
 
     @property
     def head_dim(self) -> int:
@@ -97,6 +108,19 @@ class LlamaConfig:
             raise ValueError(
                 f"num_experts_per_tok ({self.num_experts_per_tok}) cannot "
                 f"exceed num_experts ({self.num_experts})"
+            )
+        if self.moe_dispatch not in ("dense", "ragged"):
+            raise ValueError(
+                f"moe_dispatch must be 'dense' or 'ragged'; got "
+                f"{self.moe_dispatch!r}"
+            )
+        if self.moe_dispatch == "ragged" and self.router_type != "tokens_choose":
+            raise ValueError(
+                "moe_dispatch='ragged' supports tokens_choose routing only: "
+                "expert-choice selects a FIXED top-C token set per expert, "
+                "which is exactly the static shape dense dispatch already "
+                "handles without padding waste — ragged's benefit (exact "
+                "group sizes) only exists for data-dependent group sizes"
             )
 
     @classmethod

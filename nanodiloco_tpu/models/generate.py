@@ -324,7 +324,10 @@ def generate(
     tokens overflow to the residual path differs between a training
     forward over the same text and prefill/decode, so logits can diverge.
     Keep capacity_factor generous for sampling, or treat bound-capacity
-    sampling as approximate.
+    sampling as approximate. ``moe_dispatch="ragged"`` has no capacity
+    at all, so this divergence does not exist there: cached decode
+    routes exactly as the training forward at ANY capacity factor
+    (tested: tests/test_generate.py ragged greedy parity).
     """
     if prompt.ndim != 2:
         raise ValueError(f"prompt must be [batch, prompt_len]; got {prompt.shape}")

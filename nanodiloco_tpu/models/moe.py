@@ -24,16 +24,27 @@ the ``[T, E, C]`` dispatch/combine tensors have ``E·C ≈ k·T·cf``
 elements regardless of E, so their MEMORY is O(T²) per layer, not
 O(E); what grows with E is router math and einsum padding. On the CPU
 mesh at fixed per-expert width, tokens/s degrades gently through E=32
-(−10% vs E=8) and visibly at E=64 (−40%). Past that scale the known
-alternative is sorted/ragged dispatch (argsort tokens by expert, then
-``jax.lax.ragged_dot`` / grouped matmul over contiguous runs — the
-shape used by Mixtral-style megablocks kernels): it replaces the
-one-hot einsums' padded FLOPs with exact-sized grouped matmuls at the
-cost of a data-dependent permutation. Not implemented: every config
-this repo ships (E ≤ 8, and the 8B MoE preset's E=8) sits well inside
-the dense-dispatch regime; the design seam is ``moe_mlp``'s
-dispatch/combine pair, which a ragged implementation would replace
-one-for-one.
+(−27% vs E=8) and visibly at E=64 (−46%). The large-E alternative IS
+implemented: ``moe_dispatch="ragged"`` (``_ragged_mlp``) argsorts
+token-slot assignments by expert and runs the SwiGLU as exact-sized
+``jax.lax.ragged_dot`` grouped matmuls over contiguous runs — the
+shape used by Mixtral-style megablocks kernels. No capacity, no
+dropped tokens, no one-hot padding FLOPs, and cached decode loses its
+capacity-divergence caveat; the trade is a data-dependent permutation
+(gather/scatter + group-size vector, all static shapes).
+
+Honest CPU-mesh caveat (same ``scale`` phase, ``dispatch: "ragged"``
+rows): on XLA:CPU ragged is SLOWER than dense at every measured E
+(0.59× at E=8 falling to 0.16× at E=64) — the grouped-matmul loop and
+gather/scatter lowering dominate there, so the padding-FLOPs win this
+path exists for is a TPU (Mosaic grouped matmul) property, queued for
+on-chip measurement as bench.py's ``single_ragged`` MoE entry. The
+correctness wins (zero drops, exact decode) hold on any backend.
+tokens_choose routing with replicated experts only
+(config.py / train_loop.py validate); dense dispatch remains the
+default and the ep>1 path — every shipped config with E ≤ 8 sits well
+inside its regime (``configs/llama_moe_64e.json`` ships the 64-expert
+ragged shape).
 
 Capacity factor (measured, round 5 — phase "cf", fixed 120-step budget
 on the pylib corpus, 8 experts top-2, ``runs/moe_evidence_r5.jsonl``):
@@ -146,6 +157,59 @@ def _experts_choose(
     return y, jnp.zeros((), jnp.float32), dropped
 
 
+def _ragged_mlp(
+    cfg: LlamaConfig, x: jax.Array, topk_p: jax.Array, topk_e: jax.Array,
+    layer: dict, valid_t: jax.Array | None,
+) -> jax.Array:
+    """Sorted/ragged token-choice dispatch (the Mixtral/megablocks shape;
+    implements the large-E alternative the module docstring previously
+    only design-documented). Flatten the [T, k] (token, slot) routing
+    assignments, stable-argsort them by expert id so each expert's
+    tokens are a contiguous run, and run the SwiGLU as three
+    ``jax.lax.ragged_dot`` grouped matmuls with exact per-expert group
+    sizes — no capacity, no dropped tokens, no one-hot [T, E, C] padding
+    FLOPs. All shapes stay static ([k·T, ...]); the data dependence is
+    confined to the gather/scatter indices and the group-size vector,
+    which is what keeps it XLA-compilable. x: [T, d]; topk_p/topk_e:
+    [T, k] normalized weights / expert ids. Returns y [T, d].
+
+    Padding tokens (valid_t = 0) keep their expert assignment — they
+    ride through the grouped matmuls as wasted-but-correct rows — and
+    are zeroed in the combine weight, identical to dense dispatch's
+    treatment. Numerics vs dense dispatch at non-binding capacity:
+    IDENTICAL routing and weights; summation order within an expert
+    differs (contiguous run vs one-hot einsum), so outputs agree to
+    dtype tolerance, not bit-exactly.
+    """
+    t, d = x.shape
+    k = topk_e.shape[1]
+    e = cfg.num_experts
+    cdt = x.dtype
+
+    e_flat = topk_e.reshape(t * k)                       # [kT] expert ids
+    w_flat = topk_p.reshape(t * k)                       # [kT] combine wts
+    tok_flat = jnp.repeat(jnp.arange(t, dtype=jnp.int32), k)  # [kT]
+    if valid_t is not None:
+        w_flat = w_flat * valid_t.astype(w_flat.dtype)[tok_flat]
+
+    order = jnp.argsort(e_flat, stable=True)             # expert-contiguous
+    xg = x[tok_flat[order]]                              # [kT, d] gather
+    group_sizes = jnp.bincount(e_flat, length=e).astype(jnp.int32)
+
+    gate = jax.nn.silu(
+        jax.lax.ragged_dot(xg, layer["w_gate"].astype(cdt), group_sizes)
+    )
+    up = jax.lax.ragged_dot(xg, layer["w_up"].astype(cdt), group_sizes)
+    out = jax.lax.ragged_dot(
+        gate * up, layer["w_down"].astype(cdt), group_sizes
+    )                                                    # [kT, d]
+
+    out = out * w_flat[order].astype(cdt)[:, None]
+    return (
+        jnp.zeros((t, d), cdt).at[tok_flat[order]].add(out)
+    )
+
+
 def _expert_ffn(expert_in: jax.Array, layer: dict) -> jax.Array:
     """Per-expert SwiGLU over dispatched slots [E, C, d] -> [E, C, d] —
     the one FFN body both router types share."""
@@ -235,30 +299,40 @@ def moe_mlp(
     topk_p, topk_e = jax.lax.top_k(probs, k)                        # [T, k]
     topk_p = topk_p / jnp.sum(topk_p, axis=-1, keepdims=True)
 
-    # per-(token, slot) position in the chosen expert's queue: a cumsum
-    # over tokens of that expert's one-hots, k slots interleaved in
-    # priority order (slot 0 claims capacity first)
     onehot = jax.nn.one_hot(topk_e, e, dtype=jnp.float32)           # [T, k, E]
     if valid is not None:
         # pad tokens route nowhere: no capacity consumed, zero output
         # (the residual stream carries them), no aux-statistics weight
         onehot = onehot * valid.reshape(t).astype(jnp.float32)[:, None, None]
-    slot_major = jnp.swapaxes(onehot, 0, 1).reshape(k * t, e)       # [k*T, E]
-    pos = jnp.cumsum(slot_major, axis=0) - slot_major               # arrival index
-    keep = (pos < cap) * slot_major                                 # [k*T, E]
-    pos = jnp.swapaxes(pos.reshape(k, t, e), 0, 1)                  # [T, k, E]
-    keep = jnp.swapaxes(keep.reshape(k, t, e), 0, 1)                # [T, k, E]
 
-    cap_onehot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
-    # dispatch/combine [T, E, C]
-    dispatch = jnp.einsum("tke,tkec->tec", keep, cap_onehot)
-    combine = jnp.einsum("tke,tkec->tec", keep * topk_p[..., None], cap_onehot)
+    if cfg.moe_dispatch == "ragged":
+        # exact-sized grouped matmuls, no capacity, nothing dropped;
+        # `keep` stays the full assignment for the shared stats below
+        y = _ragged_mlp(
+            cfg, x, topk_p, topk_e, layer,
+            None if valid is None else valid.reshape(t),
+        )
+        keep = onehot
+    else:
+        # per-(token, slot) position in the chosen expert's queue: a
+        # cumsum over tokens of that expert's one-hots, k slots
+        # interleaved in priority order (slot 0 claims capacity first)
+        slot_major = jnp.swapaxes(onehot, 0, 1).reshape(k * t, e)   # [k*T, E]
+        pos = jnp.cumsum(slot_major, axis=0) - slot_major           # arrival index
+        keep = (pos < cap) * slot_major                             # [k*T, E]
+        pos = jnp.swapaxes(pos.reshape(k, t, e), 0, 1)              # [T, k, E]
+        keep = jnp.swapaxes(keep.reshape(k, t, e), 0, 1)            # [T, k, E]
 
-    expert_in = jnp.einsum(
-        "tec,td->ecd", dispatch.astype(cdt), x
-    )                                                                # [E, C, d]
-    out_e = _expert_ffn(expert_in, layer)
-    y = jnp.einsum("tec,ecd->td", combine.astype(cdt), out_e)
+        cap_onehot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+        # dispatch/combine [T, E, C]
+        dispatch = jnp.einsum("tke,tkec->tec", keep, cap_onehot)
+        combine = jnp.einsum("tke,tkec->tec", keep * topk_p[..., None], cap_onehot)
+
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(cdt), x
+        )                                                            # [E, C, d]
+        out_e = _expert_ffn(expert_in, layer)
+        y = jnp.einsum("tec,ecd->td", combine.astype(cdt), out_e)
 
     # Switch load-balance loss on the top-1 assignment (pre-capacity),
     # statistics over REAL tokens only — and over the WHOLE sequence

@@ -232,6 +232,14 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 f"num_experts {cfg.model.num_experts} must divide evenly "
                 f"over --ep {cfg.ep}"
             )
+        if cfg.model.moe_dispatch == "ragged":
+            raise ValueError(
+                "moe_dispatch='ragged' requires replicated experts (--ep 1): "
+                "the sorted dispatch's grouped matmuls see every expert's "
+                "weights; sharding experts over ep would need the "
+                "megablocks-style all-to-all (models/moe.py design note). "
+                "Dense dispatch is the ep>1 path"
+            )
     mesh_cfg = MeshConfig(
         diloco=cfg.num_workers, fsdp=cfg.fsdp, tp=cfg.tp, sp=cfg.sp,
         pp=cfg.pp, ep=cfg.ep,

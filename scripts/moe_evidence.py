@@ -2,12 +2,15 @@
 scaling, and what capacity factor buys.
 
 (a) ``scale``: tokens/s vs num_experts E in {8, 16, 32, 64} at fixed
-    hidden size and per-expert width, on the CPU mesh. The [T, E, C]
-    one-hot dispatch/combine einsums (models/moe.py design note) grow
-    as O(T*E*C) with C ~ k*T*cf/E — so the dispatch TENSOR is O(T^2)
-    per layer regardless of E, but the einsum FLOPs and the router
-    softmax/top-k grow with E. This phase puts the measured curve on
-    record; the design note in models/moe.py cites it.
+    hidden size and per-expert width, on the CPU mesh — for BOTH
+    dispatch modes. Dense: the [T, E, C] one-hot dispatch/combine
+    einsums (models/moe.py design note) grow as O(T*E*C) with
+    C ~ k*T*cf/E — the dispatch TENSOR is O(T^2) per layer regardless
+    of E, but the einsum FLOPs and the router softmax/top-k grow with
+    E. Ragged (``moe_dispatch="ragged"``, round-5 implementation):
+    exact-sized ``ragged_dot`` grouped matmuls, no capacity padding —
+    the expected large-E winner. This phase puts both measured curves
+    on record; the design note in models/moe.py cites it.
 
 (b) ``cf``: capacity factor in {1.0, 1.25, 1.5, 2.0} at a fixed step
     budget on the REAL pylib corpus (data/pylib.tshrd, the round-3
@@ -61,12 +64,13 @@ def phase_scale() -> None:
     from nanodiloco_tpu.parallel import Diloco, DilocoConfig, MeshConfig, build_mesh
 
     B, S, STEPS = 2, 256, 4
-    for E in (8, 16, 32, 64):
+    for E, dispatch in [(e, d) for e in (8, 16, 32, 64)
+                        for d in ("dense", "ragged")]:
         cfg = LlamaConfig(
             vocab_size=1024, hidden_size=128, intermediate_size=256,
             num_attention_heads=4, num_hidden_layers=2,
             max_position_embeddings=S, loss_chunk=128,
-            num_experts=E, num_experts_per_tok=2,
+            num_experts=E, num_experts_per_tok=2, moe_dispatch=dispatch,
         )
         mesh = build_mesh(MeshConfig(diloco=1))
         dl = Diloco(cfg, DilocoConfig(
@@ -97,10 +101,15 @@ def phase_scale() -> None:
         k_, cf_ = cfg.num_experts_per_tok, cfg.expert_capacity_factor
         C = -(-k_ * T * cf_ // E)  # ceil(k*T*cf/E), from the cfg itself
         record({
-            "phase": "scale", "num_experts": E,
+            "phase": "scale", "num_experts": E, "dispatch": dispatch,
             "tokens_per_sec": round(toks_per_s, 1),
             "best_round_s": round(best, 4),
-            "dispatch_elems_per_layer": int(T * E * C),
+            # ragged has no [T, E, C] tensors at all — its dispatch state
+            # is the [k*T] sort permutation + [E] group sizes
+            "dispatch_elems_per_layer": (
+                int(T * E * C) if dispatch == "dense"
+                else int(cfg.num_experts_per_tok * T)
+            ),
             "params": cfg.num_params(),
         })
 

@@ -309,3 +309,16 @@ def test_top_k_and_top_p_compose():
     keys = jax.random.split(jax.random.key(7), 300)
     draws = np.asarray([int(_sample(logits, k, 1.0, 3, 0.8)[0]) for k in keys])
     assert set(draws) == {0, 1}
+
+
+def test_ragged_moe_decode_has_no_capacity_divergence():
+    """Token-choice MoE decode's documented divergence (capacity sized
+    from the current call's tokens, not the full training batch) is a
+    DENSE-dispatch artifact: ragged dispatch has no capacity, so cached
+    decode must match the training forward's argmax exactly even at a
+    capacity factor that would bind hard under dense dispatch."""
+    cfg = dataclasses.replace(
+        CFG, num_experts=4, num_experts_per_tok=2,
+        expert_capacity_factor=0.25, moe_dispatch="ragged",
+    )
+    _greedy_parity(cfg)
