@@ -113,7 +113,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--outer-comm-dtype", type=str, default=None,
                    help="quantization of the outer-sync pseudo-gradient: "
                         "a float dtype casts (bfloat16), a signed-int "
-                        "dtype uses per-tensor absmax scaling (int8). "
+                        "dtype uses per-tensor absmax scaling (int8, or "
+                        "int4 for a one-byte wire at W<=18 under "
+                        "--outer-wire-collective). "
                         "Controls the sync's NUMERICS (each worker's "
                         "delta is coarsened before averaging, the "
                         "robustness arXiv:2501.18512 relies on); whether "

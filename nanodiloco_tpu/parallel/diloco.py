@@ -78,8 +78,10 @@ class DilocoConfig:
     # signed-int outer_comm_dtype): the outer mean runs as a
     # shard_map-manual region over ``diloco`` where workers quantize
     # against a SHARED scale (one pmax'd scalar per tensor), the
-    # all-reduce operand is an integer tensor (int16 when W*q_max fits,
-    # else int32), and dequantization happens after the collective — so
+    # all-reduce operand is an integer tensor of the narrowest width
+    # the worst-case sum W*q_max fits (int8 for an "int4" wire at
+    # W<=18 — one byte per element; int16 for int8 payloads; int32
+    # beyond), and dequantization happens after the collective — so
     # the bytes that travel ICI/DCN are the quantized payload, matching
     # what the reference's wire actually carries
     # (ref nanodiloco/diloco/diloco.py:49). Default off: the default
@@ -172,6 +174,23 @@ class Diloco:
                 "sp/pp > 1 requires one mesh shard per DiLoCo worker "
                 f"(diloco axis {dict(mesh.shape)['diloco']} != num_workers "
                 f"{cfg.num_workers})"
+            )
+        if (
+            model_cfg.num_experts
+            and model_cfg.moe_dispatch == "ragged"
+            and int(dict(mesh.shape).get("ep", 1)) > 1
+        ):
+            # enforced HERE, not only in the CLI path: any library caller
+            # building Diloco on an ep>1 mesh would otherwise get GSPMD
+            # silently all-gathering every expert's weights per MoE layer
+            # — semantics preserved, expert parallelism defeated, no
+            # diagnostic
+            raise ValueError(
+                "moe_dispatch='ragged' requires replicated experts (ep=1): "
+                "the sorted dispatch's grouped matmuls see every expert's "
+                "weights; sharding experts over ep needs the "
+                "megablocks-style all-to-all (models/moe.py design note). "
+                "Use dense dispatch on ep>1 meshes"
             )
         if cfg.outer_comm_dtype is not None:
             wire = jnp.dtype(cfg.outer_comm_dtype)  # raises on garbage
@@ -734,11 +753,16 @@ class Diloco:
         dt = jnp.dtype(self.cfg.outer_comm_dtype)
         q_max = float(jnp.iinfo(dt).max)
         W = self.cfg.num_workers
-        acc_dt = (
-            jnp.int16
-            if W * q_max <= float(jnp.iinfo(jnp.int16).max)
-            else jnp.int32
-        )
+        # narrowest accumulator the worst-case sum W*q_max fits: int4
+        # payloads (q_max 7) ride an INT8 wire up to W=18 — one byte per
+        # element, 4x narrower than f32, the 4-bit outer-sync regime of
+        # arXiv:2501.18512
+        if W * q_max <= float(jnp.iinfo(jnp.int8).max):
+            acc_dt = jnp.int8
+        elif W * q_max <= float(jnp.iinfo(jnp.int16).max):
+            acc_dt = jnp.int16
+        else:
+            acc_dt = jnp.int32
         snap_leaves, treedef = jax.tree.flatten(snapshot)
         pw_leaves = jax.tree.leaves(params_w)
         mask = (

@@ -596,3 +596,89 @@ def test_outer_step_effective_mask_counts_param_blowup():
     new, eff = dl._outer_step(state, jnp.ones(4, bool))
     np.testing.assert_array_equal(np.asarray(eff), [True, True, False, True])
     assert np.isfinite(np.asarray(new.snapshot["w"])).all()
+
+
+def test_int4_wire_rides_int8_allreduce():
+    """outer_comm_dtype="int4" (q_max 7): at W=4 the worst-case sum is
+    28, so the accumulator — and therefore the all-reduce payload — is
+    INT8: one byte per element on the wire, 4x narrower than f32 (the
+    4-bit outer-sync regime of arXiv:2501.18512). The HLO must show an
+    s8 all-reduce and no wide-float leak."""
+    import re
+
+    dl, mesh = _int_wire_dl(dtype="int4")
+    snapshot = {"w": jax.random.normal(jax.random.key(1), (64,)),
+                "b": jax.random.normal(jax.random.key(2), (8, 8))}
+    params = jax.tree.map(
+        lambda s, k: s[None] + jax.random.normal(jax.random.key(k), (4,) + s.shape),
+        snapshot, {"w": 3, "b": 4},
+    )
+    fn = jax.jit(lambda s, p: dl._pseudograd(s, p, jnp.ones(4)))
+    with jax.set_mesh(mesh):
+        txt = fn.lower(snapshot, params).compile().as_text()
+    from nanodiloco_tpu.utils import allreduce_wire_report
+
+    int_payload, wide_float = allreduce_wire_report(txt)
+    assert int_payload, "no integer-operand all-reduce in compiled HLO"
+    assert any(re.search(r"s8\[", r) for r in int_payload), (
+        f"int4 wire did not ride an s8 all-reduce: {int_payload}"
+    )
+    assert not any(re.search(r"s(16|32)\[", r) for r in int_payload), (
+        f"int4 wire widened past s8: {int_payload}"
+    )
+    assert not wide_float, (
+        f"wide float all-reduce leaked onto the wire: {wide_float}"
+    )
+
+
+def test_int4_wire_numerics_bounded_and_mask_safe():
+    """int4's per-element error bound is scale/2 with
+    scale = global absmax / 7 — 18x coarser than int8, still bounded;
+    the masked-NaN-worker contract holds identically."""
+    dl, _ = _int_wire_dl(dtype="int4")
+    snapshot = {"w": jax.random.normal(jax.random.key(1), (16,)),
+                "b": jax.random.normal(jax.random.key(3), (4, 4)) * 5.0}
+    params = jax.tree.map(
+        lambda s, k: s[None] + jax.random.normal(jax.random.key(k), (4,) + s.shape) * 0.1,
+        snapshot, {"w": 2, "b": 4},
+    )
+    got = dl._pseudograd(snapshot, params)
+    for k in snapshot:
+        exact = np.asarray(snapshot[k]) - np.asarray(params[k]).mean(axis=0)
+        scale = np.abs(
+            np.asarray(snapshot[k])[None] - np.asarray(params[k])
+        ).max() / 7.0
+        assert (np.abs(np.asarray(got[k]) - exact) <= scale + 1e-7).all(), k
+
+    poisoned = jax.tree.map(lambda p: p.at[2].set(jnp.nan), params)
+    healthy = dl._pseudograd(snapshot, params, jnp.asarray([1, 1, 0, 1], bool))
+    masked = dl._pseudograd(snapshot, poisoned, jnp.asarray([1, 1, 0, 1], bool))
+    for k in snapshot:
+        np.testing.assert_array_equal(np.asarray(masked[k]), np.asarray(healthy[k]))
+        assert np.isfinite(np.asarray(masked[k])).all()
+
+
+def test_int4_wire_trains():
+    """A few fused rounds under the 1-byte wire on a learnable task:
+    loss must come down — 4-bit outer deltas train (the cited claim),
+    now demonstrated by this repo's own wire."""
+    mesh = build_mesh(MeshConfig(diloco=4))
+    cfg = DilocoConfig(num_workers=4, inner_steps=4, warmup_steps=4,
+                       total_steps=200, lr=3e-3, grad_accum=1,
+                       outer_comm_dtype="int4", outer_wire_collective=True)
+    dl = Diloco(TINY, cfg, mesh)
+    state = dl.init_state(jax.random.key(0))
+    key = jax.random.key(1)
+    first = last = None
+    for _ in range(6):
+        key, k = jax.random.split(key)
+        start = jax.random.randint(k, (4, 4, 1, 2, 1), 0, TINY.vocab_size)
+        tok = ((start + jnp.arange(16)[None, None, None, None, :])
+               % TINY.vocab_size).astype(jnp.int32)
+        tok = tok.reshape(4, 4, 1, 2, 16)
+        state, losses, _ = dl.round_step(state, tok, jnp.ones_like(tok))
+        mean = float(jnp.mean(losses))
+        first = mean if first is None else first
+        last = mean
+    assert np.isfinite(last)
+    assert last < first - 0.3, f"int4 wire failed to train: {first} -> {last}"

@@ -567,3 +567,15 @@ def test_ragged_rejected_with_expert_choice_and_ep():
             train(config_from_args(args))
     finally:
         os.unlink(path)
+
+
+def test_ragged_rejected_at_diloco_layer_on_ep_mesh():
+    """The replicated-experts contract is enforced where the mesh is
+    built, not only in the CLI: a library caller constructing Diloco on
+    an ep>1 mesh with ragged dispatch gets an immediate error instead of
+    GSPMD silently all-gathering every expert's weights per layer."""
+    cfg = _ragged_cfg()
+    dcfg = DilocoConfig(num_workers=2, inner_steps=2, warmup_steps=1,
+                        total_steps=10, lr=1e-3)
+    with pytest.raises(ValueError, match="replicated experts"):
+        Diloco(cfg, dcfg, build_mesh(MeshConfig(diloco=2, ep=2)))
