@@ -104,6 +104,21 @@ class DilocoConfig:
     quarantine_nonfinite: bool = False
 
 
+def _wire_accumulator_dtype(num_workers: int, q_max: float):
+    """Narrowest signed accumulator the worst-case sum W*q_max fits —
+    the dtype the integer-collective wire actually carries. int4
+    payloads (q_max 7) ride an INT8 wire up to W=18: one byte per
+    element, 4x narrower than f32, the 4-bit outer-sync regime of
+    arXiv:2501.18512. One source of truth for the wire program
+    (_pseudograd_integer_wire) and the payload report
+    (sync_payload_report)."""
+    if num_workers * q_max <= float(jnp.iinfo(jnp.int8).max):
+        return jnp.int8
+    if num_workers * q_max <= float(jnp.iinfo(jnp.int16).max):
+        return jnp.int16
+    return jnp.int32
+
+
 class DilocoState(struct.PyTreeNode):
     params: Any          # stacked [W, ...] — each worker's current params
     inner_opt_state: Any  # stacked [W, ...]
@@ -753,16 +768,7 @@ class Diloco:
         dt = jnp.dtype(self.cfg.outer_comm_dtype)
         q_max = float(jnp.iinfo(dt).max)
         W = self.cfg.num_workers
-        # narrowest accumulator the worst-case sum W*q_max fits: int4
-        # payloads (q_max 7) ride an INT8 wire up to W=18 — one byte per
-        # element, 4x narrower than f32, the 4-bit outer-sync regime of
-        # arXiv:2501.18512
-        if W * q_max <= float(jnp.iinfo(jnp.int8).max):
-            acc_dt = jnp.int8
-        elif W * q_max <= float(jnp.iinfo(jnp.int16).max):
-            acc_dt = jnp.int16
-        else:
-            acc_dt = jnp.int32
+        acc_dt = _wire_accumulator_dtype(W, q_max)
         snap_leaves, treedef = jax.tree.flatten(snapshot)
         pw_leaves = jax.tree.leaves(params_w)
         mask = (
@@ -864,6 +870,48 @@ class Diloco:
             ).astype(dt)
             return q.astype(jnp.float32) * scale
         return d.astype(dt).astype(jnp.float32)
+
+    def sync_payload_report(self) -> dict:
+        """What one outer sync actually moves per worker, by wire mode —
+        the byte-accounting companion to the measured sync wall-clock
+        (the comm metric the reference stubbed and never implemented,
+        ref nanodiloco/diloco/diloco.py:23-24,62-64). Returns
+        ``{"bytes_per_sync", "wire", "guaranteed", "f32_bytes"}``;
+        ``guaranteed`` is True only under ``outer_wire_collective``,
+        where a test pins the compiled all-reduce operand dtype — in
+        every other mode the number describes the reduce's INPUT dtype
+        and XLA's lowering owns what travels. Scales (one f32 per
+        tensor under the collective wire) are O(num_tensors), omitted.
+        """
+        n = self.model_cfg.num_params()
+        f32 = 4 * n
+        cfg = self.cfg
+        if cfg.outer_comm_dtype is None:
+            return {"bytes_per_sync": f32, "wire": "f32 (unquantized)",
+                    "guaranteed": False, "f32_bytes": f32}
+        wire = jnp.dtype(cfg.outer_comm_dtype)
+        if jnp.issubdtype(wire, jnp.floating):
+            # the float cast is quantize-dequantize BEFORE the mean
+            # (_wire_quantize returns f32), so the reduce's input — and
+            # therefore the honest number — is f32, same as the int
+            # numerics-only mode; XLA may or may not narrow the transfer
+            return {"bytes_per_sync": f32,
+                    "wire": f"{wire.name} numerics only (f32 reduce — "
+                            "XLA owns the wire)",
+                    "guaranteed": False, "f32_bytes": f32}
+        if not cfg.outer_wire_collective:
+            return {"bytes_per_sync": f32,
+                    "wire": f"{wire.name} numerics only (f32 reduce — "
+                            "XLA owns the wire; set outer_wire_collective "
+                            "to pin it)",
+                    "guaranteed": False, "f32_bytes": f32}
+        acc = jnp.dtype(_wire_accumulator_dtype(
+            cfg.num_workers, float(jnp.iinfo(wire).max)
+        ))
+        return {"bytes_per_sync": acc.itemsize * n,
+                "wire": f"{wire.name} payload on s{acc.itemsize * 8} "
+                        "all-reduce (HLO-pinned)",
+                "guaranteed": True, "f32_bytes": f32}
 
     def _replica_finite_mask(self, params_w: Any) -> jax.Array:
         """[W] bool: worker w's replica contains only finite values.

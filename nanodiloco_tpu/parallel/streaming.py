@@ -186,6 +186,20 @@ class StreamingDiloco(Diloco):
             self._fused_step, static_argnums=(3, 4), donate_argnums=(0,)
         ))
 
+    def sync_payload_report(self) -> dict:
+        """Fragment-aware byte accounting: one streaming sync launches a
+        SINGLE fragment (~1/P of the tree), not the whole model — the
+        inherited whole-tree number would overstate each staggered
+        launch by num_fragments (round-5 review finding). Reported as
+        the mean over fragments; layer-boundary splits make individual
+        fragments unequal by up to one layer."""
+        rep = super().sync_payload_report()
+        P = self.scfg.num_fragments
+        rep["bytes_per_sync"] = rep["bytes_per_sync"] // P
+        rep["f32_bytes"] = rep["f32_bytes"] // P
+        rep["wire"] += f"; mean per fragment launch, {P} staggered/round"
+        return rep
+
     # -- cadence -------------------------------------------------------------
 
     def due(self, t: int) -> tuple[tuple[int, ...], tuple[int, ...]]:

@@ -397,6 +397,16 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         )
     else:
         dl = Diloco(model_cfg, dcfg, mesh)
+    if cfg.num_workers > 1 and not quiet:
+        # byte accounting next to the measured sync wall-clock: what one
+        # outer sync moves per worker, and whether that width is an HLO-
+        # pinned guarantee or an XLA lowering choice
+        rep = dl.sync_payload_report()
+        print(
+            f"[nanodiloco] outer-sync payload: "
+            f"{rep['bytes_per_sync'] / 1e6:.1f} MB/worker on the wire "
+            f"({rep['wire']}; f32 would be {rep['f32_bytes'] / 1e6:.1f} MB)"
+        )
     init_tree = None
     if cfg.init_hf:
         from nanodiloco_tpu.models import from_hf_pretrained

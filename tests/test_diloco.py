@@ -682,3 +682,43 @@ def test_int4_wire_trains():
         last = mean
     assert np.isfinite(last)
     assert last < first - 0.3, f"int4 wire failed to train: {first} -> {last}"
+
+
+def test_sync_payload_report_accounting():
+    """Byte accounting per wire mode: every numerics-only mode (bf16
+    cast included — _wire_quantize dequantizes to f32 BEFORE the mean)
+    honestly reports the f32 reduce input; only the integer collective
+    guarantees a narrow wire, at the ACCUMULATOR width (int8 payload ->
+    s16 wire; int4 payload at W=4 -> s8 wire). Streaming divides by the
+    fragment count (one launch moves one fragment)."""
+    mesh = build_mesh(MeshConfig(diloco=4))
+    n = TINY.num_params()
+
+    def rep(**kw):
+        return Diloco(
+            TINY, DilocoConfig(num_workers=4, **kw), mesh
+        ).sync_payload_report()
+
+    r = rep()
+    assert r["bytes_per_sync"] == 4 * n and not r["guaranteed"]
+    r = rep(outer_comm_dtype="bfloat16")
+    assert r["bytes_per_sync"] == 4 * n and not r["guaranteed"]  # honest
+    r = rep(outer_comm_dtype="int8")
+    assert r["bytes_per_sync"] == 4 * n and not r["guaranteed"]  # honest
+    r = rep(outer_comm_dtype="int8", outer_wire_collective=True)
+    assert r["bytes_per_sync"] == 2 * n and r["guaranteed"]      # s16
+    r = rep(outer_comm_dtype="int4", outer_wire_collective=True)
+    assert r["bytes_per_sync"] == 1 * n and r["guaranteed"]      # s8
+    assert "s8" in r["wire"]
+
+    from nanodiloco_tpu.parallel.streaming import StreamingConfig, StreamingDiloco
+
+    sdl = StreamingDiloco(
+        TINY,
+        DilocoConfig(num_workers=4, inner_steps=4,
+                     outer_comm_dtype="int4", outer_wire_collective=True),
+        mesh, StreamingConfig(num_fragments=2, delay=1),
+    )
+    sr = sdl.sync_payload_report()
+    assert sr["bytes_per_sync"] == (1 * n) // 2 and sr["guaranteed"]
+    assert "fragment" in sr["wire"]

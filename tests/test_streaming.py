@@ -96,7 +96,7 @@ def test_stagger_cadence():
 # -- classic equivalence -----------------------------------------------------
 
 @pytest.mark.parametrize("wire,collective", [
-    (None, False), ("int8", False), ("int8", True),
+    (None, False), ("int8", False), ("int8", True), ("int4", True),
 ])
 def test_p1_delay0_equals_classic_diloco(wire, collective):
     """num_fragments=1, delay=0, merge_alpha=1 must reproduce classic
