@@ -28,19 +28,12 @@ from __future__ import annotations
 
 import json
 import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from evidence_common import REPO, pin_cpu_unless
+
+pin_cpu_unless("ELASTIC_COST_TPU")
 
 import jax
-
-# Pin CPU BEFORE any backend query: calling jax.default_backend() here
-# would initialize the axon TPU plugin, which blocks forever while the
-# chip claim is wedged (PERF.md). Opt into a real-chip run explicitly.
-if os.environ.get("ELASTIC_COST_TPU") != "1":
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
-
 import jax.numpy as jnp
 import numpy as np
 
@@ -48,10 +41,7 @@ from nanodiloco_tpu.models import LlamaConfig
 from nanodiloco_tpu.parallel import Diloco, DilocoConfig, MeshConfig, build_mesh
 from nanodiloco_tpu.training.checkpoint import CheckpointManager, abstract_state_like
 
-OUT = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "runs", "elastic_cost_r5.jsonl",
-)
+OUT = os.path.join(REPO, "runs", "elastic_cost_r5.jsonl")
 
 W, H, ACCUM, B, S, V = 4, 5, 1, 4, 64, 128
 WARM_ROUNDS = 10    # rounds before the checkpoint

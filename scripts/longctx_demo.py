@@ -17,27 +17,17 @@ devices; the tunnel exposes 1).
 from __future__ import annotations
 
 import os
-import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from evidence_common import REPO, pin_cpu_unless
 
-import jax
-
-# pin CPU before any backend query (the axon plugin blocks on a wedged
-# chip claim — PERF.md); opt into a real-chip run explicitly
-if os.environ.get("LONGCTX_TPU") != "1":
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+pin_cpu_unless("LONGCTX_TPU")
 
 from nanodiloco_tpu.models import LlamaConfig
 from nanodiloco_tpu.training.train_loop import TrainConfig, train
 
 
 def main() -> None:
-    out = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "runs", "longctx-sp2-r5",
-    )
+    out = os.path.join(REPO, "runs", "longctx-sp2-r5")
     model = LlamaConfig(
         vocab_size=384, hidden_size=64, intermediate_size=128,
         num_attention_heads=4, num_key_value_heads=2, num_hidden_layers=2,

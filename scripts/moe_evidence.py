@@ -32,29 +32,16 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from evidence_common import REPO, make_recorder, pin_cpu_unless
+
+pin_cpu_unless("MOE_EVIDENCE_TPU")
 
 import jax
-
-# pin CPU before any backend query (a wedged chip claim blocks axon
-# init forever — PERF.md); opt into a chip run explicitly
-if os.environ.get("MOE_EVIDENCE_TPU") != "1":
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
-
 import jax.numpy as jnp
 import numpy as np
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "runs", "moe_evidence_r5.jsonl")
-
-
-def record(rec: dict) -> None:
-    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), **rec}
-    os.makedirs(os.path.dirname(OUT), exist_ok=True)
-    with open(OUT, "a") as f:
-        f.write(json.dumps(rec) + "\n")
-    print(json.dumps(rec), flush=True)
+record = make_recorder(OUT)
 
 
 def phase_scale() -> None:

@@ -28,8 +28,9 @@ import sys
 import tempfile
 import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+from evidence_common import REPO
+
+sys.path.insert(0, REPO)  # workers import nanodiloco_tpu after re-exec
 
 OUT = os.path.join(REPO, "runs", "streaming_overlap_r5.json")
 

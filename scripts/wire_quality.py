@@ -1,0 +1,74 @@
+"""Quality-vs-bytes for the outer-sync wire (round 5).
+
+The integer-collective wire bounds BYTES (HLO-pinned: s16 for int8
+payloads, s8 for int4 — `Diloco.sync_payload_report`); this script puts
+the QUALITY side on record: identical 120-step budgets on the real
+pylib corpus (W=4 classic DiLoCo, same data order) under
+
+    f32    — unquantized outer sync (control);
+    int8   — absmax-quantized payload on the integer collective;
+    int4   — the 1-byte wire (q_max 7, s8 all-reduce).
+
+Records final train loss + final eval loss per mode to
+``runs/wire_quality_r5.jsonl``. The cited expectation
+(arXiv:2501.18512: 4-bit outer syncs train without quality loss) is
+either confirmed at this scale/budget or the gap is measured.
+
+Runs on the virtual CPU mesh by default (no chip required):
+    python scripts/wire_quality.py
+"""
+
+from __future__ import annotations
+
+import os
+
+from evidence_common import REPO, make_recorder, pin_cpu_unless
+
+pin_cpu_unless("WIRE_QUALITY_TPU")
+
+record = make_recorder(os.path.join(REPO, "runs", "wire_quality_r5.jsonl"))
+
+
+def main() -> None:
+    from nanodiloco_tpu.models import LlamaConfig
+    from nanodiloco_tpu.training.metrics import summarize_run
+    from nanodiloco_tpu.training.train_loop import TrainConfig, train
+
+    data = os.path.join(REPO, "data", "pylib.tshrd")
+    if not os.path.exists(data):
+        raise SystemExit(f"{data} missing — run scripts/prepare_data.py "
+                         "--text-dir /usr/lib/python3.11 first")
+    model = LlamaConfig(
+        vocab_size=384, hidden_size=256, intermediate_size=512,
+        num_attention_heads=8, num_hidden_layers=6,
+        max_position_embeddings=256, loss_chunk=128,
+    )
+    for label, dtype, collective in (
+        ("f32", None, False),
+        ("int8", "int8", True),
+        ("int4", "int4", True),
+    ):
+        out = os.path.join(REPO, "runs", "wire-quality-r5")
+        name = f"wire-{label}"
+        log = os.path.join(out, f"{name}.jsonl")
+        if os.path.exists(log):
+            os.remove(log)  # the metrics sink appends; stale logs poison stats
+        train(TrainConfig(
+            seed=1337, batch_size=8, per_device_batch_size=2,
+            seq_length=256, warmup_steps=20, total_steps=120,
+            inner_steps=20, lr=1e-3, num_workers=4,
+            dataset_path=data, model=model, fit_vocab=True,
+            eval_every=1, log_dir=out, run_name=name, quiet=True,
+            measure_comm=False,
+            outer_comm_dtype=dtype, outer_wire_collective=collective,
+        ))
+        summary = summarize_run(log)  # torn-line-safe, shared with `report`
+        record({
+            "wire": label,
+            "final_loss": summary.get("final_loss"),
+            "final_eval_loss": summary.get("final_eval_loss"),
+        })
+
+
+if __name__ == "__main__":
+    main()
