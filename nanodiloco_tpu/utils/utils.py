@@ -106,15 +106,18 @@ def ensure_live_backend(
     """Guard against a wedged accelerator claim: a client killed
     mid-compile can leave the tunneled chip's server-side claim stuck,
     after which EVERY backend init in EVERY process blocks forever
-    (PERF.md). Probe ``jax.devices()`` in a child with a timeout,
-    retrying until ``wait_s`` elapses; if the accelerator stays blocked
-    (or errors), reconfigure THIS process to ``n_cpu_devices`` virtual
-    CPU devices and set JAX_PLATFORMS=cpu so children follow suit.
+    (PERF.md). Run a jitted matmul in a probe child with a timeout —
+    end to end through init AND compile, because the round-5 wedge mode
+    passes init and hangs in the first compile — retrying until
+    ``wait_s`` elapses; if the accelerator stays blocked (or errors),
+    reconfigure THIS process to ``n_cpu_devices`` virtual CPU devices
+    and set JAX_PLATFORMS=cpu so children follow suit.
 
     Returns a reason string when degraded, None when the backend is live.
-    Must run before anything initializes a backend in this process. The
-    probe child is interrupted SIGINT-first with a grace period — a
-    SIGKILL mid-init is exactly the event that wedges a healthy claim.
+    Must run before anything initializes a backend in this process. A
+    timed-out probe child is interrupted SIGINT-first, then SIGTERM,
+    then SIGKILL — a SIGKILL mid-init/compile is exactly the event that
+    wedges a healthy claim.
     """
     import signal
     import subprocess
@@ -134,9 +137,21 @@ def ensure_live_backend(
     deadline = time.monotonic() + wait_s
     reason = None
     last_err = b""
+    # The probe runs a jitted MATMUL end to end, not just jax.devices():
+    # the round-5 wedge mode (PERF.md ledger, 2026-07-31) acquires the
+    # claim and prints the backend banner, then hangs forever inside the
+    # FIRST compile in a native retry-sleep no signal handler can reach.
+    # An init-only probe calls that chip healthy, and the caller (e.g.
+    # the driver's bench.py) then wedges unrecoverably mid-compile —
+    # strictly worse than a degraded CPU run.
+    probe_code = (
+        "import jax, jax.numpy as jnp; "
+        "x = jnp.ones((256, 256), jnp.bfloat16); "
+        "(x @ x).block_until_ready()"
+    )
     while True:
         proc = subprocess.Popen(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c", probe_code],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         )
         try:
@@ -146,12 +161,25 @@ def ensure_live_backend(
             reason = "accelerator backend init failed; using CPU"
             last_err = err
         except subprocess.TimeoutExpired:
+            # SIGINT -> SIGTERM -> SIGKILL: SIGINT is undeliverable
+            # inside the native wedge; SIGTERM is the interrupt proven
+            # to release a held claim cleanly (round-5 ledger); SIGKILL
+            # mid-compile is the documented claim-wedging event and
+            # stays the last resort
+            # short SIGINT grace: in the native-wedge mode SIGINT is
+            # undeliverable by construction, so a long first grace only
+            # delays the degraded-CPU fallback; it stays first for the
+            # init-phase wedge, where Python still handles signals
             proc.send_signal(signal.SIGINT)
             try:
-                proc.communicate(timeout=30)
+                proc.communicate(timeout=10)
             except subprocess.TimeoutExpired:
-                proc.kill()
-                proc.communicate()
+                proc.terminate()
+                try:
+                    proc.communicate(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.communicate()
             reason = "accelerator backend init blocked (stuck claim); using CPU"
         if time.monotonic() >= deadline:
             break
