@@ -142,3 +142,24 @@ def test_8b_param_count():
     """The config is genuinely Llama-3-8B-class (~8.03B params)."""
     n = LLAMA3_8B.num_params()
     assert 7.9e9 < n < 8.1e9, n
+
+
+def test_8b_sync_payload_at_wire_widths():
+    """The numbers the wire exists for, at the scale AND worker count it
+    exists for: W=4 (the 8B multi-slice pod shape — per-mode byte math
+    is pinned generically in tests/test_diloco.py; this pins only the
+    8B-specific magnitudes). One outer sync moves ~32 GB/worker
+    unquantized; the int4 collective wire bounds it at ~8 GB, and at
+    W=4 the worst-case sum 28 must still fit the s8 accumulator — the
+    4x that decides whether a DCN-crossing sync is minutes or tens of
+    seconds at a given cross-slice bandwidth."""
+    mesh = build_mesh(MeshConfig(diloco=4, fsdp=2))
+    narrow = Diloco(
+        LLAMA3_8B,
+        DilocoConfig(num_workers=4, outer_comm_dtype="int4",
+                     outer_wire_collective=True),
+        mesh,
+    ).sync_payload_report()
+    assert 7.9e9 < narrow["bytes_per_sync"] < 8.1e9   # ~8 GB on the wire
+    assert narrow["f32_bytes"] == 4 * narrow["bytes_per_sync"]
+    assert narrow["guaranteed"] and "s8" in narrow["wire"]

@@ -549,3 +549,20 @@ def test_elastic_resume_rejects_kind_mismatch(tmp_path):
         train(small_cfg(tmp_path / "b", num_workers=2, total_steps=6,
                         streaming_fragments=2, streaming_delay=1,
                         checkpoint_dir=ckpt_dir))
+
+
+def test_train_prints_sync_payload_notice(tmp_path, capsys):
+    """Multi-worker startup prints the outer-sync byte accounting (wire
+    mode + honest f32 comparison) exactly once, with MB math matching
+    Diloco.sync_payload_report."""
+    train(small_cfg(
+        tmp_path, quiet=False,
+        outer_comm_dtype="int4", outer_wire_collective=True,
+    ))
+    out = capsys.readouterr().out
+    lines = [l for l in out.splitlines() if "outer-sync payload" in l]
+    assert len(lines) == 1, out
+    n = SMALL_MODEL.num_params()
+    assert f"{n / 1e6:.1f} MB/worker" in lines[0]          # 1 byte/param
+    assert f"f32 would be {4 * n / 1e6:.1f} MB" in lines[0]
+    assert "s8 all-reduce (HLO-pinned)" in lines[0]
