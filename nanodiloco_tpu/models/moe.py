@@ -239,7 +239,9 @@ def moe_mlp(
     itself is sized from the shard's local tokens, so WHICH tokens
     overflow to the residual path differs from the unsharded order when
     it does bind (the same documented divergence as cached decode,
-    models/generate.py). The load-balance statistics stay globally
+    models/generate.py). Ragged dispatch has no capacity, so its
+    shard-local routing is the global routing EXACTLY at any capacity
+    factor (tested at cf=0.25, where dense binds hard). The load-balance statistics stay globally
     exact: f_e/p_e reduce over ``sp_axis`` (three [E]-sized psums), so
     the aux value equals the unsharded one on every shard. Expert-choice
     routing stays sequence-local-only: top-C token selection over a

@@ -291,15 +291,25 @@ def _run_inner_step(mc, model, schedule="gpipe", accum=2):
     return jax.device_get(st.params), np.asarray(loss)
 
 
-def test_moe_sp_matches_unsharded():
+@pytest.mark.parametrize("cf,dispatch", [
+    (4.0, "dense"),    # dense needs ample capacity: shard-local routing
+                       # == global only while nothing overflows
+    (0.25, "ragged"),  # ragged has NO capacity: shard-local == global
+                       # EXACTLY even where dense would bind hard; also
+                       # proves argsort/bincount/ragged_dot/scatter run
+                       # inside the shard_map manual region
+])
+def test_moe_sp_matches_unsharded(cf, dispatch):
     """Token-choice MoE under sequence parallelism: per-token routing is
-    shard-local but identical to the unsharded forward while capacity is
-    ample, and the load-balance aux statistics are globally exact — so a
-    full inner step on (diloco=2, sp=2) must reproduce the vmap path."""
+    shard-local but identical to the unsharded forward (while capacity
+    does not bind, for dense dispatch; unconditionally, for ragged), and
+    the load-balance aux statistics are globally exact — so a full inner
+    step on (diloco=2, sp=2) must reproduce the vmap path."""
     import dataclasses
 
     moe = dataclasses.replace(
-        MOE, attention_impl="ring", expert_capacity_factor=4.0
+        MOE, attention_impl="ring", expert_capacity_factor=cf,
+        moe_dispatch=dispatch,
     )
     flash = dataclasses.replace(moe, attention_impl="flash")
     with jax.default_matmul_precision("highest"):
