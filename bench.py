@@ -541,6 +541,9 @@ def main() -> None:
         # (VERDICT r2 weak #6)
         if baseline_record is not None:
             result["last_known_good"] = baseline_record
+        # the full wedge story (probe ledger, failure-mode analysis,
+        # recovery automation) lives in the repo — point the record there
+        result["see"] = "PERF.md round-5 chip ledger; chip_watch.sh armed"
     if mid is not None:
         result["mid"] = mid
     if os.environ.get("BENCH_DECODE") == "1":
