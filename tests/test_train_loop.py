@@ -566,3 +566,32 @@ def test_train_prints_sync_payload_notice(tmp_path, capsys):
     assert f"{n / 1e6:.1f} MB/worker" in lines[0]          # 1 byte/param
     assert f"f32 would be {4 * n / 1e6:.1f} MB" in lines[0]
     assert "s8 all-reduce (HLO-pinned)" in lines[0]
+
+
+def test_generate_cli_from_moe_ragged_checkpoint(tmp_path, capsys):
+    """The train -> checkpoint -> generate journey with a ragged-MoE
+    model: the model_config.json sidecar must carry the MoE fields
+    (num_experts, moe_dispatch) so the generate subcommand rebuilds the
+    right architecture — and ragged decode has no capacity divergence to
+    caveat. Mirrors the dense test above."""
+    import dataclasses
+
+    from nanodiloco_tpu.cli import main as cli_main
+
+    moe_model = dataclasses.replace(
+        SMALL_MODEL, num_experts=4, num_experts_per_tok=2,
+        moe_dispatch="ragged",
+    )
+    ckpt_dir = str(tmp_path / "ckpts")
+    train(small_cfg(tmp_path, model=moe_model, checkpoint_dir=ckpt_dir))
+    sidecar = json.load(
+        open(os.path.join(ckpt_dir, "model_config.json"))
+    )["model"]
+    assert sidecar.get("num_experts") == 4
+    assert sidecar.get("moe_dispatch") == "ragged"
+    cli_main([
+        "generate", "--checkpoint-dir", ckpt_dir, "--prompt", "ab",
+        "--max-new-tokens", "5", "--temperature", "0",
+    ])
+    out = capsys.readouterr().out
+    assert "ab" in out and len(out.strip()) > 2
