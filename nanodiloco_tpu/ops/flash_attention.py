@@ -69,22 +69,29 @@ def _tile_knobs() -> tuple[int, int]:
         import numpy as np
         from jax.experimental import multihost_utils
 
-        if jax.process_index() == 0:
-            vals = [_env_block("NANODILOCO_PALLAS_BLOCK_Q") or 0,
-                    _env_block("NANODILOCO_PALLAS_BLOCK_K") or 0]
-        else:
-            # non-zero processes MUST reach the broadcast: their local
-            # values are discarded anyway, and raising on a malformed
-            # env var here would strand process 0 inside the collective
-            # — the exact hang class this broadcast exists to prevent
-            def safe(name):
-                try:
-                    return _env_block(name) or 0
-                except ValueError:
-                    return 0
+        # EVERY process must reach the broadcast — including process 0:
+        # env is normally pushed uniformly across a pod, so a malformed
+        # value raising on rank 0 while ranks 1..N-1 already wait inside
+        # the collective is the exact hang class this broadcast exists
+        # to prevent (round-5 review; the guard originally covered only
+        # non-zero ranks). A bad value degrades to the auto default (0)
+        # pod-wide, with a rank-0 warning instead of a silent swallow.
+        def safe(name):
+            try:
+                return _env_block(name) or 0
+            except ValueError as e:
+                if jax.process_index() == 0:
+                    import sys
 
-            vals = [safe("NANODILOCO_PALLAS_BLOCK_Q"),
-                    safe("NANODILOCO_PALLAS_BLOCK_K")]
+                    print(
+                        f"[nanodiloco] warning: ignoring malformed {name}"
+                        f" ({e}); using auto tile",
+                        file=sys.stderr,
+                    )
+                return 0
+
+        vals = [safe("NANODILOCO_PALLAS_BLOCK_Q"),
+                safe("NANODILOCO_PALLAS_BLOCK_K")]
         agreed = np.asarray(
             multihost_utils.broadcast_one_to_all(np.asarray(vals, np.int32))
         )

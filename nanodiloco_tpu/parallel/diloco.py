@@ -69,7 +69,14 @@ class DilocoConfig:
     # stores 2P-1 — see ops/pipeline.py:pp_shard_grads_1f1b for the
     # bubble/memory trade)
     pp_schedule: str = "gpipe"
-    offload_snapshot: bool = False  # keep snapshot in host memory between syncs
+    # Park the sync snapshot in pinned_host BETWEEN dispatches (honest
+    # scope: inside a dispatched program — a fused round, or each step
+    # of a stepwise round once fetched — the snapshot is device-resident
+    # because the outer step consumes it; the HBM relief is the window
+    # between dispatches, where checkpoint saves, eval forwards, and the
+    # next round's batch prep happen). Public entries fetch it back to
+    # device automatically (_fetch). Classic DiLoCo only.
+    offload_snapshot: bool = False
     # Wire format of the outer all-reduce payload (e.g. "bfloat16" halves
     # DCN/ICI traffic; pseudo-gradients are noise-tolerant — the reference
     # always reduced in fp32). None = reduce in the snapshot's dtype.
@@ -272,20 +279,43 @@ class Diloco:
             self._pspec, is_leaf=lambda x: isinstance(x, P)
         )
         self._host_shardings = None
+        self._snap_device_shardings = None
         if cfg.offload_snapshot:
             try:
                 self._host_shardings = jax.tree.map(
                     lambda s: NamedSharding(mesh, s, memory_kind="pinned_host"),
                     self._pspec, is_leaf=lambda x: isinstance(x, P),
                 )
+                # the return path: consumers inside the jitted programs
+                # need the snapshot back in DEVICE memory (an elementwise
+                # op on a pinned_host operand is a compile error, round-5
+                # review finding)
+                self._snap_device_shardings = jax.tree.map(
+                    lambda s: NamedSharding(mesh, s, memory_kind="device"),
+                    self._pspec, is_leaf=lambda x: isinstance(x, P),
+                )
             except Exception:  # backend without pinned_host support
                 self._host_shardings = None
+                self._snap_device_shardings = None
 
-        self.inner_step = self._with_mesh(jax.jit(self._inner_step, donate_argnums=(0,)))
-        self.outer_step = self._with_mesh(
+        # Public entries are wrapped with _fetch: a snapshot offloaded to
+        # pinned_host between syncs must come back to device memory
+        # BEFORE entering a jitted program — jit's executable cache does
+        # not key on memory kind, so feeding a host buffer into the
+        # device-compiled executable fails at runtime (round-5 review
+        # finding; no-op without offload_snapshot).
+        _inner_jit = self._with_mesh(
+            jax.jit(self._inner_step, donate_argnums=(0,))
+        )
+        self.inner_step = lambda state, *a: _inner_jit(self._fetch(state), *a)
+        _outer_jit = self._with_mesh(
             jax.jit(self._outer_step_state, donate_argnums=(0,))
         )
-        self.round_step = self._with_mesh(jax.jit(self._round_step, donate_argnums=(0,)))
+        self.outer_step = lambda state, *a: _outer_jit(self._fetch(state), *a)
+        _round_jit = self._with_mesh(
+            jax.jit(self._round_step, donate_argnums=(0,))
+        )
+        self.round_step = lambda state, *a: _round_jit(self._fetch(state), *a)
         # H inner steps with NO outer sync: same dispatch count as
         # round_step, so differencing the two isolates the outer
         # all-reduce's true wall clock even in fused mode (the metric the
@@ -977,6 +1007,7 @@ class Diloco:
         advisor finding). All-ones when quarantine is off."""
         W = self.cfg.num_workers
         inner_opt_state = state.inner_opt_state
+        old_snapshot = state.snapshot
         if self.cfg.quarantine_nonfinite:
             # exact criterion, applied in BOTH dispatch paths: replica
             # params must be finite (any caller-provided loss-based mask
@@ -990,12 +1021,12 @@ class Diloco:
                 inner_opt_state, worker_mask, state.params
             )
         # pseudo-gradient, pre-averaged (ref diloco.py:48-49)
-        delta = self._pseudograd(state.snapshot, state.params, worker_mask)
+        delta = self._pseudograd(old_snapshot, state.params, worker_mask)
         delta = self._constrain(delta, worker_axis=False)
         updates, outer_opt_state = self.outer_tx.update(
-            delta, state.outer_opt_state, state.snapshot
+            delta, state.outer_opt_state, old_snapshot
         )
-        snapshot = optax.apply_updates(state.snapshot, updates)
+        snapshot = optax.apply_updates(old_snapshot, updates)
         snapshot = self._constrain(snapshot, worker_axis=False)
         # every worker resets to the new sync point (ref diloco.py:50)
         params = jax.tree.map(
@@ -1097,6 +1128,23 @@ class Diloco:
         if jax.tree.structure(state.snapshot) != self._pspec_struct:
             return state
         snap = jax.device_put(state.snapshot, self._host_shardings)
+        return state.replace(snapshot=snap)
+
+    def _fetch(self, state: DilocoState) -> DilocoState:
+        """Inverse of ``_offload``: bring a pinned_host snapshot back to
+        device memory before a jitted program consumes it. No-op when
+        offload is off, the tree shape is foreign (streaming states), or
+        the snapshot already lives on device."""
+        if self._snap_device_shardings is None:
+            return state
+        if jax.tree.structure(state.snapshot) != self._pspec_struct:
+            return state
+        leaves = jax.tree.leaves(state.snapshot)
+        if not leaves or getattr(
+            leaves[0].sharding, "memory_kind", None
+        ) != "pinned_host":
+            return state
+        snap = jax.device_put(state.snapshot, self._snap_device_shardings)
         return state.replace(snapshot=snap)
 
     def stack_round_batches(self, batches) -> tuple[jax.Array, jax.Array]:

@@ -146,6 +146,15 @@ class StreamingDiloco(Diloco):
                 "verdict exists yet; run classic rounds (or restart via "
                 "--supervise) for fault quarantine"
             )
+        if cfg.offload_snapshot:
+            raise ValueError(
+                "offload_snapshot is classic-DiLoCo-only: streaming's "
+                "fused step consumes per-fragment snapshot slices on a "
+                "staggered schedule with no single between-rounds window "
+                "to park them in host memory (and its jitted step has no "
+                "host-input path — a pinned_host snapshot fed to it is a "
+                "runtime error); classic rounds offload between syncs"
+            )
         self.scfg = scfg
         H, P = cfg.inner_steps, scfg.num_fragments
         if scfg.delay >= H:

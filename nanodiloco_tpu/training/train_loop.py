@@ -647,8 +647,15 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 if ckpt and rnd % cfg.checkpoint_every == 0:
                     ckpt.save(real_step, state)
                 eval_metrics = {}
+                if evaluator is not None or moe_stats_fn is not None:
+                    # _fetch ONCE for both consumers: an offloaded
+                    # snapshot lives in pinned_host and the eval/probe
+                    # forwards need device-resident weights — two
+                    # independent fetches would pay the H2D transfer
+                    # twice per eval round
+                    snap_dev = dl._fetch(state).snapshot
                 if evaluator is not None and rnd % cfg.eval_every == 0:
-                    eval_metrics = evaluator(state.snapshot, eval_set)
+                    eval_metrics = evaluator(snap_dev, eval_set)
                     last_eval_step, last_eval = real_step, eval_metrics
                 if moe_stats_fn is not None:
                     # new dict (not .update): eval_metrics may be aliased
@@ -656,7 +663,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     # index would dispatch a throwaway gather on dense runs
                     eval_metrics = {
                         **eval_metrics,
-                        **moe_probe(state.snapshot, toks[-1, 0, 0]),
+                        **moe_probe(snap_dev, toks[-1, 0, 0]),
                     }
                 # per-sync HBM occupancy (empty dict on backends without
                 # memory_stats, e.g. CPU — keys appear only when real)
@@ -753,19 +760,23 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             if synced:
                 jax.block_until_ready(state.params)
                 compute_time += time.perf_counter() - t0
+                if cfg.quarantine_nonfinite:
+                    # EXACT count for the log: same criterion the
+                    # sync applies (loss finiteness AND replica-
+                    # params finiteness — params are still pre-reset
+                    # here, so the check is host-drivable; round-4
+                    # advisor finding on the loss-only recount).
+                    # OUTSIDE the sync timer: this duplicate finiteness
+                    # scan is logging work, and charging it to sync_s
+                    # would inflate the measured comm share (round-5
+                    # review finding)
+                    eff = round_ok & dl._replica_finite_mask(
+                        state.params
+                    )
+                    quarantined_last_round = int(
+                        cfg.num_workers - eff.sum()
+                    )
                 with sync_timer:
-                    if cfg.quarantine_nonfinite:
-                        # EXACT count for the log: same criterion the
-                        # sync applies (loss finiteness AND replica-
-                        # params finiteness — params are still pre-reset
-                        # here, so the check is host-drivable; round-4
-                        # advisor finding on the loss-only recount)
-                        eff = round_ok & dl._replica_finite_mask(
-                            state.params
-                        )
-                        quarantined_last_round = int(
-                            cfg.num_workers - eff.sum()
-                        )
                     state = dl.outer_step(state, round_ok)
                     round_ok = None
                     jax.block_until_ready(state.params)
@@ -781,18 +792,22 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             profiling = False
 
         eval_metrics = {}
+        if synced and (evaluator is not None or moe_stats_fn is not None):
+            # one fetch for both consumers (offloaded snapshots pay one
+            # H2D transfer, not two)
+            snap_dev = dl._fetch(state).snapshot
         if (
             evaluator is not None
             and synced
             and (real_step // cfg.inner_steps) % cfg.eval_every == 0
         ):
-            eval_metrics = evaluator(state.snapshot, eval_set)
+            eval_metrics = evaluator(snap_dev, eval_set)
             last_eval_step = real_step
             last_eval = eval_metrics
         if synced and moe_stats_fn is not None:
             eval_metrics = {
                 **eval_metrics,
-                **moe_probe(state.snapshot, tokens[0, 0]),
+                **moe_probe(snap_dev, tokens[0, 0]),
             }
         if synced:
             eval_metrics = {**eval_metrics, **device_memory_stats()}
@@ -838,7 +853,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         # this exact snapshot
         final_eval = (
             last_eval if last_eval_step == cfg.total_steps
-            else evaluator(state.snapshot, eval_set)
+            else evaluator(dl._fetch(state).snapshot, eval_set)
         )
     logger.finish()
     total_time = compute_time + sync_timer.total

@@ -4,6 +4,7 @@ from nanodiloco_tpu.utils.utils import (
     device_memory_stats,
     enable_compile_cache,
     ensure_live_backend,
+    probe_backend,
     force_virtual_cpu_devices,
     set_seed_all,
 )
@@ -14,6 +15,7 @@ __all__ = [
     "device_memory_stats",
     "enable_compile_cache",
     "ensure_live_backend",
+    "probe_backend",
     "force_virtual_cpu_devices",
     "set_seed_all",
 ]

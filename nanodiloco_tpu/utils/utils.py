@@ -100,6 +100,73 @@ def force_virtual_cpu_devices(n: int, strict: bool = True) -> bool:
     return True
 
 
+def probe_backend(
+    probe_timeout: int = 150,
+    require_accelerator: bool = False,
+    strip_jax_platforms: bool = False,
+) -> tuple[int, bytes]:
+    """THE liveness probe — one implementation for every consumer
+    (``ensure_live_backend`` here; ``scripts/chip_agenda.py --probe``
+    and, through it, ``chip_watch.sh``), so the in-package guard and
+    the recovery tooling can never disagree about chip health (round-5
+    review finding: two hand-rolled copies had already diverged).
+
+    Runs a jitted bf16 matmul END TO END in a child process — through
+    init AND compile, because the round-5 wedge mode passes init and
+    hangs in the first compile. A timed-out child is escalated
+    SIGINT (short grace; undeliverable inside the native wedge but
+    still first for init-phase wedges) → SIGTERM (proven to release a
+    held claim cleanly) → SIGKILL last (a SIGKILL mid-compile is the
+    documented claim-wedging event).
+
+    Returns ``(code, stderr)`` with the chip_watch.sh exit-code
+    contract: 0 = live, 2 = wedged (or CPU-only when
+    ``require_accelerator``), 1 = the probe child itself broke.
+    ``strip_jax_platforms`` ignores a JAX_PLATFORMS=cpu override in the
+    caller's environment (the recovery tooling must probe the REAL
+    accelerator, never declare a cpu-pinned shell live)."""
+    import signal
+    import subprocess
+    import sys
+
+    env = None
+    if strip_jax_platforms:
+        env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    code = (
+        "import jax, jax.numpy as jnp, sys; "
+        "x = jnp.ones((256, 256), jnp.bfloat16); "
+        "(x @ x).block_until_ready(); "
+        "sys.exit(0 if jax.default_backend() != 'cpu' else 3)"
+        if require_accelerator
+        else "import jax, jax.numpy as jnp; "
+        "x = jnp.ones((256, 256), jnp.bfloat16); "
+        "(x @ x).block_until_ready()"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+    )
+    try:
+        _, err = proc.communicate(timeout=probe_timeout)
+        if proc.returncode == 0:
+            return 0, err
+        if require_accelerator and proc.returncode == 3:
+            return 2, err  # healthy backend, but it is CPU: not live
+        return 1, err
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
+        return 2, b""
+
+
 def ensure_live_backend(
     wait_s: int = 0, probe_timeout: int = 120, n_cpu_devices: int = 1
 ) -> str | None:
@@ -119,8 +186,6 @@ def ensure_live_backend(
     then SIGKILL — a SIGKILL mid-init/compile is exactly the event that
     wedges a healthy claim.
     """
-    import signal
-    import subprocess
     import sys
     import time
 
@@ -137,49 +202,18 @@ def ensure_live_backend(
     deadline = time.monotonic() + wait_s
     reason = None
     last_err = b""
-    # The probe runs a jitted MATMUL end to end, not just jax.devices():
-    # the round-5 wedge mode (PERF.md ledger, 2026-07-31) acquires the
-    # claim and prints the backend banner, then hangs forever inside the
-    # FIRST compile in a native retry-sleep no signal handler can reach.
-    # An init-only probe calls that chip healthy, and the caller (e.g.
-    # the driver's bench.py) then wedges unrecoverably mid-compile —
-    # strictly worse than a degraded CPU run.
-    probe_code = (
-        "import jax, jax.numpy as jnp; "
-        "x = jnp.ones((256, 256), jnp.bfloat16); "
-        "(x @ x).block_until_ready()"
-    )
+    # shared probe (probe_backend above): jitted matmul end to end — an
+    # init-only probe calls the compile-phase wedge mode healthy and the
+    # caller (e.g. the driver's bench.py) then wedges unrecoverably
+    # mid-compile, strictly worse than a degraded CPU run
     while True:
-        proc = subprocess.Popen(
-            [sys.executable, "-c", probe_code],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-        )
-        try:
-            _, err = proc.communicate(timeout=probe_timeout)
-            if proc.returncode == 0:
-                return None
+        code, err = probe_backend(probe_timeout=probe_timeout)
+        if code == 0:
+            return None
+        if code == 1:
             reason = "accelerator backend init failed; using CPU"
             last_err = err
-        except subprocess.TimeoutExpired:
-            # SIGINT -> SIGTERM -> SIGKILL: SIGINT is undeliverable
-            # inside the native wedge; SIGTERM is the interrupt proven
-            # to release a held claim cleanly (round-5 ledger); SIGKILL
-            # mid-compile is the documented claim-wedging event and
-            # stays the last resort
-            # short SIGINT grace: in the native-wedge mode SIGINT is
-            # undeliverable by construction, so a long first grace only
-            # delays the degraded-CPU fallback; it stays first for the
-            # init-phase wedge, where Python still handles signals
-            proc.send_signal(signal.SIGINT)
-            try:
-                proc.communicate(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.terminate()
-                try:
-                    proc.communicate(timeout=30)
-                except subprocess.TimeoutExpired:
-                    proc.kill()
-                    proc.communicate()
+        else:
             reason = "accelerator backend init blocked (stuck claim); using CPU"
         if time.monotonic() >= deadline:
             break
@@ -241,17 +275,25 @@ def resolve_run_name(local_name: str, max_len: int = 128) -> str:
     return bytes(out).rstrip(b"\x00").decode(errors="replace")
 
 
-def allreduce_wire_report(hlo_text: str) -> tuple[list[str], list[str]]:
+def allreduce_wire_report(
+    hlo_text: str, scale_leaves: int = 16
+) -> tuple[list[str], list[str]]:
     """Classify a compiled module's all-reduce operands for wire audits.
 
     Returns ``(integer_results, wide_float_results)``: the result-type
     strings (possibly tuples — XLA's combiner merges per-leaf psums)
     of all-reduce ops that carry a signed-int payload, and of those
-    that carry a float tensor wider than 16 elements. Used by the
-    integer-wire HLO test (tests/test_diloco.py) and the multichip
-    dryrun (__graft_entry__.py) so the parsing lives in ONE place —
-    if XLA's text format changes (e.g. all-reduce-start/done pairs),
-    fix it here."""
+    that carry a float tensor wider than the legitimate bookkeeping
+    floats. The integer wire itself all-reduces one f32 scalar PER
+    TENSOR (the shared absmax pmax) plus the survivor count — pass
+    ``scale_leaves`` = the synced pytree's leaf count so a model whose
+    tree outgrows the default does not read its own scale op as a
+    payload leak (round-5 review finding: the old fixed 16 breaks at
+    17+ leaves). Used by the integer-wire HLO tests
+    (tests/test_diloco.py) and the multichip dryrun
+    (__graft_entry__.py) so the parsing lives in ONE place — if XLA's
+    text format changes (e.g. all-reduce-start/done pairs), fix it
+    here."""
     import re
 
     import numpy as np
@@ -266,12 +308,13 @@ def allreduce_wire_report(hlo_text: str) -> tuple[list[str], list[str]]:
         if " all-reduce-start(" in l and "=" in l
     ]
     int_payload = [r for r in results if re.search(r"s(8|16|32)\[", r)]
+    threshold = max(16, int(scale_leaves))
     wide_float = []
     for r in results:
         for m in re.finditer(r"(f64|f32|f16|bf16)\[([0-9,]*)\]", r):
             dims = [int(d) for d in m.group(2).split(",") if d]
             n = int(np.prod(dims)) if dims else 1
-            if n > 16:
+            if n > threshold:
                 wide_float.append(r)
                 break
     return int_payload, wide_float

@@ -51,58 +51,21 @@ def record(rec: dict) -> None:
 
 
 def probe_status() -> int:
-    """Probe the accelerator claim in a child, SIGINT-first (a SIGKILL
-    mid-init is what wedges a healthy claim, PERF.md). Deliberately
-    ignores a JAX_PLATFORMS=cpu override in this shell — the agenda is
-    only meaningful on the chip, so a cpu-pinned environment must read
-    as not-live, never as something to silently measure CPU on.
+    """Shared liveness contract (0 = live accelerator, 2 = wedged/
+    CPU-only, 1 = probe broke): delegates to the ONE implementation in
+    ``nanodiloco_tpu.utils.probe_backend`` — jitted-matmul probe child,
+    SIGINT→SIGTERM→SIGKILL escalation — so the agenda, chip_watch.sh,
+    and the in-package ``ensure_live_backend`` guard can never disagree
+    about chip health. ``require_accelerator``: the agenda is only
+    meaningful on the chip; ``strip_jax_platforms``: a cpu-pinned shell
+    must read as not-live, never as something to silently measure."""
+    from nanodiloco_tpu.utils import probe_backend
 
-    The probe runs a jitted matmul, not just ``jax.devices()``: the
-    round-5 wedge (PERF.md ledger, 2026-07-31) acquired the claim and
-    printed the backend warning, then hung inside the FIRST compile in a
-    native retry-sleep — an init-only probe reads that chip as healthy.
-
-    Returns the chip_watch.sh exit-code contract: 0 = live accelerator,
-    2 = wedged or CPU-only (keep waiting), 1 = the probe child itself
-    broke (an unattended watcher must abort, not sleep on an
-    ImportError for hours).
-    """
-    import signal
-
-    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
-    code = (
-        "import jax, jax.numpy as jnp, sys; "
-        "x = jnp.ones((256, 256), jnp.bfloat16); "
-        "(x @ x).block_until_ready(); "
-        "sys.exit(0 if jax.default_backend() != 'cpu' else 3)"
+    code, _ = probe_backend(
+        probe_timeout=150, require_accelerator=True,
+        strip_jax_platforms=True,
     )
-    proc = subprocess.Popen(
-        [sys.executable, "-c", code],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
-    )
-    try:
-        proc.communicate(timeout=150)
-        if proc.returncode == 0:
-            return 0
-        return 2 if proc.returncode == 3 else 1
-    except subprocess.TimeoutExpired:
-        # escalation ladder: SIGINT (polite) -> SIGTERM (proven to
-        # release a held claim cleanly, round-5 ledger) -> SIGKILL as
-        # the absolute last resort ONLY. A timed-out probe can be a
-        # slow-but-healthy chip mid-compile, and a SIGKILL there is the
-        # documented claim-wedging event — the probe must never be the
-        # thing that wedges the chip it is probing.
-        proc.send_signal(signal.SIGINT)
-        try:
-            proc.communicate(timeout=30)
-        except subprocess.TimeoutExpired:
-            proc.terminate()
-            try:
-                proc.communicate(timeout=30)
-            except subprocess.TimeoutExpired:
-                proc.kill()
-                proc.communicate()
-        return 2
+    return code
 
 
 def chip_is_live() -> bool:

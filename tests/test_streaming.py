@@ -349,3 +349,17 @@ def test_streaming_sp_trains():
         state, loss = sd.step(state, tok, m, t)
     assert np.isfinite(np.asarray(loss)).all()
 
+
+
+def test_streaming_rejects_offload_snapshot():
+    """offload_snapshot is classic-only: streaming's jitted step has no
+    host-input path, so a pinned_host snapshot fed to it is a runtime
+    error — reject at construction with the rationale."""
+    with pytest.raises(ValueError, match="classic-DiLoCo-only"):
+        StreamingDiloco(
+            TINY,
+            DilocoConfig(num_workers=2, inner_steps=4,
+                         offload_snapshot=True),
+            build_mesh(MeshConfig(diloco=2)),
+            StreamingConfig(num_fragments=2, delay=1),
+        )
