@@ -166,6 +166,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "every N outer syncs (0 = off)")
     p.add_argument("--eval-batches", type=int, default=8,
                    help="number of held-out eval batches to reserve")
+    # --- observability (nanodiloco_tpu/obs) ---
+    p.add_argument("--trace-out", type=str, default=None, metavar="JSON",
+                   help="write a Chrome trace-event JSON of host-side "
+                        "round phases (data/inner/sync/eval/ckpt) — open "
+                        "in Perfetto or chrome://tracing; no jax.profiler "
+                        "involved, negligible overhead")
+    p.add_argument("--status-file", type=str, default=None, metavar="JSON",
+                   help="maintain a live status.json (atomic rewrite) "
+                        "with state/step/loss/throughput/alarms for "
+                        "external pollers")
+    p.add_argument("--watch-loss-zscore", type=float, default=6.0,
+                   help="watchdog: alarm when a loss rises more than this "
+                        "many rolling-window std-devs above the window "
+                        "mean (0 disables)")
+    p.add_argument("--watch-loss-window", type=int, default=32,
+                   help="watchdog: rolling window length for the spike "
+                        "and throughput sentinels")
+    p.add_argument("--watch-tps-collapse", type=float, default=0.4,
+                   help="watchdog: alarm when tokens/sec drops below this "
+                        "fraction of the rolling median (0 disables)")
+    p.add_argument("--watch-stall-factor", type=float, default=5.0,
+                   help="watchdog: alarm when no loop heartbeat for this "
+                        "many times the rolling round time (0 disables "
+                        "the heartbeat thread)")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="write a jax.profiler trace to this directory: one "
                         "whole warm round under fused dispatch (the "
@@ -252,6 +276,12 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         measure_comm=measure_comm,
         eval_every=args.eval_every,
         eval_batches=args.eval_batches,
+        trace_out=args.trace_out,
+        status_file=args.status_file,
+        watch_loss_zscore=args.watch_loss_zscore,
+        watch_loss_window=args.watch_loss_window,
+        watch_tps_collapse=args.watch_tps_collapse,
+        watch_stall_factor=args.watch_stall_factor,
         profile_dir=args.profile_dir,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
@@ -433,8 +463,16 @@ def export_hf_main(argv: list[str]) -> None:
 def report_main(argv: list[str]) -> None:
     """``nanodiloco_tpu report RUN.jsonl``: one-screen operator summary
     of a training run's metrics stream (the JSONL is the source of
-    truth, metrics.py) — loss/eval trend, throughput, sync share,
-    quarantine events, HBM peak, MoE router health."""
+    truth, metrics.py) — loss/eval trend, throughput, sync share, wire
+    bytes, alarms, quarantine events, HBM peak, MoE router health.
+
+    ``report compare BASELINE CANDIDATE``: regression gate — diff two
+    runs (each a run .jsonl or a summary/BASELINE .json) and exit 1
+    when the candidate regresses past the configured thresholds, so a
+    bench trajectory becomes an enforced contract in CI or a cron."""
+    if argv[:1] == ["compare"]:
+        report_compare_main(argv[1:])
+        return
     p = argparse.ArgumentParser(prog="nanodiloco_tpu report")
     p.add_argument("jsonl", help="metrics JSONL written by training")
     p.add_argument("--json", action="store_true",
@@ -449,6 +487,55 @@ def report_main(argv: list[str]) -> None:
         return
     for k, v in summary.items():
         print(f"{k:>24}: {v}")
+
+
+def report_compare_main(argv: list[str]) -> None:
+    p = argparse.ArgumentParser(prog="nanodiloco_tpu report compare")
+    p.add_argument("baseline",
+                   help="reference run: a metrics .jsonl, a `report "
+                        "--json` dump, or a BASELINE.json with published "
+                        "numbers")
+    p.add_argument("candidate", help="run under test (same formats)")
+    p.add_argument("--max-loss-increase", type=float, default=0.02,
+                   help="relative final/eval/best-loss increase that "
+                        "counts as a regression (default 2%%)")
+    p.add_argument("--max-tps-drop", type=float, default=0.2,
+                   help="relative tokens/sec drop that counts as a "
+                        "regression (default 20%%)")
+    p.add_argument("--max-comm-share-increase", type=float, default=0.05,
+                   help="ABSOLUTE comm-share increase that counts as a "
+                        "regression (default +0.05)")
+    p.add_argument("--json", action="store_true",
+                   help="print the full diff as one JSON object")
+    args = p.parse_args(argv)
+
+    from nanodiloco_tpu.training.metrics import compare_runs, load_comparable
+
+    diff = compare_runs(
+        load_comparable(args.baseline),
+        load_comparable(args.candidate),
+        max_loss_increase=args.max_loss_increase,
+        max_tps_drop=args.max_tps_drop,
+        max_comm_share_increase=args.max_comm_share_increase,
+    )
+    if args.json:
+        print(json.dumps(diff))
+    else:
+        for k, m in diff["metrics"].items():
+            mark = "REGRESSED" if m.get("regressed") else (
+                "ok" if m.get("gated") else "ungated"
+            )
+            print(
+                f"{k:>24}: {m.get('baseline')} -> {m.get('candidate')} "
+                f"[{mark}]"
+            )
+        print(
+            f"{'verdict':>24}: "
+            + ("OK" if diff["ok"]
+               else f"REGRESSION in {', '.join(diff['regressions'])}")
+        )
+    if not diff["ok"]:
+        raise SystemExit(1)
 
 
 def main(argv: list[str] | None = None) -> None:

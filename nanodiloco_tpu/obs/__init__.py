@@ -1,0 +1,22 @@
+"""Host-side observability: span tracer, training watchdog, live status.
+
+The DiLoCo value proposition is a RATIO — compute time over
+communication time (arXiv:2311.08105) — and a production run must be
+able to show where every millisecond of a round goes (``tracer``), be
+alerted when the run silently degrades (``watchdog``), and account for
+every wire byte the outer sync moves (``Diloco.sync_wire_bytes``).
+Everything here is pure host-side Python: no jax imports, no device
+work, safe to run on every step of a training loop.
+"""
+
+from nanodiloco_tpu.obs.tracer import SpanTracer, current_tracer, set_tracer, trace_span
+from nanodiloco_tpu.obs.watchdog import Watchdog, WatchdogConfig
+
+__all__ = [
+    "SpanTracer",
+    "current_tracer",
+    "set_tracer",
+    "trace_span",
+    "Watchdog",
+    "WatchdogConfig",
+]

@@ -943,6 +943,74 @@ class Diloco:
                         "all-reduce (HLO-pinned)",
                 "guaranteed": True, "f32_bytes": f32}
 
+    def sync_wire_bytes(self, snapshot: Any | None = None) -> dict:
+        """Per-worker wire-byte accounting for one outer-sync ROUND —
+        the comm-volume side of the compute/communication ratio that IS
+        DiLoCo's claim (arXiv:2311.08105). ``sync_payload_report`` is
+        the human-readable startup banner; this is the machine-readable
+        per-round ledger the train loop folds into every sync's JSONL
+        record (and ``summarize_run`` totals over the run).
+
+        ``snapshot`` (optional) supplies the ACTUAL synced tree — its
+        leaf shapes capture fit_vocab shrinks, HF imports, anything the
+        config-derived count would miss; without it the model config's
+        parameter count stands in. Streaming inherits this unchanged:
+        every fragment launches exactly once per round, so the
+        whole-tree number IS the per-round total there too (the
+        per-LAUNCH division lives in streaming's sync_payload_report).
+
+        Returns::
+
+            wire_bytes_per_sync   bytes this worker puts on the wire per
+                                  round under the configured mode (HLO-
+                                  pinned only under outer_wire_collective;
+                                  otherwise the reduce's input width —
+                                  XLA's lowering owns the transfer)
+            raw_bytes_per_sync    the f32 reference wire (what the
+                                  torch reference's all_reduce moves)
+            wire_compression      raw / wire (1.0 = no narrowing)
+            wire_overhead_bytes   scale vector + survivor-count scalar
+                                  riding the integer-collective wire
+        """
+        if snapshot is not None:
+            leaves = jax.tree.leaves(snapshot)
+            n = sum(int(np.prod(l.shape)) for l in leaves)
+            n_leaves = len(leaves)
+        else:
+            n = self.model_cfg.num_params()
+            n_leaves = len(
+                jax.tree.leaves(
+                    self._pspec, is_leaf=lambda x: isinstance(x, P)
+                )
+            )
+        raw = 4 * n
+        cfg = self.cfg
+        if cfg.outer_wire_collective:
+            acc = jnp.dtype(
+                _wire_accumulator_dtype(
+                    cfg.num_workers,
+                    float(jnp.iinfo(jnp.dtype(cfg.outer_comm_dtype)).max),
+                )
+            )
+            # one f32 absmax scalar per tensor (the shared-scale pmax)
+            # plus the survivor-count scalar — the only float traffic a
+            # clean integer wire carries (allreduce_wire_report audits
+            # exactly this shape)
+            overhead = 4 * n_leaves + 4
+            wire = acc.itemsize * n + overhead
+        else:
+            # every other mode reduces in f32 (quantize-dequantize
+            # happens before the mean — _wire_quantize's honest-scope
+            # note); the wire number must say so, never flatter itself
+            overhead = 0
+            wire = raw
+        return {
+            "wire_bytes_per_sync": int(wire),
+            "raw_bytes_per_sync": int(raw),
+            "wire_compression": round(raw / wire, 4) if wire else 1.0,
+            "wire_overhead_bytes": int(overhead),
+        }
+
     def _replica_finite_mask(self, params_w: Any) -> jax.Array:
         """[W] bool: worker w's replica contains only finite values.
         The EXACT quarantine criterion — loss finiteness alone has a

@@ -99,7 +99,10 @@ class CheckpointManager:
             self.directory, item_handlers=ocp.PyTreeCheckpointHandler()
         )
         try:
-            meta = mngr.item_metadata(step).tree
+            # newer orbax wraps the metadata tree in an object with a
+            # ``.tree`` attribute; 0.7-era returns the tree itself
+            meta = mngr.item_metadata(step)
+            meta = getattr(meta, "tree", meta)
             sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
 
             def abstract(tree):
@@ -108,15 +111,20 @@ class CheckpointManager:
                     tree,
                 )
 
-            if only is None:
-                item = abstract(meta)
-            else:
+            if only is not None:
                 missing = only - set(meta)
                 if missing:
                     raise KeyError(
                         f"checkpoint has no field(s) {sorted(missing)}; "
                         f"available: {sorted(meta)}"
                     )
+            if only is None or not hasattr(ocp, "PLACEHOLDER"):
+                # legacy orbax has no PLACEHOLDER partial restore:
+                # materialize everything and let the caller take the
+                # fields it wants — correctness preserved, the
+                # skip-the-read memory saving is modern-orbax-only
+                item = abstract(meta)
+            else:
                 item = {
                     k: (abstract(v) if k in only
                         else jax.tree.map(lambda _: ocp.PLACEHOLDER, v))
@@ -139,7 +147,8 @@ class CheckpointManager:
             raise FileNotFoundError(f"no checkpoint found under {self.directory}")
         # the training manager's explicit StandardCheckpointHandler makes
         # item_metadata work without a save in this process (see __init__)
-        meta = self._mngr.item_metadata(step).tree
+        meta = self._mngr.item_metadata(step)
+        meta = getattr(meta, "tree", meta)
         return int(jax.tree.leaves(meta["params"])[0].shape[0])
 
     def restore_elastic(
@@ -199,7 +208,10 @@ class CheckpointManager:
             self.directory, item_handlers=ocp.PyTreeCheckpointHandler()
         )
         try:
-            meta = mngr.item_metadata(step).tree
+            # newer orbax wraps the metadata tree in an object with a
+            # ``.tree`` attribute; 0.7-era returns the tree itself
+            meta = mngr.item_metadata(step)
+            meta = getattr(meta, "tree", meta)
             missing = only - set(meta)
             if missing:
                 kind = "streaming" if is_streaming else "classic"
@@ -221,8 +233,22 @@ class CheckpointManager:
             rargs: dict = {}
             for k, v in meta.items():
                 if k not in only:
-                    item[k] = jax.tree.map(lambda _: ocp.PLACEHOLDER, v)
-                    rargs[k] = jax.tree.map(lambda _: ocp.RestoreArgs(), v)
+                    if hasattr(ocp, "PLACEHOLDER"):
+                        item[k] = jax.tree.map(lambda _: ocp.PLACEHOLDER, v)
+                        rargs[k] = jax.tree.map(lambda _: ocp.RestoreArgs(), v)
+                    else:
+                        # legacy orbax: no skip-the-read — restore onto
+                        # one device and discard (modern orbax keeps the
+                        # memory saving)
+                        sd = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+                        item[k] = jax.tree.map(
+                            lambda m: jax.ShapeDtypeStruct(
+                                m.shape, m.dtype, sharding=sd
+                            ), v,
+                        )
+                        rargs[k] = jax.tree.map(
+                            lambda m: ocp.ArrayRestoreArgs(sharding=sd), v
+                        )
                     continue
                 meta_paths, treedef = jax.tree_util.tree_flatten_with_path(v)
                 tgt_map = _path_leaf_map(fresh_map[k])

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any
 
@@ -66,6 +67,11 @@ class MetricsLogger:
     ) -> None:
         self.run_name = run_name
         self.quiet = quiet
+        # the watchdog's heartbeat thread emits alarm records through
+        # log() concurrently with the train loop's metrics — one lock
+        # keeps JSONL lines whole (a torn line is exactly the corruption
+        # summarize_run has to paper over)
+        self._lock = threading.Lock()
         if process_index is None:
             import jax
 
@@ -101,11 +107,12 @@ class MetricsLogger:
         rec = dict(metrics)
         if step is not None:
             rec["step"] = step
-        if self._file:
-            self._file.write(json.dumps(rec) + "\n")
-            self._file.flush()
-        if self._wandb:
-            self._wandb.log(rec)
+        with self._lock:
+            if self._file:
+                self._file.write(json.dumps(rec) + "\n")
+                self._file.flush()
+            if self._wandb:
+                self._wandb.log(rec)
         if not self.quiet:
             parts = " ".join(
                 f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
@@ -114,11 +121,12 @@ class MetricsLogger:
             print(f"[{self.run_name}] {parts}", flush=True)
 
     def finish(self) -> None:
-        if self._file:
-            self._file.close()
-            self._file = None
-        if self._wandb:
-            self._wandb.finish()
+        with self._lock:
+            if self._file:
+                self._file.close()
+                self._file = None
+            if self._wandb:
+                self._wandb.finish()
 
 
 def summarize_run(path: str) -> dict[str, Any]:
@@ -183,4 +191,107 @@ def summarize_run(path: str) -> dict[str, Any]:
     if ent:
         out["moe_router_entropy_last"] = round(ent[-1], 4)
         out["moe_router_entropy_min"] = round(min(ent), 4)
+    # observability stack (PR: obs/): alarms, wire bytes, phase budget
+    alarms = [r for r in recs if r.get("alarm")]
+    if alarms:
+        out["alarms"] = len(alarms)
+        kinds: dict[str, int] = {}
+        for a in alarms:
+            kinds[a["alarm"]] = kinds.get(a["alarm"], 0) + 1
+        out["alarm_kinds"] = kinds
+    wire = series("wire_bytes_per_sync")
+    if wire:
+        totals = series("wire_bytes_total")
+        out["wire_bytes_total"] = int(totals[-1]) if totals else int(sum(wire))
+        comp = series("wire_compression")
+        if comp:
+            out["wire_compression"] = comp[-1]
+    phase_keys = sorted(
+        {k for r in recs for k in r if k.startswith("t_") and r[k] is not None}
+    )
+    for k in phase_keys:
+        vals = series(k)
+        if vals:
+            out[f"{k}_mean_s"] = round(sum(vals) / len(vals), 4)
     return out
+
+
+# regression-gate metric directions: (summary key, lower_is_better)
+_COMPARE_METRICS = [
+    ("final_loss", True),
+    ("final_eval_loss", True),
+    ("best_loss", True),
+    ("tokens_per_sec_last", False),
+    ("comm_share_last", True),
+]
+
+
+def load_comparable(path: str) -> dict[str, Any]:
+    """A summary dict for ``compare_runs`` from either a run JSONL or a
+    plain-JSON summary/baseline file. A ``.json`` file may be a
+    ``report --json`` dump or a BASELINE.json whose numbers live under
+    ``"published"``; anything without at least one comparable metric is
+    rejected loudly (a silently-empty baseline would gate nothing)."""
+    if path.endswith(".jsonl"):
+        return summarize_run(path)
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc.get("published"), dict) and doc["published"]:
+        doc = doc["published"]
+    if not any(k in doc for k, _ in _COMPARE_METRICS):
+        raise ValueError(
+            f"{path} has none of the comparable metrics "
+            f"({', '.join(k for k, _ in _COMPARE_METRICS)}); pass a run "
+            ".jsonl or a summary JSON"
+        )
+    return doc
+
+
+def compare_runs(
+    baseline: dict[str, Any],
+    candidate: dict[str, Any],
+    max_loss_increase: float = 0.02,
+    max_tps_drop: float = 0.2,
+    max_comm_share_increase: float = 0.05,
+) -> dict[str, Any]:
+    """Diff two run summaries and flag regressions — the gate that turns
+    a bench trajectory into an enforced contract (``report compare``
+    exits non-zero when ``regressions`` is non-empty).
+
+    Thresholds: losses regress when they INCREASE by more than
+    ``max_loss_increase`` relative; throughput regresses when it DROPS
+    by more than ``max_tps_drop`` relative; comm share regresses when
+    it increases by more than ``max_comm_share_increase`` ABSOLUTE
+    (shares are already ratios). Metrics present in only one summary
+    are reported but never gate — a baseline without eval numbers must
+    not fail every candidate that has them."""
+    metrics: dict[str, Any] = {}
+    regressions: list[str] = []
+    for key, lower_better in _COMPARE_METRICS:
+        b, c = baseline.get(key), candidate.get(key)
+        if b is None or c is None:
+            if b is not None or c is not None:
+                metrics[key] = {"baseline": b, "candidate": c, "gated": False}
+            continue
+        b, c = float(b), float(c)
+        delta = c - b
+        if key == "comm_share_last":
+            regressed = delta > max_comm_share_increase
+        elif lower_better:
+            regressed = delta > max_loss_increase * max(abs(b), 1e-12)
+        else:
+            regressed = -delta > max_tps_drop * max(abs(b), 1e-12)
+        metrics[key] = {
+            "baseline": b,
+            "candidate": c,
+            "delta": round(delta, 6),
+            "gated": True,
+            "regressed": regressed,
+        }
+        if regressed:
+            regressions.append(key)
+    return {
+        "metrics": metrics,
+        "regressions": regressions,
+        "ok": not regressions,
+    }

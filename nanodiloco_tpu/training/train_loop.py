@@ -34,6 +34,7 @@ import numpy as np
 
 from nanodiloco_tpu.data import DilocoBatcher, get_tokenizer, pack_corpus, synthetic_corpus
 from nanodiloco_tpu.models.config import LlamaConfig
+from nanodiloco_tpu.obs import SpanTracer, Watchdog, WatchdogConfig, set_tracer, trace_span
 from nanodiloco_tpu.parallel.diloco import Diloco, DilocoConfig
 from nanodiloco_tpu.parallel.mesh import MeshConfig, build_mesh
 from nanodiloco_tpu.training.metrics import MetricsLogger, SyncTimer
@@ -129,6 +130,22 @@ class TrainConfig:
     # jax.profiler trace target: one whole warm round (fused mode) or a
     # few steady-state steps (stepwise mode)
     profile_dir: str | None = None
+    # --- observability (obs/) ---
+    # Chrome trace-event JSON of host-side round phases (data/inner/
+    # sync/eval/ckpt...) — open in Perfetto, no jax.profiler needed
+    trace_out: str | None = None
+    # live status.json (atomic rewrite) for external pollers: state,
+    # step, last loss/throughput, alarm count
+    status_file: str | None = None
+    # watchdog sentinel thresholds (obs/watchdog.py): loss-spike
+    # z-score over a rolling window, throughput collapse vs the rolling
+    # median, stalled-round factor over the rolling round time
+    # (0 disables the heartbeat thread); alarms land in the JSONL as
+    # {"alarm": kind, ...} records
+    watch_loss_zscore: float = 6.0
+    watch_loss_window: int = 32
+    watch_tps_collapse: float = 0.4
+    watch_stall_factor: float = 5.0
     checkpoint_dir: str | None = None
     checkpoint_every: int = 1        # in outer syncs
     resume: bool = True
@@ -481,381 +498,533 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     )
     sync_timer = SyncTimer()
 
-    evaluator = None
-    if cfg.eval_every:
-        from nanodiloco_tpu.training.evaluate import Evaluator, holdout_batches
-
-        evaluator = Evaluator(model_cfg, mesh, quiet=quiet)
-        eval_set = holdout_batches(
-            eval_rows, cfg.per_device_batch_size, mask_rows=eval_mask_rows
-        )
-
-    # MoE observability: once per outer sync, probe the snapshot's router
-    # on one microbatch — dropped-token fraction + router entropy land in
-    # the JSONL, so capacity-bound dropping / router collapse can't stay
-    # silent (a collapsed router otherwise looks perfectly healthy in the
-    # loss for a long time)
-    moe_stats_fn = None
-    if model_cfg.num_experts:
-        from nanodiloco_tpu.models.moe import make_router_stats_fn
-
-        moe_stats_fn = make_router_stats_fn(model_cfg)
-
-    _moe_probe_err: list = []
-
-    def moe_probe(snapshot, tok_bs) -> dict:
-        if moe_stats_fn is None or _moe_probe_err:
-            return {}
-        try:
-            stats = moe_stats_fn(snapshot, jnp.asarray(tok_bs))
-            return {k: float(v) for k, v in stats.items()}
-        except Exception as e:  # exotic sharding the probe can't place
-            _moe_probe_err.append(e)
-            if not quiet:
-                print(f"[nanodiloco] MoE router-stats probe disabled: {e}")
-            return {}
-
-    start_step = int(state.inner_step_count)
-    # actual row width (padded layout rounds to a multiple of 8 and can
-    # be shorter than --seq-length; tshrd shards fix their own length)
-    row_len = (
-        batcher.seq_len if hasattr(batcher, "seq_len") else batcher.data.shape[1]
+    # --- observability: span tracer + watchdog (nanodiloco_tpu/obs) ---------
+    # The tracer records host-side round phases unconditionally (two
+    # perf_counter calls per span); Chrome-trace export happens only
+    # when --trace-out asked for it. The watchdog's sentinels run
+    # in-loop; its heartbeat thread catches stalls the loop itself
+    # cannot report. Alarms go through logger.log, i.e. into the SAME
+    # JSONL as the metrics (and stdout/wandb), rank-0-gated by the
+    # logger itself.
+    # without --trace-out nothing will ever export the event list, so
+    # don't retain it (max_events=0 drops each event on close); the
+    # per-phase t_* totals are accumulated separately and still flow
+    # into the JSONL either way
+    tracer = SpanTracer(max_events=500_000 if cfg.trace_out else 0)
+    prev_tracer = set_tracer(tracer)
+    watchdog = Watchdog(
+        WatchdogConfig(
+            loss_zscore=cfg.watch_loss_zscore,
+            loss_window=cfg.watch_loss_window,
+            tps_collapse_frac=cfg.watch_tps_collapse,
+            stall_factor=cfg.watch_stall_factor,
+        ),
+        emit=lambda rec: logger.log(rec),
+        status_path=cfg.status_file if logger.is_writer else None,
     )
-    tokens_per_step = (
-        cfg.num_workers * cfg.grad_accum * cfg.per_device_batch_size * row_len
-    )
-    # deterministic O(1) resume positioning (no replayed gathers)
-    batches = batcher.iter_from(start_step)
+    watchdog.start()
+    # per-sync wire ledger from the ACTUAL synced tree (fit_vocab
+    # shrinks included); per WORKER — a single-worker run's "wire"
+    # never leaves the chip, the numbers then describe the sync's
+    # tensor volume
+    wire_rec = dl.sync_wire_bytes(state.snapshot)
+    wire_metrics = {
+        "wire_bytes_per_sync": wire_rec["wire_bytes_per_sync"],
+        "wire_compression": wire_rec["wire_compression"],
+    }
+    wire_bytes_total = 0
 
-    compute_time = 0.0
-    last_loss = float("nan")
-    # jax.profiler tracing (the subsystem the reference stubbed but never
-    # built, SURVEY §5 "Tracing / profiling"): fused runs trace ONE warm
-    # round (see the fused loop); stepwise runs trace a few steady-state
-    # steps via the window below, clamped so a resume close to
-    # total_steps still produces a trace.
-    profile_start = min(start_step + 3, cfg.total_steps)
-    profile_stop = min(profile_start + 3, cfg.total_steps)
-    profiling = False
-    last_eval_step = None
+    completed = False
+    try:
+        evaluator = None
+        if cfg.eval_every:
+            from nanodiloco_tpu.training.evaluate import Evaluator, holdout_batches
 
-    fused = (
-        cfg.fused_rounds
-        and start_step % cfg.inner_steps == 0  # mid-round resume -> stepwise
-    )
-    if cfg.fused_rounds and not fused and not quiet:
-        print(
-            "[nanodiloco] fused rounds disabled: resume at step "
-            f"{start_step} is mid-round"
-        )
-    # fused-mode comm estimate (the sync is compiled into the round
-    # program, so its cost is measured by differencing against an
-    # inner-only round — not reported as a fake 0.0)
-    est_inner_s: float | None = None
-    best_full_s: float | None = None
-    fused_sync_metrics: dict[str, float] = {}
-    if fused:
-        # explicit nulls until (unless) the differenced estimate lands —
-        # a stable JSONL schema, and never a fake 0.0 (the sync cost is
-        # fused into the round program, not zero)
-        fused_sync_metrics = {"avg_sync_time_s": None, "comm_share": None}
-        first_round = start_step // cfg.inner_steps + 1
-        last_round = cfg.total_steps // cfg.inner_steps
-        # Host-side round assembly (draw H batches, stack, device_put)
-        # runs one round AHEAD on a background thread, overlapping the
-        # device's current round (numpy stacking releases the GIL; the
-        # generator is only ever touched by this single worker thread,
-        # sequentially). The pipeline deliberately PAUSES around the
-        # one-time comm measurement: no prefetch may be in flight while
-        # the differenced probes run, or host/DMA contention biases the
-        # estimate (and the probe's 2x-state window would also hold an
-        # extra round of batches in HBM).
-        from concurrent.futures import ThreadPoolExecutor
+            evaluator = Evaluator(model_cfg, mesh, quiet=quiet)
+            eval_set = holdout_batches(
+                eval_rows, cfg.per_device_batch_size, mask_rows=eval_mask_rows
+            )
 
-        prefetcher = ThreadPoolExecutor(max_workers=1)
-        pending = (
-            prefetcher.submit(dl.stack_round_batches, batches)
-            if first_round <= last_round
-            else None
+        # MoE observability: once per outer sync, probe the snapshot's router
+        # on one microbatch — dropped-token fraction + router entropy land in
+        # the JSONL, so capacity-bound dropping / router collapse can't stay
+        # silent (a collapsed router otherwise looks perfectly healthy in the
+        # loss for a long time)
+        moe_stats_fn = None
+        if model_cfg.num_experts:
+            from nanodiloco_tpu.models.moe import make_router_stats_fn
+
+            moe_stats_fn = make_router_stats_fn(model_cfg)
+
+        _moe_probe_err: list = []
+
+        def moe_probe(snapshot, tok_bs) -> dict:
+            if moe_stats_fn is None or _moe_probe_err:
+                return {}
+            try:
+                stats = moe_stats_fn(snapshot, jnp.asarray(tok_bs))
+                return {k: float(v) for k, v in stats.items()}
+            except Exception as e:  # exotic sharding the probe can't place
+                _moe_probe_err.append(e)
+                if not quiet:
+                    print(f"[nanodiloco] MoE router-stats probe disabled: {e}")
+                return {}
+
+        start_step = int(state.inner_step_count)
+        # actual row width (padded layout rounds to a multiple of 8 and can
+        # be shorter than --seq-length; tshrd shards fix their own length)
+        row_len = (
+            batcher.seq_len if hasattr(batcher, "seq_len") else batcher.data.shape[1]
         )
-        # trace ONE warm fused round — the real training cadence (H inner
-        # steps + the outer sync in a single program), which a per-step
-        # stepwise trace cannot show. The second round where possible so
-        # compile and the comm-measurement pause stay out of the capture.
-        profile_round = (
-            min(first_round + 1, last_round) if cfg.profile_dir else None
+        tokens_per_step = (
+            cfg.num_workers * cfg.grad_accum * cfg.per_device_batch_size * row_len
         )
-        try:
-            for rnd in range(first_round, last_round + 1):
-                toks, masks = pending.result()
-                pending = None
-                measuring = cfg.measure_comm and est_inner_s is None
-                if rnd < last_round and not measuring:
-                    pending = prefetcher.submit(dl.stack_round_batches, batches)
-                tracing = rnd == profile_round
-                if tracing:
-                    jax.profiler.start_trace(cfg.profile_dir)
-                try:
-                    t0 = time.perf_counter()
-                    state, losses, eff_mask = dl.round_step(state, toks, masks)
-                    jax.block_until_ready(losses)
-                    round_s = time.perf_counter() - t0
-                finally:
-                    # a failing traced round must still flush/stop the
-                    # global profiler or every later train() hits
-                    # "profiling is already in progress"
+        # deterministic O(1) resume positioning (no replayed gathers)
+        batches = batcher.iter_from(start_step)
+
+        compute_time = 0.0
+        last_loss = float("nan")
+        # jax.profiler tracing (the subsystem the reference stubbed but never
+        # built, SURVEY §5 "Tracing / profiling"): fused runs trace ONE warm
+        # round (see the fused loop); stepwise runs trace a few steady-state
+        # steps via the window below, clamped so a resume close to
+        # total_steps still produces a trace.
+        profile_start = min(start_step + 3, cfg.total_steps)
+        profile_stop = min(profile_start + 3, cfg.total_steps)
+        profiling = False
+        last_eval_step = None
+
+        fused = (
+            cfg.fused_rounds
+            and start_step % cfg.inner_steps == 0  # mid-round resume -> stepwise
+        )
+        if cfg.fused_rounds and not fused and not quiet:
+            print(
+                "[nanodiloco] fused rounds disabled: resume at step "
+                f"{start_step} is mid-round"
+            )
+        # fused-mode comm estimate (the sync is compiled into the round
+        # program, so its cost is measured by differencing against an
+        # inner-only round — not reported as a fake 0.0)
+        est_inner_s: float | None = None
+        best_full_s: float | None = None
+        fused_sync_metrics: dict[str, float] = {}
+        if fused:
+            # explicit nulls until (unless) the differenced estimate lands —
+            # a stable JSONL schema, and never a fake 0.0 (the sync cost is
+            # fused into the round program, not zero)
+            fused_sync_metrics = {"avg_sync_time_s": None, "comm_share": None}
+            first_round = start_step // cfg.inner_steps + 1
+            last_round = cfg.total_steps // cfg.inner_steps
+            # Host-side round assembly (draw H batches, stack, device_put)
+            # runs one round AHEAD on a background thread, overlapping the
+            # device's current round (numpy stacking releases the GIL; the
+            # generator is only ever touched by this single worker thread,
+            # sequentially). The pipeline deliberately PAUSES around the
+            # one-time comm measurement: no prefetch may be in flight while
+            # the differenced probes run, or host/DMA contention biases the
+            # estimate (and the probe's 2x-state window would also hold an
+            # extra round of batches in HBM).
+            from concurrent.futures import ThreadPoolExecutor
+
+            prefetcher = ThreadPoolExecutor(max_workers=1)
+            pending = (
+                prefetcher.submit(dl.stack_round_batches, batches)
+                if first_round <= last_round
+                else None
+            )
+            # trace ONE warm fused round — the real training cadence (H inner
+            # steps + the outer sync in a single program), which a per-step
+            # stepwise trace cannot show. The second round where possible so
+            # compile and the comm-measurement pause stay out of the capture.
+            profile_round = (
+                min(first_round + 1, last_round) if cfg.profile_dir else None
+            )
+            try:
+                for rnd in range(first_round, last_round + 1):
+                    with trace_span("data"):
+                        toks, masks = pending.result()
+                    pending = None
+                    measuring = cfg.measure_comm and est_inner_s is None
+                    if rnd < last_round and not measuring:
+                        pending = prefetcher.submit(dl.stack_round_batches, batches)
+                    tracing = rnd == profile_round
                     if tracing:
-                        jax.profiler.stop_trace()
-                compute_time += round_s
-                state = dl._offload(state)
-                if cfg.measure_comm:
-                    # Differenced estimate: warm full round minus warm
-                    # inner-only round (neither side carries compile time).
-                    # The inner-only side costs two throwaway rounds on state
-                    # copies (compile + timed; one copy alive at a time —
-                    # transient 2x state HBM). The full-round side is the
-                    # running MIN of warm rounds' own wall clocks (converges
-                    # as noise/recompiles wash out); only a single-round run
-                    # pays one extra probe round for it.
-                    if est_inner_s is None:
-                        est_inner_s = dl.measure_inner_round_time(
-                            state, toks, masks, repeats=1
-                        )
-                        if rnd == last_round:  # no warm round 2 will come
-                            probe = jax.tree.map(jnp.copy, state)
+                        jax.profiler.start_trace(cfg.profile_dir)
+                    try:
+                        # the fused round program contains the outer sync —
+                        # this span is inner compute + sync as ONE phase;
+                        # the JSONL's t_inner/t_sync split comes from the
+                        # differenced measure_comm estimate below
+                        with trace_span("inner", round=rnd):
                             t0 = time.perf_counter()
-                            probe, probe_loss, _ = dl.round_step(probe, toks, masks)
-                            jax.block_until_ready(probe_loss)
-                            best_full_s = time.perf_counter() - t0
-                            del probe
-                    elif not tracing:
-                        # the traced round's wall clock carries profiler
-                        # collection overhead — feeding it into the min
-                        # would overstate sync cost on short runs whose
-                        # only warm round is the traced one
-                        best_full_s = min(best_full_s or round_s, round_s)
-                    if best_full_s is not None:
-                        sync_s = max(0.0, best_full_s - est_inner_s)
-                        fused_sync_metrics = {
-                            "avg_sync_time_s": sync_s,
-                            "comm_share": sync_s / best_full_s,
+                            state, losses, eff_mask = dl.round_step(state, toks, masks)
+                            jax.block_until_ready(losses)
+                            round_s = time.perf_counter() - t0
+                    finally:
+                        # a failing traced round must still flush/stop the
+                        # global profiler or every later train() hits
+                        # "profiling is already in progress"
+                        if tracing:
+                            jax.profiler.stop_trace()
+                    compute_time += round_s
+                    state = dl._offload(state)
+                    if cfg.measure_comm:
+                        # Differenced estimate: warm full round minus warm
+                        # inner-only round (neither side carries compile time).
+                        # The inner-only side costs two throwaway rounds on state
+                        # copies (compile + timed; one copy alive at a time —
+                        # transient 2x state HBM). The full-round side is the
+                        # running MIN of warm rounds' own wall clocks (converges
+                        # as noise/recompiles wash out); only a single-round run
+                        # pays one extra probe round for it.
+                        if est_inner_s is None:
+                            with trace_span("comm_probe"):
+                                est_inner_s = dl.measure_inner_round_time(
+                                    state, toks, masks, repeats=1
+                                )
+                                if rnd == last_round:  # no warm round 2 will come
+                                    probe = jax.tree.map(jnp.copy, state)
+                                    t0 = time.perf_counter()
+                                    probe, probe_loss, _ = dl.round_step(probe, toks, masks)
+                                    jax.block_until_ready(probe_loss)
+                                    best_full_s = time.perf_counter() - t0
+                                    del probe
+                        elif not tracing:
+                            # the traced round's wall clock carries profiler
+                            # collection overhead — feeding it into the min
+                            # would overstate sync cost on short runs whose
+                            # only warm round is the traced one
+                            best_full_s = min(best_full_s or round_s, round_s)
+                        if best_full_s is not None:
+                            sync_s = max(0.0, best_full_s - est_inner_s)
+                            fused_sync_metrics = {
+                                "avg_sync_time_s": sync_s,
+                                "comm_share": sync_s / best_full_s,
+                            }
+                    if pending is None and rnd < last_round:
+                        # resume the pipeline after the measurement pause
+                        pending = prefetcher.submit(dl.stack_round_batches, batches)
+                    real_step = rnd * cfg.inner_steps
+                    if ckpt and rnd % cfg.checkpoint_every == 0:
+                        with trace_span("ckpt"):
+                            ckpt.save(real_step, state)
+                    eval_metrics = {}
+                    # fetch the snapshot only when a consumer actually runs
+                    # THIS round (the MoE probe runs every round; eval only
+                    # on its cadence) — an ungated fetch pays a full-model
+                    # H2D per round under offload_snapshot and parks a
+                    # device copy in exactly the HBM offload exists to free
+                    # (ADVICE r5 medium)
+                    eval_due = evaluator is not None and rnd % cfg.eval_every == 0
+                    if eval_due or moe_stats_fn is not None:
+                        # _fetch ONCE for both consumers: an offloaded
+                        # snapshot lives in pinned_host and the eval/probe
+                        # forwards need device-resident weights — two
+                        # independent fetches would pay the H2D transfer
+                        # twice per eval round
+                        with trace_span("eval"):
+                            snap_dev = dl._fetch(state).snapshot
+                            if eval_due:
+                                eval_metrics = evaluator(snap_dev, eval_set)
+                                last_eval_step, last_eval = real_step, eval_metrics
+                            if moe_stats_fn is not None:
+                                # new dict (not .update): eval_metrics may be
+                                # aliased by last_eval / the returned summary,
+                                # and the token index would dispatch a throwaway
+                                # gather on dense runs
+                                eval_metrics = {
+                                    **eval_metrics,
+                                    **moe_probe(snap_dev, toks[-1, 0, 0]),
+                                }
+                            # no device-resident snapshot copy may survive
+                            # into the next round's dispatch
+                            del snap_dev
+                    # per-sync HBM occupancy (empty dict on backends without
+                    # memory_stats, e.g. CPU — keys appear only when real)
+                    eval_metrics = {**eval_metrics, **device_memory_stats()}
+                    # reduce the worker axis ON DEVICE first: losses is [H, W]
+                    # sharded over `diloco`, which spans other processes on a
+                    # pod — np.asarray of the raw array would raise on
+                    # non-addressable shards (caught by test_multihost.py);
+                    # the mean's output is replicated, so every host can
+                    # fetch it
+                    quarantine_metrics = {}
+                    if cfg.quarantine_nonfinite:
+                        # a quarantined worker's NaN must not flow into the
+                        # logged loss (an operator would kill a run the
+                        # feature just saved) — masked mean + an explicit
+                        # event count from the round's EFFECTIVE sync mask
+                        # (loss finiteness AND replica-params finiteness —
+                        # a blow-up on the round's final inner update is
+                        # quarantined by _outer_step and must be counted;
+                        # the loss-only recount here missed it, round-4
+                        # advisor finding). eff_mask is [W] diloco-sharded;
+                        # reduce on device before the host fetch.
+                        losses_h = np.asarray(_finite_worker_mean(losses))
+                        quarantine_metrics = {
+                            "quarantined_workers": int(
+                                cfg.num_workers - eff_mask.sum()
+                            )
                         }
-                if pending is None and rnd < last_round:
-                    # resume the pipeline after the measurement pause
-                    pending = prefetcher.submit(dl.stack_round_batches, batches)
-                real_step = rnd * cfg.inner_steps
-                if ckpt and rnd % cfg.checkpoint_every == 0:
-                    ckpt.save(real_step, state)
-                eval_metrics = {}
-                if evaluator is not None or moe_stats_fn is not None:
-                    # _fetch ONCE for both consumers: an offloaded
-                    # snapshot lives in pinned_host and the eval/probe
-                    # forwards need device-resident weights — two
-                    # independent fetches would pay the H2D transfer
-                    # twice per eval round
+                    else:
+                        losses_h = np.asarray(jnp.mean(losses, axis=1))  # [H]
+                    # round phase budget: depth-0 span totals since the last
+                    # round (tracer resets). The fused program contains the
+                    # sync, so t_inner/t_sync split on the differenced
+                    # estimate once it lands — never a fake zero split.
+                    phases = tracer.phase_totals()
+                    round_budget = {
+                        f"t_{k}": round(v, 6) for k, v in phases.items()
+                    }
+                    sync_est = fused_sync_metrics.get("avg_sync_time_s")
+                    if sync_est is not None and "t_inner" in round_budget:
+                        round_budget["t_sync"] = round(sync_est, 6)
+                        round_budget["t_inner"] = round(
+                            max(0.0, round_budget["t_inner"] - sync_est), 6
+                        )
+                    wire_bytes_total += wire_rec["wire_bytes_per_sync"]
+                    tps = (real_step - start_step) * tokens_per_step / compute_time
+                    with trace_span("log"):
+                        for i in range(cfg.inner_steps):
+                            step = real_step - cfg.inner_steps + 1 + i
+                            step_loss = float(losses_h[i])
+                            watchdog.observe_loss(step, step_loss)
+                            logger.log(
+                                {
+                                    **(eval_metrics if i == cfg.inner_steps - 1 else {}),
+                                    "loss": step_loss,
+                                    "perplexity": float(np.exp(min(step_loss, 50.0))),
+                                    "lr": float(schedule(step - 1)),
+                                    "effective_step": step * cfg.num_workers,
+                                    "total_samples": step * cfg.batch_size * cfg.num_workers,
+                                    "tokens_per_sec": tps,
+                                    "outer_synced": int(i == cfg.inner_steps - 1),
+                                    **(
+                                        quarantine_metrics
+                                        if i == cfg.inner_steps - 1 else {}
+                                    ),
+                                    **fused_sync_metrics,
+                                    **round_budget,
+                                    **(
+                                        {**wire_metrics,
+                                         "wire_bytes_total": wire_bytes_total}
+                                        if i == cfg.inner_steps - 1 else {}
+                                    ),
+                                },
+                                step=step,
+                            )
+                    # the collapse sentinel needs PER-ROUND throughput: the
+                    # cumulative tps above dilutes a mid-run collapse into
+                    # invisibility (100 rounds at 10% speed barely move a
+                    # 5000-round average)
+                    watchdog.observe_throughput(
+                        real_step,
+                        cfg.inner_steps * tokens_per_step / max(round_s, 1e-9),
+                    )
+                    watchdog.heartbeat(
+                        real_step,
+                        loss=float(losses_h[-1]),
+                        tokens_per_sec=round(tps, 1),
+                    )
+                    last_loss = float(losses_h[-1])
+            finally:
+                if pending is not None:
+                    pending.cancel()
+                prefetcher.shutdown(wait=False)
+
+        round_ok = None  # per-round device-side [W] finiteness (quarantine)
+        quarantined_last_round = 0
+        round_t0 = time.perf_counter()  # sync-to-sync wall-clock (watchdog)
+        for real_step in ([] if fused else range(start_step + 1, cfg.total_steps + 1)):
+            if cfg.profile_dir and real_step == profile_start:
+                jax.profiler.start_trace(cfg.profile_dir)
+                profiling = True
+            with trace_span("data"):
+                tokens, mask = next(batches)
+            t0 = time.perf_counter()
+            if streaming:
+                # fragment launches/applies are fused into the jitted step and
+                # overlap the inner compute — there is no separate sync phase
+                # to time (that's the point, arXiv:2501.18512).
+                with trace_span("inner"):
+                    state, loss = dl.step(
+                        state, dl.feed(tokens), dl.feed(mask), real_step
+                    )
+                    synced = real_step % cfg.inner_steps == 0
+                    jax.block_until_ready(loss)
+                    compute_time += time.perf_counter() - t0
+                if synced:
+                    state = dl._offload(state)
+                    if ckpt and (
+                        real_step // cfg.inner_steps
+                    ) % cfg.checkpoint_every == 0:
+                        with trace_span("ckpt"):
+                            ckpt.save(real_step, state)
+            else:
+                with trace_span("inner"):
+                    state, loss = dl.inner_step(state, dl.feed(tokens), dl.feed(mask))
+                    if cfg.quarantine_nonfinite:
+                        # accumulate ON DEVICE ([W] stays diloco-sharded; a
+                        # host fetch of the raw loss would fail on a pod) —
+                        # one & per step, consumed by the sync below
+                        round_ok = (
+                            jnp.isfinite(loss) if round_ok is None
+                            else round_ok & jnp.isfinite(loss)
+                        )
+                    synced = real_step % cfg.inner_steps == 0
+                    # sync steps fence on the updated params (the sync
+                    # consumes them); plain steps fence on the loss
+                    jax.block_until_ready(state.params if synced else loss)
+                    compute_time += time.perf_counter() - t0
+                if synced:
+                    if cfg.quarantine_nonfinite:
+                        # EXACT count for the log: same criterion the
+                        # sync applies (loss finiteness AND replica-
+                        # params finiteness — params are still pre-reset
+                        # here, so the check is host-drivable; round-4
+                        # advisor finding on the loss-only recount).
+                        # OUTSIDE the sync timer: this duplicate finiteness
+                        # scan is logging work, and charging it to sync_s
+                        # would inflate the measured comm share (round-5
+                        # review finding)
+                        eff = round_ok & dl._replica_finite_mask(
+                            state.params
+                        )
+                        quarantined_last_round = int(
+                            cfg.num_workers - eff.sum()
+                        )
+                    with trace_span("sync"), sync_timer:
+                        state = dl.outer_step(state, round_ok)
+                        round_ok = None
+                        jax.block_until_ready(state.params)
+                    state = dl._offload(state)
+                    if ckpt and (real_step // cfg.inner_steps) % cfg.checkpoint_every == 0:
+                        with trace_span("ckpt"):
+                            ckpt.save(real_step, state)
+
+            if profiling and real_step >= profile_stop:
+                jax.profiler.stop_trace()
+                profiling = False
+
+            eval_metrics = {}
+            eval_due = (
+                evaluator is not None
+                and synced
+                and (real_step // cfg.inner_steps) % cfg.eval_every == 0
+            )
+            if eval_due or (synced and moe_stats_fn is not None):
+                # one fetch for both consumers (offloaded snapshots pay one
+                # H2D transfer, not two), gated on a consumer actually
+                # running THIS round (ADVICE r5 medium) and dropped after so
+                # no device snapshot copy survives into the next dispatch
+                with trace_span("eval"):
                     snap_dev = dl._fetch(state).snapshot
-                if evaluator is not None and rnd % cfg.eval_every == 0:
-                    eval_metrics = evaluator(snap_dev, eval_set)
-                    last_eval_step, last_eval = real_step, eval_metrics
-                if moe_stats_fn is not None:
-                    # new dict (not .update): eval_metrics may be aliased
-                    # by last_eval / the returned summary, and the token
-                    # index would dispatch a throwaway gather on dense runs
+                    if eval_due:
+                        eval_metrics = evaluator(snap_dev, eval_set)
+                        last_eval_step = real_step
+                        last_eval = eval_metrics
+                    if moe_stats_fn is not None:
+                        eval_metrics = {
+                            **eval_metrics,
+                            **moe_probe(snap_dev, tokens[0, 0]),
+                        }
+                    del snap_dev
+            if synced:
+                eval_metrics = {**eval_metrics, **device_memory_stats()}
+
+            if cfg.quarantine_nonfinite:
+                # same masked-mean treatment as the fused path: a healed
+                # worker's NaN step loss must not poison the logged metric
+                last_loss = float(_finite_worker_mean(loss))
+                if synced:
                     eval_metrics = {
                         **eval_metrics,
-                        **moe_probe(snap_dev, toks[-1, 0, 0]),
+                        "quarantined_workers": quarantined_last_round,
                     }
-                # per-sync HBM occupancy (empty dict on backends without
-                # memory_stats, e.g. CPU — keys appear only when real)
-                eval_metrics = {**eval_metrics, **device_memory_stats()}
-                # reduce the worker axis ON DEVICE first: losses is [H, W]
-                # sharded over `diloco`, which spans other processes on a
-                # pod — np.asarray of the raw array would raise on
-                # non-addressable shards (caught by test_multihost.py);
-                # the mean's output is replicated, so every host can
-                # fetch it
-                quarantine_metrics = {}
-                if cfg.quarantine_nonfinite:
-                    # a quarantined worker's NaN must not flow into the
-                    # logged loss (an operator would kill a run the
-                    # feature just saved) — masked mean + an explicit
-                    # event count from the round's EFFECTIVE sync mask
-                    # (loss finiteness AND replica-params finiteness —
-                    # a blow-up on the round's final inner update is
-                    # quarantined by _outer_step and must be counted;
-                    # the loss-only recount here missed it, round-4
-                    # advisor finding). eff_mask is [W] diloco-sharded;
-                    # reduce on device before the host fetch.
-                    losses_h = np.asarray(_finite_worker_mean(losses))
-                    quarantine_metrics = {
-                        "quarantined_workers": int(
-                            cfg.num_workers - eff_mask.sum()
-                        )
-                    }
-                else:
-                    losses_h = np.asarray(jnp.mean(losses, axis=1))  # [H]
-                for i in range(cfg.inner_steps):
-                    step = real_step - cfg.inner_steps + 1 + i
-                    step_loss = float(losses_h[i])
-                    logger.log(
-                        {
-                            **(eval_metrics if i == cfg.inner_steps - 1 else {}),
-                            "loss": step_loss,
-                            "perplexity": float(np.exp(min(step_loss, 50.0))),
-                            "lr": float(schedule(step - 1)),
-                            "effective_step": step * cfg.num_workers,
-                            "total_samples": step * cfg.batch_size * cfg.num_workers,
-                            "tokens_per_sec": (real_step - start_step) * tokens_per_step
-                            / compute_time,
-                            "outer_synced": int(i == cfg.inner_steps - 1),
-                            **(
-                                quarantine_metrics
-                                if i == cfg.inner_steps - 1 else {}
-                            ),
-                            **fused_sync_metrics,
-                        },
-                        step=step,
-                    )
-                last_loss = float(losses_h[-1])
-        finally:
-            if pending is not None:
-                pending.cancel()
-            prefetcher.shutdown(wait=False)
-
-    round_ok = None  # per-round device-side [W] finiteness (quarantine)
-    quarantined_last_round = 0
-    for real_step in ([] if fused else range(start_step + 1, cfg.total_steps + 1)):
-        if cfg.profile_dir and real_step == profile_start:
-            jax.profiler.start_trace(cfg.profile_dir)
-            profiling = True
-        tokens, mask = next(batches)
-        t0 = time.perf_counter()
-        if streaming:
-            # fragment launches/applies are fused into the jitted step and
-            # overlap the inner compute — there is no separate sync phase
-            # to time (that's the point, arXiv:2501.18512).
-            state, loss = dl.step(
-                state, dl.feed(tokens), dl.feed(mask), real_step
-            )
-            synced = real_step % cfg.inner_steps == 0
-            jax.block_until_ready(loss)
-            compute_time += time.perf_counter() - t0
-            if synced:
-                state = dl._offload(state)
-                if ckpt and (
-                    real_step // cfg.inner_steps
-                ) % cfg.checkpoint_every == 0:
-                    ckpt.save(real_step, state)
-        else:
-            state, loss = dl.inner_step(state, dl.feed(tokens), dl.feed(mask))
-            if cfg.quarantine_nonfinite:
-                # accumulate ON DEVICE ([W] stays diloco-sharded; a host
-                # fetch of the raw loss would fail on a pod) — one & per
-                # step, consumed by the sync below
-                round_ok = (
-                    jnp.isfinite(loss) if round_ok is None
-                    else round_ok & jnp.isfinite(loss)
-                )
-            synced = real_step % cfg.inner_steps == 0
-            if synced:
-                jax.block_until_ready(state.params)
-                compute_time += time.perf_counter() - t0
-                if cfg.quarantine_nonfinite:
-                    # EXACT count for the log: same criterion the
-                    # sync applies (loss finiteness AND replica-
-                    # params finiteness — params are still pre-reset
-                    # here, so the check is host-drivable; round-4
-                    # advisor finding on the loss-only recount).
-                    # OUTSIDE the sync timer: this duplicate finiteness
-                    # scan is logging work, and charging it to sync_s
-                    # would inflate the measured comm share (round-5
-                    # review finding)
-                    eff = round_ok & dl._replica_finite_mask(
-                        state.params
-                    )
-                    quarantined_last_round = int(
-                        cfg.num_workers - eff.sum()
-                    )
-                with sync_timer:
-                    state = dl.outer_step(state, round_ok)
-                    round_ok = None
-                    jax.block_until_ready(state.params)
-                state = dl._offload(state)
-                if ckpt and (real_step // cfg.inner_steps) % cfg.checkpoint_every == 0:
-                    ckpt.save(real_step, state)
             else:
-                jax.block_until_ready(loss)
-                compute_time += time.perf_counter() - t0
-
-        if profiling and real_step >= profile_stop:
-            jax.profiler.stop_trace()
-            profiling = False
-
-        eval_metrics = {}
-        if synced and (evaluator is not None or moe_stats_fn is not None):
-            # one fetch for both consumers (offloaded snapshots pay one
-            # H2D transfer, not two)
-            snap_dev = dl._fetch(state).snapshot
-        if (
-            evaluator is not None
-            and synced
-            and (real_step // cfg.inner_steps) % cfg.eval_every == 0
-        ):
-            eval_metrics = evaluator(snap_dev, eval_set)
-            last_eval_step = real_step
-            last_eval = eval_metrics
-        if synced and moe_stats_fn is not None:
-            eval_metrics = {
-                **eval_metrics,
-                **moe_probe(snap_dev, tokens[0, 0]),
-            }
-        if synced:
-            eval_metrics = {**eval_metrics, **device_memory_stats()}
-
-        if cfg.quarantine_nonfinite:
-            # same masked-mean treatment as the fused path: a healed
-            # worker's NaN step loss must not poison the logged metric
-            last_loss = float(_finite_worker_mean(loss))
+                last_loss = float(jnp.mean(loss))
+            total_time = compute_time + sync_timer.total
+            tps = (real_step - start_step) * tokens_per_step / total_time
+            watchdog.observe_loss(real_step, last_loss)
+            # the loop's liveness tick: per STEP here (the stepwise loop's
+            # natural cadence — a stall mid-round must not wait for the
+            # sync), per round in fused mode
+            watchdog.heartbeat(
+                real_step, loss=last_loss, tokens_per_sec=round(tps, 1)
+            )
+            round_budget = {}
+            sync_extras = {}
             if synced:
-                eval_metrics = {
-                    **eval_metrics,
-                    "quarantined_workers": quarantined_last_round,
+                # per-round phase budget: depth-0 span seconds accumulated
+                # over the round's H steps (tracer resets at each sync)
+                round_budget = {
+                    f"t_{k}": round(v, 6)
+                    for k, v in tracer.phase_totals().items()
                 }
-        else:
-            last_loss = float(jnp.mean(loss))
-        total_time = compute_time + sync_timer.total
-        logger.log(
-            {
-                **eval_metrics,
-                "loss": last_loss,
-                "perplexity": float(np.exp(min(last_loss, 50.0))),
-                "lr": float(schedule(real_step - 1)),
-                "effective_step": real_step * cfg.num_workers,
-                "total_samples": real_step * cfg.batch_size * cfg.num_workers,
-                "tokens_per_sec": (real_step - start_step) * tokens_per_step / total_time,
-                "outer_synced": int(synced),
-                "avg_sync_time_s": sync_timer.avg_sync_time,
-                "comm_share": sync_timer.total / total_time if total_time else 0.0,
-            },
-            step=real_step,
-        )
+                wire_bytes_total += wire_rec["wire_bytes_per_sync"]
+                sync_extras = {
+                    **wire_metrics, "wire_bytes_total": wire_bytes_total,
+                }
+                # per-round throughput for the collapse sentinel (the
+                # cumulative tps would dilute a mid-run collapse away)
+                now = time.perf_counter()
+                watchdog.observe_throughput(
+                    real_step,
+                    cfg.inner_steps * tokens_per_step / max(now - round_t0, 1e-9),
+                )
+                round_t0 = now
+            logger.log(
+                {
+                    **eval_metrics,
+                    "loss": last_loss,
+                    "perplexity": float(np.exp(min(last_loss, 50.0))),
+                    "lr": float(schedule(real_step - 1)),
+                    "effective_step": real_step * cfg.num_workers,
+                    "total_samples": real_step * cfg.batch_size * cfg.num_workers,
+                    "tokens_per_sec": tps,
+                    "outer_synced": int(synced),
+                    "avg_sync_time_s": sync_timer.avg_sync_time,
+                    "comm_share": sync_timer.total / total_time if total_time else 0.0,
+                    **round_budget,
+                    **sync_extras,
+                },
+                step=real_step,
+            )
 
-    if profiling:
-        jax.profiler.stop_trace()
-    if ckpt:
-        if ckpt.latest_step != cfg.total_steps:  # orbax refuses overwrites
-            ckpt.save(cfg.total_steps, state, force=True)
-        ckpt.wait()
-        ckpt.close()
-    final_eval = {}
-    if evaluator is not None:
-        # reuse the in-loop result when the last sync already evaluated
-        # this exact snapshot
-        final_eval = (
-            last_eval if last_eval_step == cfg.total_steps
-            else evaluator(dl._fetch(state).snapshot, eval_set)
-        )
-    logger.finish()
+        if profiling:
+            jax.profiler.stop_trace()
+        if ckpt:
+            if ckpt.latest_step != cfg.total_steps:  # orbax refuses overwrites
+                ckpt.save(cfg.total_steps, state, force=True)
+            ckpt.wait()
+            ckpt.close()
+        final_eval = {}
+        if evaluator is not None:
+            # reuse the in-loop result when the last sync already evaluated
+            # this exact snapshot
+            final_eval = (
+                last_eval if last_eval_step == cfg.total_steps
+                else evaluator(dl._fetch(state).snapshot, eval_set)
+            )
+        completed = True
+    finally:
+        # teardown runs on EVERY exit (an exception mid-train must not
+        # leak the process-global tracer or leave the heartbeat daemon
+        # alarming a dead run): stop the watchdog BEFORE closing the
+        # logger (a post-close alarm would write to a closed file),
+        # restore the previous tracer, and export the Chrome trace —
+        # after a crash it shows exactly which phase the run died in.
+        watchdog.stop("finished" if completed else "crashed")
+        set_tracer(prev_tracer)
+        if cfg.trace_out and logger.is_writer:
+            try:
+                tracer.export_chrome(cfg.trace_out)
+                if not quiet:
+                    print(f"[nanodiloco] host span trace -> {cfg.trace_out}")
+            except OSError:
+                pass  # a full disk must not mask the real outcome
+        logger.finish()
     total_time = compute_time + sync_timer.total
     if fused:
         sync_summary = fused_sync_metrics
@@ -870,6 +1039,9 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         "final_loss": last_loss,
         "steps": cfg.total_steps,
         **sync_summary,
+        **wire_metrics,
+        "wire_bytes_total": wire_bytes_total,
+        "alarms": watchdog.alarm_count,
         "run_name": run_name,
         "state": state,
     }

@@ -1,0 +1,78 @@
+"""Compatibility shims for older jax releases (0.4.x).
+
+The codebase targets the modern public surface — ``jax.shard_map``,
+``jax.set_mesh``, ``jax.lax.pcast`` — which landed after 0.4.37. On an
+older jax these names are synthesized from their era-equivalents so the
+same source runs unmodified:
+
+- ``jax.shard_map(f, mesh=, in_specs=, out_specs=, axis_names=)`` →
+  ``jax.experimental.shard_map.shard_map`` with the complement of
+  ``axis_names`` passed as ``auto`` (the old spelling of
+  partial-manual) and ``check_rep=False`` (the new API's varying-type
+  system replaced replication checking; the old checker rejects the
+  partial-manual regions this codebase writes).
+- ``jax.set_mesh(mesh)`` → the mesh itself (``Mesh.__enter__`` is the
+  old ambient-mesh context manager, identical usage under ``with``).
+- ``jax.lax.pcast(x, axes, to=)`` → identity. pcast only adjusts the
+  NEW type system's replicated/varying annotations; with
+  ``check_rep=False`` there is no annotation to adjust and values are
+  already correct.
+
+``install()`` is idempotent and a no-op on a modern jax. It is called
+from the package ``__init__`` so every entry point (CLI, tests, bench)
+gets it before any mesh code runs.
+"""
+
+from __future__ import annotations
+
+
+def install() -> None:
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                      axis_names=None, **kwargs):
+            auto = frozenset()
+            if axis_names is not None and mesh is not None:
+                # size-1 axes are semantically irrelevant to manual vs
+                # auto; dropping them matters on legacy jax, whose EAGER
+                # shard_map rejects any non-empty auto set (and
+                # build_mesh always materializes all six axes)
+                auto = frozenset(
+                    a for a in mesh.axis_names
+                    if a not in frozenset(axis_names)
+                    and dict(mesh.shape)[a] > 1
+                )
+
+            sm = _legacy_shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=False, auto=auto,
+            )
+
+            jitted = []  # lazy one-time jit so retries hit its cache
+
+            def call(*args):
+                # check_rep=False matches the new API (no replication
+                # checker); legacy's EAGER impl raises
+                # NotImplementedError for partial-auto regions, which
+                # the jit path handles fine — fall through to it
+                try:
+                    return sm(*args)
+                except NotImplementedError:
+                    if not jitted:
+                        jitted.append(jax.jit(sm))
+                    return jitted[0](*args)
+
+            return call
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax, "set_mesh"):
+        # Mesh is itself the legacy ambient-mesh context manager; the
+        # only call shape in this codebase is ``with jax.set_mesh(m):``
+        jax.set_mesh = lambda mesh: mesh
+
+    if not hasattr(jax.lax, "pcast"):
+        jax.lax.pcast = lambda x, axes, to=None: x

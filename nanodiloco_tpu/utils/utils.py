@@ -154,17 +154,23 @@ def probe_backend(
             return 2, err  # healthy backend, but it is CPU: not live
         return 1, err
     except subprocess.TimeoutExpired:
+        # keep whatever stderr the wedged child managed to emit before
+        # (or while) being signalled — it is the ONLY diagnostic saying
+        # which phase of init/compile hung; returning b"" here made
+        # ensure_live_backend report an empty (or stale) reason
+        # (ADVICE r5 low)
+        err = b""
         proc.send_signal(signal.SIGINT)
         try:
-            proc.communicate(timeout=10)
+            _, err = proc.communicate(timeout=10)
         except subprocess.TimeoutExpired:
             proc.terminate()
             try:
-                proc.communicate(timeout=30)
+                _, err = proc.communicate(timeout=30)
             except subprocess.TimeoutExpired:
                 proc.kill()
-                proc.communicate()
-        return 2, b""
+                _, err = proc.communicate()
+        return 2, err or b""
 
 
 def ensure_live_backend(
@@ -215,6 +221,10 @@ def ensure_live_backend(
             last_err = err
         else:
             reason = "accelerator backend init blocked (stuck claim); using CPU"
+            # the timed-out probe now returns the child's captured
+            # stderr — the hang-phase diagnostic; keep a previous
+            # iteration's only when this probe produced none
+            last_err = err or last_err
         if time.monotonic() >= deadline:
             break
         time.sleep(30)
@@ -283,20 +293,20 @@ def allreduce_wire_report(
     Returns ``(integer_results, wide_float_results)``: the result-type
     strings (possibly tuples — XLA's combiner merges per-leaf psums)
     of all-reduce ops that carry a signed-int payload, and of those
-    that carry a float tensor wider than the legitimate bookkeeping
-    floats. The integer wire itself all-reduces one f32 scalar PER
-    TENSOR (the shared absmax pmax) plus the survivor count — pass
-    ``scale_leaves`` = the synced pytree's leaf count so a model whose
-    tree outgrows the default does not read its own scale op as a
-    payload leak (round-5 review finding: the old fixed 16 breaks at
-    17+ leaves). Used by the integer-wire HLO tests
-    (tests/test_diloco.py) and the multichip dryrun
-    (__graft_entry__.py) so the parsing lives in ONE place — if XLA's
-    text format changes (e.g. all-reduce-start/done pairs), fix it
-    here."""
+    that carry any float tensor OTHER than the integer wire's two
+    legitimate bookkeeping shapes: the shared absmax pmax — one f32
+    vector of exactly ``[scale_leaves]`` elements (pass the synced
+    pytree's leaf count) — and the f32 survivor-count scalar. Matching
+    the exact expected shape replaces the old size threshold
+    (``> max(16, scale_leaves)``), which let a genuinely leaked f32
+    payload of up to ``scale_leaves`` elements escape the audit — a
+    false-negative window that GREW with tree size (ADVICE r5 low);
+    now only a leak that is f32 of exactly the leaf count could slip
+    through. Used by the integer-wire HLO tests (tests/test_diloco.py)
+    and the multichip dryrun (__graft_entry__.py) so the parsing lives
+    in ONE place — if XLA's text format changes (e.g.
+    all-reduce-start/done pairs), fix it here."""
     import re
-
-    import numpy as np
 
     results = [
         l.split(" all-reduce(")[0]
@@ -308,13 +318,14 @@ def allreduce_wire_report(
         if " all-reduce-start(" in l and "=" in l
     ]
     int_payload = [r for r in results if re.search(r"s(8|16|32)\[", r)]
-    threshold = max(16, int(scale_leaves))
+    expected = int(scale_leaves)
     wide_float = []
     for r in results:
         for m in re.finditer(r"(f64|f32|f16|bf16)\[([0-9,]*)\]", r):
             dims = [int(d) for d in m.group(2).split(",") if d]
-            n = int(np.prod(dims)) if dims else 1
-            if n > threshold:
+            scalar = not dims
+            scale_vec = m.group(1) == "f32" and dims == [expected]
+            if not (scalar or scale_vec):
                 wide_float.append(r)
                 break
     return int_payload, wide_float

@@ -364,6 +364,17 @@ def main() -> None:
         # claim the chip); the parent owns the deadline. A crash is
         # recorded HERE with its traceback — the JSONL is the only
         # diagnostic hours later in an unattended recovery window.
+        # Validate the phase name BEFORE dispatch, mirroring the
+        # parent's unknown-phase check: a bare KeyError/IndexError here
+        # (e.g. a selftest phase name without NANODILOCO_AGENDA_SELFTEST
+        # in the child env) would be recorded as a confusing phase crash
+        # (ADVICE r5 low).
+        if len(args) < 2 or args[1] not in PHASES:
+            raise SystemExit(
+                f"--child needs one phase name from {list(PHASES)}; got "
+                f"{args[1:] or 'nothing'} (selftest phases require "
+                "NANODILOCO_AGENDA_SELFTEST in this process's env)"
+            )
         try:
             PHASES[args[1]]()
         except Exception as e:

@@ -22,7 +22,16 @@ os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # pre-0.5 jax: the option doesn't exist, but XLA_FLAGS is read at
+    # backend INIT (not import), so setting it here — before the first
+    # device query — still yields the 8-device virtual mesh
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 # Persistent compilation cache: the suite compiles many identical tiny
 # programs (every train() builds fresh jits); cache hits cut minutes off
 # repeat runs. Safe on CPU; keyed by backend+config so the axon TPU
@@ -35,9 +44,31 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 import pytest  # noqa: E402
 
 
+# pre-0.5 jax: programs that natively ABORT (SIGABRT inside legacy
+# XLA's SPMD partitioner — not a Python exception, it takes the whole
+# pytest process down and every later test with it). Skipped only on
+# legacy jax; modern jax runs them.
+_LEGACY_JAX = tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5)
+_LEGACY_XLA_ABORTERS = {
+    # sp manual region with a >1 auto axis (fsdp/tp) inside
+    "test_sp_diloco_round_matches_unsharded[fsdp2_sp2]",
+    "test_sp_diloco_round_matches_unsharded[tp2_sp2]",
+}
+
+
 def pytest_collection_modifyitems(config, items):
     """Skip ``slow``-marked tests in the default run, but never when the
-    user asked for them — via ``-m`` or an explicit ``::`` node id."""
+    user asked for them — via ``-m`` or an explicit ``::`` node id.
+    Legacy-jax native aborters are skipped unconditionally: a SIGABRT
+    cannot be caught and would kill the whole session."""
+    if _LEGACY_JAX:
+        crash = pytest.mark.skip(
+            reason="aborts (SIGABRT) in legacy XLA's partitioner on "
+                   f"jax {jax.__version__}; runs on jax >= 0.5"
+        )
+        for item in items:
+            if item.name in _LEGACY_XLA_ABORTERS:
+                item.add_marker(crash)
     if config.getoption("-m") or any("::" in a for a in config.args):
         return
     skip = pytest.mark.skip(reason="slow parity test; run with -m slow or by node id")

@@ -1,0 +1,431 @@
+"""Observability stack (nanodiloco_tpu/obs): span tracer, watchdog
+sentinels, comm byte accounting, the report-compare regression gate,
+and the end-to-end train() wiring (trace JSON, per-phase JSONL keys,
+status.json)."""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from nanodiloco_tpu.models.config import LlamaConfig
+from nanodiloco_tpu.obs.tracer import SpanTracer, current_tracer, set_tracer, trace_span
+from nanodiloco_tpu.obs.watchdog import Watchdog, WatchdogConfig
+
+SMALL_MODEL = LlamaConfig(
+    vocab_size=384, hidden_size=32, intermediate_size=64,
+    num_attention_heads=4, num_hidden_layers=2, max_position_embeddings=64,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- tracer -----------------------------------------------------------------
+
+
+def test_tracer_nesting_and_depth():
+    clk = FakeClock()
+    tr = SpanTracer(clock=clk)
+    with tr.span("round"):
+        clk.t += 1.0
+        with tr.span("inner"):
+            clk.t += 2.0
+        clk.t += 0.5
+    events = {e["name"]: e for e in tr.events}
+    assert events["round"]["depth"] == 0
+    assert events["inner"]["depth"] == 1
+    assert events["round"]["dur"] == pytest.approx(3.5)
+    assert events["inner"]["dur"] == pytest.approx(2.0)
+    # only depth-0 spans enter the phase budget (no double counting)
+    totals = tr.phase_totals()
+    assert totals == {"round": pytest.approx(3.5)}
+    assert tr.phase_totals() == {}  # reset happened
+
+
+def test_tracer_chrome_export_is_valid_and_nested(tmp_path):
+    clk = FakeClock(10.0)
+    tr = SpanTracer(clock=clk)
+    with tr.span("outer_sync", round=3):
+        clk.t += 0.25
+        with tr.span("allreduce"):
+            clk.t += 0.1
+        clk.t += 0.05
+    path = tr.export_chrome(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))  # must be VALID json
+    evs = doc["traceEvents"]
+    assert {e["name"] for e in evs} == {"outer_sync", "allreduce"}
+    for e in evs:
+        assert e["ph"] == "X"
+        assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+    parent = next(e for e in evs if e["name"] == "outer_sync")
+    child = next(e for e in evs if e["name"] == "allreduce")
+    # nested containment on the same tid is what Perfetto renders as a
+    # flame graph
+    assert child["tid"] == parent["tid"]
+    assert child["ts"] >= parent["ts"]
+    assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"] + 1e-6
+    assert parent["args"] == {"round": 3}
+
+
+def test_trace_span_uses_installed_tracer():
+    tr = SpanTracer(clock=FakeClock())
+    prev = set_tracer(tr)
+    try:
+        with trace_span("phase"):
+            pass
+        assert [e["name"] for e in tr.events] == ["phase"]
+        assert current_tracer() is tr
+    finally:
+        set_tracer(prev)
+    # after restore, trace_span records nothing new on tr
+    with trace_span("phase2"):
+        pass
+    assert [e["name"] for e in tr.events] == ["phase"]
+
+
+# -- watchdog sentinels ------------------------------------------------------
+
+
+def _wd(alarms, cfg=None, **kw):
+    return Watchdog(cfg or WatchdogConfig(), emit=alarms.append, **kw)
+
+
+def test_watchdog_nan_alarm_fires_once_per_episode():
+    alarms = []
+    wd = _wd(alarms)
+    wd.observe_loss(1, float("nan"))
+    wd.observe_loss(2, float("nan"))  # same episode: no second alarm
+    assert len(alarms) == 1
+    assert alarms[0]["alarm"] == "nan_loss" and alarms[0]["step"] == 1
+    wd.observe_loss(3, 2.0)           # healthy: re-arms
+    wd.observe_loss(4, float("inf"))
+    assert [a["alarm"] for a in alarms] == ["nan_loss", "nan_loss"]
+    assert alarms[1]["step"] == 4
+
+
+def test_watchdog_loss_spike_zscore():
+    alarms = []
+    wd = _wd(alarms, WatchdogConfig(loss_zscore=4.0, loss_window=16))
+    for i in range(16):
+        wd.observe_loss(i, 2.0 + 0.01 * (i % 3))
+    wd.observe_loss(100, 50.0)  # massive upward spike
+    assert [a["alarm"] for a in alarms] == ["loss_spike"]
+    assert alarms[0]["zscore"] > 4.0
+    # a downward outlier is good news, never an alarm
+    wd.observe_loss(101, 0.5)
+    assert len(alarms) == 1
+
+
+def test_watchdog_throughput_collapse():
+    alarms = []
+    wd = _wd(alarms, WatchdogConfig(tps_collapse_frac=0.5, loss_window=32))
+    for i in range(8):
+        wd.observe_throughput(i, 1000.0)
+    wd.observe_throughput(9, 100.0)  # 10% of the median
+    assert [a["alarm"] for a in alarms] == ["throughput_collapse"]
+    assert alarms[0]["rolling_median"] == pytest.approx(1000.0)
+
+
+def test_watchdog_stall_via_injected_clock():
+    alarms = []
+    clk = FakeClock()
+    wd = _wd(
+        alarms,
+        WatchdogConfig(stall_factor=3.0, min_stall_s=5.0),
+        clock=clk,
+    )
+    for step, t in enumerate([0.0, 10.0, 20.0]):  # mean beat: 10 s
+        clk.t = t
+        wd.heartbeat(step)
+    clk.t = 25.0
+    assert not wd.check_stall()      # 5 s silent < limit (30 s)
+    clk.t = 51.0
+    assert wd.check_stall()          # 31 s silent > 3 x 10 s
+    assert wd.check_stall()          # still stalled...
+    assert len(alarms) == 1          # ...but one alarm per episode
+    assert alarms[0]["alarm"] == "stall"
+    clk.t = 52.0
+    wd.heartbeat(4)                  # loop came back: re-arms
+    clk.t = 120.0
+    assert wd.check_stall()
+    assert [a["alarm"] for a in alarms] == ["stall", "stall"]
+
+
+def test_watchdog_status_file(tmp_path):
+    path = str(tmp_path / "status.json")
+    wd = _wd([], status_path=path)
+    wd.heartbeat(7, loss=3.25, tokens_per_sec=123.4)
+    doc = json.load(open(path))
+    assert doc["state"] == "running"
+    assert doc["step"] == 7 and doc["loss"] == 3.25
+    wd.stop("finished")
+    assert json.load(open(path))["state"] == "finished"
+
+
+def test_watchdog_alarm_lands_in_metrics_jsonl(tmp_path):
+    """The injected-NaN acceptance path: an alarm emitted through
+    MetricsLogger.log becomes a structured JSONL record in the same
+    stream as the metrics."""
+    from nanodiloco_tpu.training.metrics import MetricsLogger
+
+    logger = MetricsLogger("wdrun", out_dir=str(tmp_path), quiet=True,
+                           process_index=0)
+    wd = Watchdog(WatchdogConfig(), emit=logger.log)
+    wd.observe_loss(5, float("nan"))
+    logger.finish()
+    recs = [json.loads(l) for l in open(tmp_path / "wdrun.jsonl")]
+    assert recs == [{"alarm": "nan_loss", "step": 5, "loss": "nan"}]
+
+
+# -- comm byte accounting ----------------------------------------------------
+
+
+def test_sync_wire_bytes_raw_vs_int4():
+    from nanodiloco_tpu.parallel.diloco import Diloco, DilocoConfig
+    from nanodiloco_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    mesh = build_mesh(MeshConfig(diloco=2))
+    raw_dl = Diloco(SMALL_MODEL, DilocoConfig(num_workers=2), mesh)
+    int4_dl = Diloco(
+        SMALL_MODEL,
+        DilocoConfig(num_workers=2, outer_comm_dtype="int4",
+                     outer_wire_collective=True),
+        mesh,
+    )
+    n = SMALL_MODEL.num_params()
+    raw = raw_dl.sync_wire_bytes()
+    assert raw["wire_bytes_per_sync"] == raw["raw_bytes_per_sync"] == 4 * n
+    assert raw["wire_compression"] == 1.0
+    i4 = int4_dl.sync_wire_bytes()
+    # int4 payload rides an int8 accumulator at W=2: 1 byte/element,
+    # plus the f32 scale-per-leaf + survivor-count overhead
+    assert i4["raw_bytes_per_sync"] == 4 * n
+    assert n < i4["wire_bytes_per_sync"] < 4 * n
+    assert i4["wire_bytes_per_sync"] == n + i4["wire_overhead_bytes"]
+    assert 3.5 < i4["wire_compression"] <= 4.0
+    # the ACTUAL tree wins over the config-derived count
+    state = raw_dl.init_state(jax.random.key(0))
+    from_state = raw_dl.sync_wire_bytes(state.snapshot)
+    n_actual = sum(
+        int(np.prod(l.shape)) for l in jax.tree.leaves(state.snapshot)
+    )
+    assert from_state["raw_bytes_per_sync"] == 4 * n_actual
+
+
+# -- report compare gate -----------------------------------------------------
+
+
+def _write_run(path, tps, final_loss):
+    with open(path, "w") as f:
+        for i, loss in enumerate([final_loss + 1.0, final_loss], start=1):
+            f.write(json.dumps({
+                "loss": loss, "tokens_per_sec": tps, "step": i,
+                "outer_synced": 1,
+            }) + "\n")
+
+
+def test_report_compare_ok_and_regression_exit_codes(tmp_path):
+    from nanodiloco_tpu.cli import report_main
+
+    base = str(tmp_path / "base.jsonl")
+    good = str(tmp_path / "good.jsonl")
+    slow = str(tmp_path / "slow.jsonl")
+    _write_run(base, tps=1000.0, final_loss=3.0)
+    _write_run(good, tps=990.0, final_loss=2.95)   # within thresholds
+    _write_run(slow, tps=500.0, final_loss=3.0)    # seeded tps regression
+    report_main(["compare", base, good])           # must NOT raise
+    with pytest.raises(SystemExit) as e:
+        report_main(["compare", base, slow])
+    assert e.value.code == 1
+    # threshold is configurable: a 60% allowed drop passes the same pair
+    report_main(["compare", base, slow, "--max-tps-drop", "0.6"])
+
+
+def test_report_compare_loss_regression_and_json(tmp_path, capsys):
+    from nanodiloco_tpu.cli import report_main
+
+    base = str(tmp_path / "base.jsonl")
+    worse = str(tmp_path / "worse.jsonl")
+    _write_run(base, tps=100.0, final_loss=3.0)
+    _write_run(worse, tps=100.0, final_loss=3.5)
+    with pytest.raises(SystemExit):
+        report_main(["compare", base, worse, "--json"])
+    diff = json.loads(capsys.readouterr().out)
+    assert "final_loss" in diff["regressions"]
+    assert diff["metrics"]["final_loss"]["regressed"] is True
+
+
+def test_report_compare_against_baseline_json(tmp_path):
+    from nanodiloco_tpu.cli import report_main
+    from nanodiloco_tpu.training.metrics import load_comparable
+
+    run = str(tmp_path / "run.jsonl")
+    _write_run(run, tps=100.0, final_loss=3.0)
+    baseline = str(tmp_path / "BASELINE.json")
+    with open(baseline, "w") as f:
+        json.dump({"published": {"final_loss": 3.0,
+                                 "tokens_per_sec_last": 90.0}}, f)
+    report_main(["compare", baseline, run])  # candidate faster + equal loss
+    # a baseline without any comparable metric is rejected loudly
+    empty = str(tmp_path / "empty.json")
+    with open(empty, "w") as f:
+        json.dump({"metric": "prose only"}, f)
+    with pytest.raises(ValueError, match="none of the comparable"):
+        load_comparable(empty)
+
+
+def test_summarize_run_surfaces_obs_keys(tmp_path):
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    path = tmp_path / "r.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"loss": 3.0, "step": 1, "t_inner": 0.5,
+                            "t_sync": 0.1}) + "\n")
+        f.write(json.dumps({"alarm": "nan_loss", "step": 2}) + "\n")
+        f.write(json.dumps({"loss": 2.5, "step": 3, "t_inner": 0.7,
+                            "t_sync": 0.3, "outer_synced": 1,
+                            "wire_bytes_per_sync": 1000,
+                            "wire_bytes_total": 2000,
+                            "wire_compression": 4.0}) + "\n")
+    s = summarize_run(str(path))
+    assert s["alarms"] == 1 and s["alarm_kinds"] == {"nan_loss": 1}
+    assert s["wire_bytes_total"] == 2000
+    assert s["wire_compression"] == 4.0
+    assert s["t_inner_mean_s"] == pytest.approx(0.6)
+    assert s["t_sync_mean_s"] == pytest.approx(0.2)
+
+
+# -- allreduce wire audit (exact-shape classification) -----------------------
+
+
+def test_allreduce_wire_report_exact_shapes():
+    from nanodiloco_tpu.utils import allreduce_wire_report
+
+    hlo = "\n".join([
+        "  %a = s8[1000]{0} all-reduce(s8[1000]{0} %x), to_apply=%sum",
+        "  %b = (f32[3]{0}, f32[]) all-reduce(f32[3]{0} %s, f32[] %c), to_apply=%max",
+    ])
+    ints, wide = allreduce_wire_report(hlo, scale_leaves=3)
+    assert len(ints) == 1 and "s8[1000]" in ints[0]
+    assert wide == []  # scale vector + survivor scalar are legitimate
+    # a leaked f32 payload is flagged even when SMALLER than the leaf
+    # count (the old size threshold would have passed it)
+    leak = "  %c = f32[64]{0} all-reduce(f32[64]{0} %p), to_apply=%sum"
+    _, wide = allreduce_wire_report(leak, scale_leaves=128)
+    assert wide and "f32[64]" in wide[0]
+    # a non-f32 float vector is never a legitimate scale op
+    bf = "  %d = bf16[3]{0} all-reduce(bf16[3]{0} %p), to_apply=%sum"
+    _, wide = allreduce_wire_report(bf, scale_leaves=3)
+    assert wide
+
+
+# -- chip_agenda child-mode validation ---------------------------------------
+
+
+def test_chip_agenda_child_rejects_unknown_phase(tmp_path):
+    script = os.path.join(
+        os.path.dirname(__file__), "..", "scripts", "chip_agenda.py"
+    )
+    env = {**os.environ, "NANODILOCO_AGENDA_OUT": str(tmp_path / "o.jsonl")}
+    r = subprocess.run(
+        [sys.executable, script, "--child", "nope"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r.returncode != 0
+    assert "phase name" in r.stderr
+    assert not os.path.exists(tmp_path / "o.jsonl")  # no bogus crash record
+    r2 = subprocess.run(
+        [sys.executable, script, "--child"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r2.returncode != 0 and "phase name" in r2.stderr
+
+
+# -- end-to-end train() wiring ----------------------------------------------
+
+
+def _obs_cfg(tmp_path, **kw):
+    from nanodiloco_tpu.training.train_loop import TrainConfig
+
+    defaults = dict(
+        seed=1337, batch_size=4, per_device_batch_size=2, seq_length=32,
+        warmup_steps=2, total_steps=6, inner_steps=3, lr=1e-3,
+        num_workers=2, model=SMALL_MODEL, log_dir=str(tmp_path),
+        quiet=True, use_wandb=False, checkpoint_dir=None,
+        trace_out=str(tmp_path / "trace.json"),
+        status_file=str(tmp_path / "status.json"),
+    )
+    defaults.update(kw)
+    return TrainConfig(**defaults)
+
+
+@pytest.mark.parametrize("fused", [True, False], ids=["fused", "stepwise"])
+def test_train_emits_trace_phases_and_wire_metrics(tmp_path, fused):
+    from nanodiloco_tpu.training.train_loop import train
+
+    run = f"obs_{'fused' if fused else 'step'}"
+    out = train(_obs_cfg(tmp_path, fused_rounds=fused, run_name=run))
+    assert out["alarms"] == 0
+    assert out["wire_bytes_total"] == 2 * out["wire_bytes_per_sync"] > 0
+
+    # Chrome trace: valid JSON, the expected phases, and span coverage
+    # of the round wall-clock (the acceptance bar is >=95%; asserted a
+    # little lower to keep CI noise out of the gate)
+    doc = json.load(open(tmp_path / "trace.json"))
+    evs = doc["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert {"data", "inner"} <= names
+    assert ("sync" in names) != fused  # fused rounds contain their sync
+    t0 = min(e["ts"] for e in evs)
+    t1 = max(e["ts"] + e["dur"] for e in evs)
+    covered = sum(
+        e["dur"] for e in evs
+        if not any(  # count only depth-0 spans (avoid double counting)
+            o is not e and o["tid"] == e["tid"]
+            and o["ts"] <= e["ts"] and e["ts"] + e["dur"] <= o["ts"] + o["dur"]
+            for o in evs
+        )
+    )
+    assert covered / (t1 - t0) >= 0.90, f"spans cover {covered / (t1 - t0):.0%}"
+
+    # JSONL: sync records carry the per-phase budget + wire ledger
+    recs = [json.loads(l) for l in open(tmp_path / f"{run}.jsonl")]
+    syncs = [r for r in recs if r.get("outer_synced")]
+    assert len(syncs) == 2
+    for r in syncs:
+        assert r["t_inner"] > 0 and "t_data" in r
+        assert r["wire_bytes_per_sync"] > 0 and r["wire_compression"] == 1.0
+    assert syncs[-1]["wire_bytes_total"] == out["wire_bytes_total"]
+
+    # status.json reached its terminal state
+    status = json.load(open(tmp_path / "status.json"))
+    assert status["state"] == "finished"
+    assert status["step"] == 6 and status["alarms"] == 0
+
+
+def test_train_cli_flags_reach_config():
+    from nanodiloco_tpu.cli import build_parser, config_from_args
+
+    args = build_parser().parse_args([
+        "--trace-out", "/tmp/t.json", "--status-file", "/tmp/s.json",
+        "--watch-loss-zscore", "4.5", "--watch-stall-factor", "0",
+        "--watch-tps-collapse", "0.25", "--watch-loss-window", "64",
+    ])
+    cfg = config_from_args(args)
+    assert cfg.trace_out == "/tmp/t.json"
+    assert cfg.status_file == "/tmp/s.json"
+    assert cfg.watch_loss_zscore == 4.5
+    assert cfg.watch_stall_factor == 0.0
+    assert cfg.watch_tps_collapse == 0.25
+    assert cfg.watch_loss_window == 64
