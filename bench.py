@@ -32,53 +32,14 @@ import time
 import jax
 import jax.numpy as jnp
 
-# bf16 peak TFLOP/s per chip by device kind substring (first match wins).
-# Override with BENCH_PEAK_TFLOPS when the kind string is missing/wrong.
-_PEAKS = [
-    ("v6", 918.0),
-    ("v5p", 459.0),
-    ("v5", 197.0),   # v5e / "v5 lite"
-    ("v4", 275.0),
-    ("v3", 123.0),
-]
-
-
-def _peak_tflops() -> tuple[float | None, str]:
-    kind = jax.devices()[0].device_kind
-    env = os.environ.get("BENCH_PEAK_TFLOPS")
-    if env:
-        return float(env), kind
-    low = kind.lower()
-    for sub, peak in _PEAKS:
-        if sub in low:
-            return peak, kind
-    return None, kind
-
-
-def train_flops_per_token(cfg, seq: int, moe_tokens: int | None = None) -> float:
-    """Matmul FLOPs per trained token, fwd+bwd (3x fwd): 6 x matmul
-    params (embedding lookup excluded, lm_head included) plus attention
-    scores/values 12*L*S*d (non-causal convention). For MoE, executed
-    FLOPs means (a) the expert FFN counts the slots actually COMPUTED
-    (dense dispatch runs E x C = k x capacity_factor slot-passes per
-    token), not all E experts' parameters, and (b) the dense
-    dispatch/combine one-hot einsums are counted too — they are real
-    MXU matmuls of the same order as the FFN at bench shapes, O(T) per
-    token like attention (``moe_tokens`` = the T = batch x seq the
-    [T, E, C] routing tensors span; defaults to ``seq``)."""
-    matmul_params = cfg.num_params() - cfg.vocab_size * cfg.hidden_size
-    out = 12.0 * cfg.num_hidden_layers * seq * cfg.hidden_size
-    if cfg.num_experts:
-        d, f = cfg.hidden_size, cfg.intermediate_size
-        kcf = cfg.num_experts_per_tok * cfg.expert_capacity_factor
-        all_experts = 3 * cfg.num_experts * d * f
-        matmul_params += cfg.num_hidden_layers * (3 * d * f * kcf - all_experts)
-        t = moe_tokens if moe_tokens is not None else seq
-        # dispatch ('tec,td->ecd') + combine ('tec,ecd->td'): E*C*d MACs
-        # per token each, E*C ~= kcf*T -> 2 einsums x 3 (fwd+bwd) x
-        # 2 FLOPs/MAC
-        out += 12.0 * cfg.num_hidden_layers * kcf * t * d
-    return 6.0 * matmul_params + out
+# One source of truth for the chip-peak table and the hand FLOPs
+# formula: nanodiloco_tpu/obs/costs.py — where `report cost` reconciles
+# them against XLA's own cost model. The names stay importable here
+# (chip_agenda and recorded workflows call bench._peak_tflops()).
+from nanodiloco_tpu.obs.costs import (  # noqa: E402
+    detect_peak_tflops as _peak_tflops,
+    train_flops_per_token,
+)
 
 
 def run_workload(

@@ -176,6 +176,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="maintain a live status.json (atomic rewrite) "
                         "with state/step/loss/throughput/alarms for "
                         "external pollers")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve live telemetry over HTTP on this port "
+                        "(stdlib server, daemon thread): /metrics is "
+                        "OpenMetrics text (loss, tokens/sec, comm share, "
+                        "wire bytes, phase seconds, alarms by kind, HBM "
+                        "peak, outer syncs), /healthz answers 200/503 "
+                        "from the watchdog's live status. 0 picks a free "
+                        "port (printed); unset = no server, no cost")
+    p.add_argument("--cost-analysis", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="log XLA's cost_analysis of the dispatched "
+                        "program once at startup ({'cost_analysis': ...} "
+                        "in the JSONL): analytic FLOPs/token + chip peak "
+                        "for `report cost` and the mfu_analytic compare "
+                        "gate. Host-side lowering only — no second XLA "
+                        "compile")
     p.add_argument("--watch-loss-zscore", type=float, default=6.0,
                    help="watchdog: alarm when a loss rises more than this "
                         "many rolling-window std-devs above the window "
@@ -278,6 +294,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         eval_batches=args.eval_batches,
         trace_out=args.trace_out,
         status_file=args.status_file,
+        metrics_port=args.metrics_port,
+        cost_analysis=args.cost_analysis,
         watch_loss_zscore=args.watch_loss_zscore,
         watch_loss_window=args.watch_loss_window,
         watch_tps_collapse=args.watch_tps_collapse,
@@ -469,9 +487,26 @@ def report_main(argv: list[str]) -> None:
     ``report compare BASELINE CANDIDATE``: regression gate — diff two
     runs (each a run .jsonl or a summary/BASELINE .json) and exit 1
     when the candidate regresses past the configured thresholds, so a
-    bench trajectory becomes an enforced contract in CI or a cron."""
+    bench trajectory becomes an enforced contract in CI or a cron.
+
+    ``report merge-trace SHARD... -o MERGED``: fold per-process trace
+    shards (rank 0's ``--trace-out`` file + the ``*.rank{k}.json``
+    shards the other hosts wrote) into ONE Chrome trace with pid =
+    process index — both hosts' sync spans on a single Perfetto
+    timeline.
+
+    ``report cost RUN.jsonl``: reconcile the run's captured XLA
+    cost_analysis record against its measured throughput and wire
+    ledger — analytic MFU and analytic-vs-ledger wire bytes as a
+    computed artifact instead of a hand-derived table."""
     if argv[:1] == ["compare"]:
         report_compare_main(argv[1:])
+        return
+    if argv[:1] == ["merge-trace"]:
+        report_merge_trace_main(argv[1:])
+        return
+    if argv[:1] == ["cost"]:
+        report_cost_main(argv[1:])
         return
     p = argparse.ArgumentParser(prog="nanodiloco_tpu report")
     p.add_argument("jsonl", help="metrics JSONL written by training")
@@ -536,6 +571,107 @@ def report_compare_main(argv: list[str]) -> None:
         )
     if not diff["ok"]:
         raise SystemExit(1)
+
+
+def report_merge_trace_main(argv: list[str]) -> None:
+    p = argparse.ArgumentParser(prog="nanodiloco_tpu report merge-trace")
+    p.add_argument("shards", nargs="+",
+                   help="per-process Chrome trace shards: rank 0's "
+                        "--trace-out file plus the trace.rank{k}.json "
+                        "files the other hosts wrote next to it")
+    p.add_argument("-o", "--out", required=True,
+                   help="merged Chrome trace output path (open in "
+                        "Perfetto / chrome://tracing)")
+    args = p.parse_args(argv)
+
+    import os
+
+    from nanodiloco_tpu.obs.tracer import merge_chrome_traces
+
+    docs = []
+    for path in args.shards:
+        with open(path) as f:
+            docs.append(json.load(f))
+    merged = merge_chrome_traces(docs)
+    d = os.path.dirname(os.path.abspath(args.out))
+    os.makedirs(d, exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    spans = sum(1 for e in merged["traceEvents"] if e.get("ph") == "X")
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    print(
+        f"merged {len(docs)} shard(s) -> {args.out} "
+        f"({spans} spans across {len(pids)} process(es))"
+    )
+
+
+def report_cost_main(argv: list[str]) -> None:
+    p = argparse.ArgumentParser(prog="nanodiloco_tpu report cost")
+    p.add_argument("jsonl",
+                   help="metrics JSONL from a run with cost capture on "
+                        "(the default; --no-cost-analysis disables it)")
+    p.add_argument("--json", action="store_true",
+                   help="print the reconciliation as one JSON object")
+    args = p.parse_args(argv)
+
+    from nanodiloco_tpu.training.metrics import find_cost_record, read_jsonl_records
+
+    recs, _torn = read_jsonl_records(args.jsonl)
+    cost = find_cost_record(recs)
+    if cost is None:
+        raise SystemExit(
+            f"{args.jsonl} has no cost_analysis record: the run was "
+            "started with --no-cost-analysis, predates cost capture, or "
+            "the backend reported no cost model"
+        )
+
+    from nanodiloco_tpu.obs.costs import analytic_mfu
+
+    out: dict = {"program": cost.get("program"),
+                 "device_kind": cost.get("device_kind"),
+                 "num_devices": cost.get("num_devices")}
+    fpt = cost.get("flops_per_token")
+    hand = cost.get("flops_per_token_hand")
+    if fpt:
+        out["flops_per_token_analytic"] = round(fpt, 1)
+    if hand:
+        out["flops_per_token_hand"] = round(hand, 1)
+    if fpt and hand:
+        out["analytic_vs_hand_ratio"] = round(fpt / hand, 4)
+    # the dispatched executable's own (loop-bodies-once) analysis —
+    # trend numbers, not per-token truths (obs/costs caveat)
+    for k in ("flops_billed", "bytes_accessed_billed"):
+        if k in cost:
+            out[k] = cost[k]
+    tps = [r["tokens_per_sec"] for r in recs
+           if r.get("tokens_per_sec") is not None]
+    if tps:
+        out["tokens_per_sec_last"] = round(tps[-1], 1)
+        mfu = analytic_mfu(cost, tps[-1])
+        if mfu is not None:
+            out["mfu_analytic"] = round(mfu, 5)
+            out["peak_tflops"] = cost.get("peak_tflops")
+        else:
+            out["mfu_analytic"] = None  # no chip peak captured (e.g. CPU)
+    # analytic-vs-ledger wire bytes: what sync_wire_bytes SAID a sync
+    # moves vs what the per-round ledger actually accumulated
+    per_sync = [r["wire_bytes_per_sync"] for r in recs
+                if r.get("wire_bytes_per_sync") is not None]
+    totals = [r["wire_bytes_total"] for r in recs
+              if r.get("wire_bytes_total") is not None]
+    syncs = sum(1 for r in recs if r.get("outer_synced"))
+    if per_sync:
+        out["wire_bytes_per_sync_analytic"] = int(per_sync[-1])
+    if totals and syncs:
+        ledger = totals[-1] / syncs
+        out["wire_bytes_per_sync_ledger"] = int(ledger)
+        if per_sync:
+            out["wire_match"] = bool(abs(ledger - per_sync[-1]) < 0.5)
+    if args.json:
+        print(json.dumps(out))
+        return
+    for k, v in out.items():
+        print(f"{k:>28}: {v}")
 
 
 def main(argv: list[str] | None = None) -> None:

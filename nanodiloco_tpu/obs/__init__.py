@@ -1,22 +1,37 @@
-"""Host-side observability: span tracer, training watchdog, live status.
+"""Host-side observability: tracer, watchdog, telemetry, cost analytics.
 
 The DiLoCo value proposition is a RATIO — compute time over
 communication time (arXiv:2311.08105) — and a production run must be
 able to show where every millisecond of a round goes (``tracer``), be
-alerted when the run silently degrades (``watchdog``), and account for
-every wire byte the outer sync moves (``Diloco.sync_wire_bytes``).
-Everything here is pure host-side Python: no jax imports, no device
-work, safe to run on every step of a training loop.
+alerted when the run silently degrades (``watchdog``), account for
+every wire byte the outer sync moves (``Diloco.sync_wire_bytes``),
+answer a live scrape over HTTP (``telemetry``), and reconcile measured
+throughput against what XLA says the program costs (``costs``).
+Everything here is stdlib host-side Python — no new dependencies, no
+device work; only ``costs`` touches jax, and lazily, to read the
+compiler's own cost model.
 """
 
-from nanodiloco_tpu.obs.tracer import SpanTracer, current_tracer, set_tracer, trace_span
+from nanodiloco_tpu.obs.tracer import (
+    SpanTracer,
+    current_tracer,
+    merge_chrome_traces,
+    set_tracer,
+    trace_shard_path,
+    trace_span,
+)
 from nanodiloco_tpu.obs.watchdog import Watchdog, WatchdogConfig
+from nanodiloco_tpu.obs.telemetry import TelemetryServer, parse_metrics_text
 
 __all__ = [
     "SpanTracer",
     "current_tracer",
+    "merge_chrome_traces",
     "set_tracer",
+    "trace_shard_path",
     "trace_span",
     "Watchdog",
     "WatchdogConfig",
+    "TelemetryServer",
+    "parse_metrics_text",
 ]

@@ -1,0 +1,254 @@
+"""Live telemetry endpoint: /metrics (OpenMetrics) + /healthz, stdlib only.
+
+A production pod is SCRAPED, not tailed: a Prometheus poller, a load
+balancer's health check, or an operator's curl must be able to ask a
+RUNNING job "are you healthy, what is your round budget, how many wire
+bytes have you moved" without ssh-ing in and parsing an unbounded
+JSONL. This server is ``http.server`` on a daemon thread — no new
+dependencies, nothing when the port is unset — and its gauges are fed
+from the SAME ``MetricsLogger.log()`` path that writes the JSONL, so
+the scrape and the file can never tell different stories.
+
+Endpoints:
+- ``GET /metrics`` — OpenMetrics text: last loss/eval loss/tokens-per-
+  sec/comm-share, wire bytes (per-sync gauge + running total), per-
+  phase round-budget seconds (``phase`` label), alarm counters by
+  ``kind``, HBM peak, outer-sync count, step, analytic FLOPs/token
+  when a cost record was captured.
+- ``GET /healthz`` — 200/503 + the watchdog's status document (the
+  same state ``--status-file`` writes, now pull-able). 503 when the
+  run is stalled or crashed, or when a ``nan_loss`` alarm has fired (a
+  NaN poisons every later step — the job is unhealthy even though the
+  loop still turns). Loss spikes and throughput dips stay 200: they
+  are alerts, not liveness failures.
+
+The server binds ``port`` on all interfaces (a scraper is usually not
+on the host); ``port=0`` picks a free port, exposed as ``.port`` (and
+printed by the train loop) — the form tests and one-off runs use.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+
+# JSONL key -> (metric name, help). All gauges: "last observed value".
+_GAUGE_KEYS = {
+    "loss": ("nanodiloco_loss", "last logged training loss"),
+    "eval_loss": ("nanodiloco_eval_loss", "last held-out eval loss"),
+    "perplexity": ("nanodiloco_perplexity", "last training perplexity"),
+    "lr": ("nanodiloco_lr", "current inner learning rate"),
+    "step": ("nanodiloco_step", "last logged real (inner) step"),
+    "tokens_per_sec": (
+        "nanodiloco_tokens_per_sec", "cumulative training throughput"
+    ),
+    "comm_share": (
+        "nanodiloco_comm_share",
+        "outer-sync share of wall clock (the DiLoCo ratio)",
+    ),
+    "avg_sync_time_s": (
+        "nanodiloco_avg_sync_time_seconds", "mean outer-sync wall clock"
+    ),
+    "wire_bytes_per_sync": (
+        "nanodiloco_wire_bytes_per_sync", "per-worker wire bytes per outer sync"
+    ),
+    "hbm_peak_bytes": (
+        "nanodiloco_hbm_peak_bytes", "peak device memory in use"
+    ),
+    "quarantined_workers": (
+        "nanodiloco_quarantined_workers", "workers masked out of the last sync"
+    ),
+}
+
+
+class TelemetryServer:
+    """Scrapeable mirror of the metrics stream. ``observe(rec)`` is
+    called by ``MetricsLogger.log`` with every record (metrics AND
+    alarms — one source of truth); ``health_fn`` returns the watchdog's
+    status document on each /healthz hit (live state, not a cached
+    copy). Thread-safe: the HTTP threads read under the same lock the
+    train loop writes under."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "0.0.0.0",
+        health_fn: Callable[[], dict] | None = None,
+    ) -> None:
+        self._health_fn = health_fn
+        self._lock = threading.Lock()
+        self._gauges: dict[str, float] = {}
+        self._phases: dict[str, float] = {}
+        self._alarms: dict[str, int] = {}
+        self._outer_syncs = 0
+        self._wire_total = 0.0
+        self._thread: threading.Thread | None = None
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # a scrape must not spam stdout
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = server.render_metrics().encode()
+                    ctype = OPENMETRICS_CONTENT_TYPE
+                    code = 200
+                elif path == "/healthz":
+                    code, doc = server.health()
+                    body = (json.dumps(doc) + "\n").encode()
+                    ctype = "application/json"
+                else:
+                    code, body, ctype = 404, b"not found\n", "text/plain"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "TelemetryServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="nanodiloco-telemetry",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- ingest (the MetricsLogger.log path) ---------------------------------
+
+    def observe(self, rec: dict[str, Any]) -> None:
+        with self._lock:
+            for k, v in rec.items():
+                if v is None:
+                    continue
+                if k == "alarm":
+                    self._alarms[str(v)] = self._alarms.get(str(v), 0) + 1
+                elif k == "outer_synced":
+                    self._outer_syncs += int(bool(v))
+                elif k == "wire_bytes_total":
+                    self._wire_total = float(v)
+                elif k.startswith("t_") and isinstance(v, (int, float)):
+                    self._phases[k[2:]] = float(v)
+                elif k == "cost_analysis" and isinstance(v, dict):
+                    fpt = v.get("flops_per_token")
+                    if isinstance(fpt, (int, float)):
+                        self._gauges["nanodiloco_flops_per_token"] = float(fpt)
+                elif k in _GAUGE_KEYS and isinstance(v, (int, float)):
+                    self._gauges[_GAUGE_KEYS[k][0]] = float(v)
+
+    # -- render --------------------------------------------------------------
+
+    def render_metrics(self) -> str:
+        """OpenMetrics text. Counters use the spec's family-name /
+        ``_total``-sample split; ``# EOF`` terminates the exposition
+        (a truncated scrape must be detectable as truncated)."""
+        with self._lock:
+            gauges = dict(self._gauges)
+            phases = dict(self._phases)
+            alarms = dict(self._alarms)
+            syncs = self._outer_syncs
+            wire_total = self._wire_total
+        helps = {name: h for name, h in _GAUGE_KEYS.values()}
+        helps["nanodiloco_flops_per_token"] = (
+            "analytic FLOPs per token from the lowered program's "
+            "XLA cost analysis"
+        )
+        lines: list[str] = []
+        for name in sorted(gauges):
+            lines.append(f"# TYPE {name} gauge")
+            if name in helps:
+                lines.append(f"# HELP {name} {helps[name]}")
+            lines.append(f"{name} {_fmt(gauges[name])}")
+        if phases:
+            lines.append("# TYPE nanodiloco_phase_seconds gauge")
+            lines.append(
+                "# HELP nanodiloco_phase_seconds last round's host-side "
+                "phase budget"
+            )
+            for ph in sorted(phases):
+                lines.append(
+                    f'nanodiloco_phase_seconds{{phase="{ph}"}} '
+                    f"{_fmt(phases[ph])}"
+                )
+        lines.append("# TYPE nanodiloco_alarms counter")
+        lines.append("# HELP nanodiloco_alarms watchdog alarms by kind")
+        for kind in sorted(alarms):
+            lines.append(
+                f'nanodiloco_alarms_total{{kind="{kind}"}} {alarms[kind]}'
+            )
+        lines.append(f"nanodiloco_alarms_total {sum(alarms.values())}")
+        lines.append("# TYPE nanodiloco_outer_syncs counter")
+        lines.append(f"nanodiloco_outer_syncs_total {syncs}")
+        lines.append("# TYPE nanodiloco_wire_bytes counter")
+        lines.append(
+            "# HELP nanodiloco_wire_bytes cumulative per-worker outer-sync "
+            "wire bytes"
+        )
+        lines.append(f"nanodiloco_wire_bytes_total {_fmt(wire_total)}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+    def health(self) -> tuple[int, dict]:
+        """(status code, document). Unhealthy (503) = stalled, crashed,
+        or any ``nan_loss`` alarm on record; everything else — spikes,
+        throughput dips, a finished run — reports 200 with the detail
+        in the body."""
+        if self._health_fn is None:
+            return 200, {"state": "unknown", "healthy": True}
+        try:
+            doc = dict(self._health_fn())
+        except Exception as e:  # a broken probe is itself unhealthy
+            return 503, {"state": "error", "healthy": False, "error": str(e)}
+        kinds = doc.get("alarm_kinds") or {}
+        unhealthy = (
+            doc.get("state") in ("stalled", "crashed")
+            or kinds.get("nan_loss", 0) > 0
+        )
+        doc["healthy"] = not unhealthy
+        return (503 if unhealthy else 200), doc
+
+
+def _fmt(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() and abs(v) < 2**53 else repr(v)
+
+
+def parse_metrics_text(text: str) -> dict[str, float]:
+    """Parse an OpenMetrics exposition into ``{sample_name: value}``
+    with the label set kept verbatim in the key (e.g.
+    ``nanodiloco_alarms_total{kind="nan_loss"}``). The consumer half of
+    the scrape loop (tests, chip_agenda's telemetry phase) — tolerant
+    of unknown lines, strict about nothing."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out

@@ -50,12 +50,22 @@ class SpanTracer:
         self,
         clock: Callable[[], float] = time.perf_counter,
         max_events: int = 500_000,
+        process_index: int = 0,
     ) -> None:
         self._clock = clock
         self._max_events = max_events
+        # which process of a multi-host pod this tracer records; carried
+        # in the export's metadata so merge_chrome_traces can assign
+        # stable pids (the train loop passes jax.process_index() — this
+        # module itself stays jax-free)
+        self.process_index = int(process_index)
         self._lock = threading.Lock()
         self._events: list[dict[str, Any]] = []
         self._dropped = 0
+        # tid -> human thread name, recorded at span time so the export
+        # can emit Chrome thread_name metadata (Perfetto then shows
+        # main/prefetch/watchdog lanes instead of raw get_ident() ints)
+        self._thread_names: dict[int, str] = {}
         self._local = threading.local()
         # wall-clock anchor: trace timestamps are perf_counter-relative;
         # recording the pairing at construction lets the export carry an
@@ -86,16 +96,19 @@ class SpanTracer:
         finally:
             stack.pop()
             t1 = self._clock()
+            tid = threading.get_ident()
             ev = {
                 "name": name,
                 "t0": t0,
                 "dur": t1 - t0,
                 "depth": depth,
-                "tid": threading.get_ident(),
+                "tid": tid,
             }
             if args:
                 ev["args"] = args
             with self._lock:
+                if tid not in self._thread_names:
+                    self._thread_names[tid] = threading.current_thread().name
                 self._events.append(ev)
                 if len(self._events) > self._max_events:
                     drop = len(self._events) - self._max_events
@@ -122,12 +135,32 @@ class SpanTracer:
     def to_chrome(self) -> dict[str, Any]:
         """Chrome trace-event JSON object (the ``{"traceEvents": [...]}``
         form). Complete ("X") events; nesting is implied by containment
-        on the same tid, which Perfetto renders as a flame graph."""
+        on the same tid, which Perfetto renders as a flame graph.
+        Metadata ("M") events name the process (``rank{k}``) and each
+        thread, so the timeline shows ``main``/``prefetch`` lanes, not
+        raw thread-id integers."""
         pid = os.getpid()
         with self._lock:
             events = list(self._events)
             dropped = self._dropped
-        tev = [
+            thread_names = dict(self._thread_names)
+        tev: list[dict[str, Any]] = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": f"nanodiloco rank{self.process_index}"},
+            }
+        ]
+        for tid, tname in sorted(thread_names.items()):
+            tev.append({
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": tname},
+            })
+        tev += [
             {
                 "name": e["name"],
                 "ph": "X",
@@ -145,6 +178,7 @@ class SpanTracer:
             "otherData": {
                 "tracer": "nanodiloco_tpu.obs",
                 "wall_start_unix": self._wall0,
+                "process_index": self.process_index,
                 **({"dropped_events": dropped} if dropped else {}),
             },
         }
@@ -206,3 +240,77 @@ def trace_span(name: str, **args: Any):
     race mid-span still closes the span on the tracer that opened it."""
     with _current.span(name, **args) as t:
         yield t
+
+
+def trace_shard_path(path: str, process_index: int) -> str:
+    """Where process ``k`` of a pod writes its trace shard:
+    ``trace.json`` -> ``trace.rank1.json`` etc. Rank 0 keeps the
+    requested path unchanged, so single-process behaviour (and every
+    existing consumer of ``--trace-out``) is untouched."""
+    if process_index == 0:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.rank{process_index}{ext or '.json'}"
+
+
+def merge_chrome_traces(docs: list[dict[str, Any]]) -> dict[str, Any]:
+    """Fold per-process trace shards into ONE Chrome trace: ``pid`` =
+    process index, timestamps re-anchored onto a common wall clock, and
+    process/thread-name metadata rewritten per pid — so the 2-process
+    multihost run renders as a single Perfetto timeline where both
+    hosts' ``sync`` spans line up (outer-step skew, finally visible).
+
+    Alignment uses each shard's ``wall_start_unix`` anchor (recorded at
+    tracer construction): shard timestamps are perf_counter-relative,
+    so shifting each by ``(wall0_k - min(wall0)) * 1e6`` puts every
+    shard on the earliest shard's clock. Shards without an anchor (a
+    foreign trace) merge unshifted. Pid collisions (two shards both
+    claiming rank 0) fall back to ordinal pids — the merge must never
+    silently overlay two processes onto one lane."""
+    if not docs:
+        raise ValueError("no trace shards to merge")
+    anchors = [
+        (doc.get("otherData") or {}).get("wall_start_unix") for doc in docs
+    ]
+    known = [a for a in anchors if isinstance(a, (int, float))]
+    base = min(known) if known else None
+    merged: list[dict[str, Any]] = []
+    used_pids: set[int] = set()
+    for i, (doc, anchor) in enumerate(zip(docs, anchors)):
+        other = doc.get("otherData") or {}
+        pid = other.get("process_index")
+        if not isinstance(pid, int) or pid in used_pids:
+            pid = i
+            while pid in used_pids:
+                pid += 1
+        used_pids.add(pid)
+        shift_us = (
+            (anchor - base) * 1e6
+            if base is not None and isinstance(anchor, (int, float))
+            else 0.0
+        )
+        saw_process_name = False
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            if ev.get("ph") == "M":
+                saw_process_name |= ev.get("name") == "process_name"
+            elif "ts" in ev:
+                ev["ts"] = ev["ts"] + shift_us
+            merged.append(ev)
+        if not saw_process_name:
+            merged.append({
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": f"nanodiloco rank{pid}"},
+            })
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tracer": "nanodiloco_tpu.obs merge-trace",
+            "merged_shards": len(docs),
+            **({"wall_start_unix": base} if base is not None else {}),
+        },
+    }

@@ -73,7 +73,9 @@ class Watchdog:
         self._last_beat: float | None = None
         self._last_step = 0
         self._alarm_count = 0
+        self._alarm_kinds: dict[str, int] = {}
         self._last_alarm: dict | None = None
+        self._final_state: str | None = None  # set by stop()
         # per-kind armed flags: one alarm per episode
         self._armed = {"nan_loss": True, "loss_spike": True,
                        "throughput_collapse": True, "stall": True}
@@ -89,6 +91,7 @@ class Watchdog:
                 return
             self._armed[kind] = False
             self._alarm_count += 1
+            self._alarm_kinds[kind] = self._alarm_kinds.get(kind, 0) + 1
             rec = {"alarm": kind, "step": step, **detail}
             self._last_alarm = rec
         self._emit(rec)
@@ -101,6 +104,11 @@ class Watchdog:
     @property
     def alarm_count(self) -> int:
         return self._alarm_count
+
+    @property
+    def alarm_kinds(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._alarm_kinds)
 
     @property
     def last_alarm(self) -> dict | None:
@@ -207,6 +215,11 @@ class Watchdog:
         if self._thread is not None:
             self._thread.join(timeout=2 * self.cfg.poll_s + 1)
             self._thread = None
+        with self._lock:
+            # status_doc() answers with this from now on — a /healthz
+            # probe after teardown must see crashed/finished, not a
+            # stale "running"
+            self._final_state = final_status
         self._write_status(state=final_status)
 
     def _poll_loop(self) -> None:
@@ -217,7 +230,29 @@ class Watchdog:
                 # the watchdog must never take the training loop down
                 pass
 
-    # -- status.json ---------------------------------------------------------
+    # -- status.json / live status ------------------------------------------
+
+    def _status_doc_locked(self, state: str) -> dict:
+        """Build the status document; caller holds ``self._lock``."""
+        stalled = not self._armed["stall"]
+        return {
+            "state": "stalled" if (state == "running" and stalled) else state,
+            "step": self._last_step,
+            "updated_unix": time.time(),
+            "alarms": self._alarm_count,
+            **({"alarm_kinds": dict(self._alarm_kinds)}
+               if self._alarm_kinds else {}),
+            **({"last_alarm": self._last_alarm} if self._last_alarm else {}),
+            **self._status_extra,
+        }
+
+    def status_doc(self) -> dict:
+        """The live status document — exactly what ``--status-file``
+        writes, but returned in-process so a PULL consumer (the
+        telemetry server's /healthz) never has to round-trip through
+        disk. After ``stop()`` it reports the final state."""
+        with self._lock:
+            return self._status_doc_locked(self._final_state or "running")
 
     def _write_status(self, state: str = "running") -> None:
         if not self.status_path:
@@ -228,15 +263,7 @@ class Watchdog:
         # publish garbled JSON — the exact torn state tmp+rename exists
         # to prevent
         with self._lock:
-            stalled = not self._armed["stall"]
-            doc = {
-                "state": "stalled" if (state == "running" and stalled) else state,
-                "step": self._last_step,
-                "updated_unix": time.time(),
-                "alarms": self._alarm_count,
-                **({"last_alarm": self._last_alarm} if self._last_alarm else {}),
-                **self._status_extra,
-            }
+            doc = self._status_doc_locked(state)
             tmp = self.status_path + ".tmp"
             try:
                 d = os.path.dirname(os.path.abspath(self.status_path))

@@ -304,18 +304,20 @@ class Diloco:
         # not key on memory kind, so feeding a host buffer into the
         # device-compiled executable fails at runtime (round-5 review
         # finding; no-op without offload_snapshot).
-        _inner_jit = self._with_mesh(
-            jax.jit(self._inner_step, donate_argnums=(0,))
-        )
-        self.inner_step = lambda state, *a: _inner_jit(self._fetch(state), *a)
+        # the raw jit objects are kept (not just the wrapped callables):
+        # cost analytics lowers them AOT without executing
+        # (round_cost_analysis — jax.stages.Lowered has no donation or
+        # dispatch side effects, so the probe never touches state)
+        self._inner_jit = jax.jit(self._inner_step, donate_argnums=(0,))
+        _inner_call = self._with_mesh(self._inner_jit)
+        self.inner_step = lambda state, *a: _inner_call(self._fetch(state), *a)
         _outer_jit = self._with_mesh(
             jax.jit(self._outer_step_state, donate_argnums=(0,))
         )
         self.outer_step = lambda state, *a: _outer_jit(self._fetch(state), *a)
-        _round_jit = self._with_mesh(
-            jax.jit(self._round_step, donate_argnums=(0,))
-        )
-        self.round_step = lambda state, *a: _round_jit(self._fetch(state), *a)
+        self._round_jit = jax.jit(self._round_step, donate_argnums=(0,))
+        _round_call = self._with_mesh(self._round_jit)
+        self.round_step = lambda state, *a: _round_call(self._fetch(state), *a)
         # H inner steps with NO outer sync: same dispatch count as
         # round_step, so differencing the two isolates the outer
         # all-reduce's true wall clock even in fused mode (the metric the
@@ -1187,6 +1189,78 @@ class Diloco:
                 best = min(best, time.perf_counter() - t0)
         del probe
         return best
+
+    # -- XLA cost analytics (obs/costs) --------------------------------------
+
+    def _jit_cost_analysis(self, jit_fn, state: DilocoState, *args):
+        """``{"flops", "bytes_accessed"}`` from XLA's cost model for one
+        of this instance's jitted programs, or None when the backend's
+        cost model yields nothing. Lowering only — a host-side trace +
+        StableHLO emission, NOT a second XLA compile — and the state is
+        never consumed (donation applies at execution, which never
+        happens here). ``_fetch`` mirrors the real call path so an
+        offloaded snapshot lowers with device shardings."""
+        from nanodiloco_tpu.obs.costs import lowered_cost
+
+        try:
+            fetched = self._fetch(state)
+            if self.mesh.size > 1:
+                with jax.set_mesh(self.mesh):
+                    lowered = jit_fn.lower(fetched, *args)
+            else:
+                lowered = jit_fn.lower(fetched, *args)
+            return lowered_cost(lowered)
+        except Exception:
+            # analytics must never take down training: an exotic
+            # sharding the AOT path can't lower just means "no record"
+            return None
+
+    def round_cost_analysis(self, state: DilocoState, tokens, loss_mask):
+        """Cost analysis of the FUSED round program (H inner steps +
+        outer sync as one executable) — the program a fused training
+        run actually dispatches, so its FLOPs are the honest numerator
+        for analytic MFU."""
+        return self._jit_cost_analysis(self._round_jit, state, tokens, loss_mask)
+
+    def inner_cost_analysis(self, state: DilocoState, tokens, loss_mask):
+        """Cost analysis of one inner step — the stepwise path's unit of
+        dispatch (the outer sync's FLOPs are a rounding error next to
+        H steps of fwd+bwd, so per-token numbers match the fused
+        program's)."""
+        return self._jit_cost_analysis(self._inner_jit, state, tokens, loss_mask)
+
+    def microbatch_cost_analysis(self, state: DilocoState, batch_shape):
+        """Per-token-normalizable cost analysis: ONE microbatch's
+        fwd+bwd (``loss_fn`` value_and_grad at ``batch_shape`` =
+        [B, S]) lowered with every scan force-unrolled, so XLA bills
+        all L layers and every CE chunk instead of one loop body each
+        (obs/costs loop caveat — the dispatched executable's own
+        numbers cannot be normalized per token). Abstract inputs (one
+        worker's unstacked param shapes), never compiled or executed.
+        Optimizer/outer-sync FLOPs are excluded — the same scope as the
+        hand formula this number reconciles against. None when the
+        probe can't lower (e.g. a manual-collective loss path)."""
+        from nanodiloco_tpu.obs.costs import lowered_cost, unrolled_scans
+
+        try:
+            p1 = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype),
+                state.params,
+            )
+            tok = jax.ShapeDtypeStruct(tuple(batch_shape), jnp.int32)
+
+            def probe(p, t, m):
+                return jax.value_and_grad(self.loss_fn, has_aux=True)(p, t, m)
+
+            with unrolled_scans():
+                if self.mesh.size > 1:
+                    with jax.set_mesh(self.mesh):
+                        lowered = jax.jit(probe).lower(p1, tok, tok)
+                else:
+                    lowered = jax.jit(probe).lower(p1, tok, tok)
+            return lowered_cost(lowered)
+        except Exception:
+            return None
 
     # -- snapshot host offload (ref diloco.py:27-32, made async) -------------
 

@@ -237,10 +237,24 @@ class CheckpointManager:
                         item[k] = jax.tree.map(lambda _: ocp.PLACEHOLDER, v)
                         rargs[k] = jax.tree.map(lambda _: ocp.RestoreArgs(), v)
                     else:
-                        # legacy orbax: no skip-the-read — restore onto
-                        # one device and discard (modern orbax keeps the
-                        # memory saving)
-                        sd = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+                        # legacy orbax: no skip-the-read — restore the
+                        # discarded leaves anyway (modern orbax keeps
+                        # the memory saving). The sharding must be
+                        # addressable from EVERY process: a
+                        # SingleDeviceSharding of global device 0 is
+                        # foreign to every other pod process and orbax
+                        # deadlocks on it at the first multi-process
+                        # elastic resume (found by the newly-runnable
+                        # 2-process elastic test) — replicate over all
+                        # devices instead
+                        import numpy as _np
+
+                        rep_mesh = jax.sharding.Mesh(
+                            _np.array(jax.devices()), ("all",)
+                        )
+                        sd = jax.sharding.NamedSharding(
+                            rep_mesh, jax.sharding.PartitionSpec()
+                        )
                         item[k] = jax.tree.map(
                             lambda m: jax.ShapeDtypeStruct(
                                 m.shape, m.dtype, sharding=sd

@@ -67,6 +67,14 @@ class MetricsLogger:
     ) -> None:
         self.run_name = run_name
         self.quiet = quiet
+        # ALWAYS set, even for file-less runs and non-writer ranks: any
+        # consumer probing logger.path must read None, not AttributeError
+        self.path: str | None = None
+        # optional live scrape mirror (obs/telemetry.TelemetryServer):
+        # every record log() writes also updates its gauges, so the
+        # /metrics endpoint and the JSONL can never disagree. Assigned
+        # by the train loop after construction; None costs nothing.
+        self.telemetry = None
         # the watchdog's heartbeat thread emits alarm records through
         # log() concurrently with the train loop's metrics — one lock
         # keeps JSONL lines whole (a torn line is exactly the corruption
@@ -113,6 +121,11 @@ class MetricsLogger:
                 self._file.flush()
             if self._wandb:
                 self._wandb.log(rec)
+        if self.telemetry is not None:
+            try:
+                self.telemetry.observe(rec)
+            except Exception:
+                pass  # a scrape-mirror bug must never take down training
         if not self.quiet:
             parts = " ".join(
                 f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
@@ -129,13 +142,12 @@ class MetricsLogger:
                 self._wandb.finish()
 
 
-def summarize_run(path: str) -> dict[str, Any]:
-    """One-screen summary of a training JSONL (the ``report`` CLI): loss
-    and eval trajectory, throughput, sync share, and — when the run
-    recorded them — quarantine events, HBM peak, and MoE router health.
-    Keys appear only when the underlying metric was logged, mirroring
-    the logger's own never-fake-zeros schema."""
-    recs = []
+def read_jsonl_records(path: str) -> tuple[list[dict], int]:
+    """``(records, torn_line_count)`` from a run JSONL. A live writer
+    mid-append (or a crash) leaves a torn trailing line; every consumer
+    (``report``, ``report cost``, compare) must read the valid records,
+    not traceback — ONE implementation of that tolerance."""
+    recs: list[dict] = []
     torn = 0
     with open(path) as f:
         for line in f:
@@ -145,10 +157,28 @@ def summarize_run(path: str) -> dict[str, Any]:
             try:
                 recs.append(json.loads(line))
             except json.JSONDecodeError:
-                # a live writer mid-append (or a crash) leaves a torn
-                # trailing line; an operator report must summarize the
-                # valid records, not traceback
                 torn += 1
+    return recs, torn
+
+
+def find_cost_record(recs: list[dict]) -> dict | None:
+    """The run's one-time ``cost_analysis`` record (obs/costs), or None
+    — shared by ``summarize_run`` and ``report cost`` so the two can
+    never disagree about which record counts."""
+    return next(
+        (r["cost_analysis"] for r in recs
+         if isinstance(r.get("cost_analysis"), dict)),
+        None,
+    )
+
+
+def summarize_run(path: str) -> dict[str, Any]:
+    """One-screen summary of a training JSONL (the ``report`` CLI): loss
+    and eval trajectory, throughput, sync share, and — when the run
+    recorded them — quarantine events, HBM peak, and MoE router health.
+    Keys appear only when the underlying metric was logged, mirroring
+    the logger's own never-fake-zeros schema."""
+    recs, torn = read_jsonl_records(path)
     if not recs:
         raise ValueError(f"no metric records in {path}")
 
@@ -213,6 +243,20 @@ def summarize_run(path: str) -> dict[str, Any]:
         vals = series(k)
         if vals:
             out[f"{k}_mean_s"] = round(sum(vals) / len(vals), 4)
+    # XLA cost analytics (obs/costs): the one-time cost_analysis record
+    # turns measured throughput into an analytic MFU — computed here so
+    # report compare can gate it without touching the backend
+    cost = find_cost_record(recs)
+    if cost:
+        fpt = cost.get("flops_per_token")
+        if fpt:
+            out["flops_per_token_analytic"] = round(float(fpt), 1)
+        if tps:
+            from nanodiloco_tpu.obs.costs import analytic_mfu
+
+            mfu = analytic_mfu(cost, tps[-1])
+            if mfu is not None:
+                out["mfu_analytic"] = round(mfu, 5)
     return out
 
 
@@ -223,6 +267,11 @@ _COMPARE_METRICS = [
     ("best_loss", True),
     ("tokens_per_sec_last", False),
     ("comm_share_last", True),
+    # analytic MFU (obs/costs cost record x measured tokens/sec): gated
+    # only when BOTH summaries carry it — compare_runs' missing-metric
+    # rule — so runs without a captured peak never fail on it. Shares
+    # the throughput direction/threshold: it IS throughput, normalized.
+    ("mfu_analytic", False),
 ]
 
 

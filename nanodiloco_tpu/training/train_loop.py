@@ -137,6 +137,17 @@ class TrainConfig:
     # live status.json (atomic rewrite) for external pollers: state,
     # step, last loss/throughput, alarm count
     status_file: str | None = None
+    # live telemetry endpoint (obs/telemetry.py): /metrics OpenMetrics
+    # text + /healthz 200/503 on an http.server daemon thread, gauges
+    # fed from the MetricsLogger.log path. None = no server, no cost;
+    # 0 = pick a free port (printed). Rank 0 only on a pod.
+    metrics_port: int | None = None
+    # capture XLA's cost_analysis of the dispatched program once at
+    # startup and log it into the JSONL ({"cost_analysis": {...}}):
+    # analytic FLOPs/token + the chip peak, the inputs `report cost`
+    # and the mfu_analytic compare gate reconcile against measured
+    # throughput. One-time host-side lowering (no second XLA compile).
+    cost_analysis: bool = True
     # watchdog sentinel thresholds (obs/watchdog.py): loss-spike
     # z-score over a rolling window, throughput collapse vs the rolling
     # median, stalled-round factor over the rolling round time
@@ -510,7 +521,10 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     # don't retain it (max_events=0 drops each event on close); the
     # per-phase t_* totals are accumulated separately and still flow
     # into the JSONL either way
-    tracer = SpanTracer(max_events=500_000 if cfg.trace_out else 0)
+    tracer = SpanTracer(
+        max_events=500_000 if cfg.trace_out else 0,
+        process_index=jax.process_index(),
+    )
     prev_tracer = set_tracer(tracer)
     watchdog = Watchdog(
         WatchdogConfig(
@@ -523,6 +537,34 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         status_path=cfg.status_file if logger.is_writer else None,
     )
     watchdog.start()
+    # live telemetry endpoint (obs/telemetry.py): /metrics gauges are fed
+    # by the logger's own log() path (one source of truth with the
+    # JSONL); /healthz pulls the watchdog's live status document — the
+    # same state --status-file writes, now scrapeable. Rank-0 only: the
+    # gauges mirror the single pod-wide metrics stream. No port, no
+    # server, no cost.
+    telemetry = None
+    if cfg.metrics_port is not None and logger.is_writer:
+        from nanodiloco_tpu.obs.telemetry import TelemetryServer
+
+        try:
+            telemetry = TelemetryServer(
+                port=cfg.metrics_port, health_fn=watchdog.status_doc
+            ).start()
+            logger.telemetry = telemetry
+            if not quiet:
+                print(
+                    f"[nanodiloco] telemetry: port {telemetry.port} "
+                    "(/metrics, /healthz)"
+                )
+        except OSError as e:
+            telemetry = None
+            if not quiet:
+                print(
+                    f"[nanodiloco] warning: telemetry server failed to "
+                    f"bind port {cfg.metrics_port}: {e}; continuing "
+                    "without the endpoint"
+                )
     # per-sync wire ledger from the ACTUAL synced tree (fit_vocab
     # shrinks included); per WORKER — a single-worker run's "wire"
     # never leaves the chip, the numbers then describe the sync's
@@ -579,6 +621,42 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         tokens_per_step = (
             cfg.num_workers * cfg.grad_accum * cfg.per_device_batch_size * row_len
         )
+
+        def log_cost(billed, program: str) -> None:
+            """Log the one-time XLA cost_analysis record (obs/costs):
+            the dispatched executable's raw billed numbers, a per-token
+            FLOPs figure from the unrolled one-microbatch probe, the
+            hand formula at the SAME shapes (fit_vocab shrinks
+            included), and the chip peak known now — everything `report
+            cost` and the mfu_analytic gate need from the JSONL alone."""
+            probe = dl.microbatch_cost_analysis(
+                state, (cfg.per_device_batch_size, row_len)
+            )
+            if not billed and not probe:
+                if not quiet:
+                    print(
+                        "[nanodiloco] cost_analysis: backend reported no "
+                        "usable cost model for this program; skipping"
+                    )
+                return
+            from nanodiloco_tpu.obs.costs import build_cost_record
+
+            logger.log(
+                {
+                    "cost_analysis": build_cost_record(
+                        program=program,
+                        billed=billed,
+                        probe=probe,
+                        probe_tokens=cfg.per_device_batch_size * row_len,
+                        num_devices=mesh.size,
+                        model_cfg=model_cfg,
+                        seq=row_len,
+                        moe_tokens=cfg.per_device_batch_size * row_len,
+                    )
+                },
+                step=start_step,
+            )
+
         # deterministic O(1) resume positioning (no replayed gathers)
         batches = batcher.iter_from(start_step)
 
@@ -645,6 +723,16 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     with trace_span("data"):
                         toks, masks = pending.result()
                     pending = None
+                    if cfg.cost_analysis and rnd == first_round:
+                        # once, on the real round arguments (an AOT
+                        # lowering — host-side, no second XLA compile,
+                        # state untouched), BEFORE the dispatch below
+                        # donates the state buffers
+                        with trace_span("cost_analysis"):
+                            log_cost(
+                                dl.round_cost_analysis(state, toks, masks),
+                                "fused_round",
+                            )
                     measuring = cfg.measure_comm and est_inner_s is None
                     if rnd < last_round and not measuring:
                         pending = prefetcher.submit(dl.stack_round_batches, batches)
@@ -842,6 +930,18 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 profiling = True
             with trace_span("data"):
                 tokens, mask = next(batches)
+            if cfg.cost_analysis and real_step == start_step + 1 and not streaming:
+                # stepwise unit of dispatch: one inner step (the outer
+                # sync's FLOPs are a rounding error next to H of these);
+                # streaming's fragment-fused step program isn't lowered
+                # standalone — its runs rely on the fused-round capture
+                with trace_span("cost_analysis"):
+                    log_cost(
+                        dl.inner_cost_analysis(
+                            state, dl.feed(tokens), dl.feed(mask)
+                        ),
+                        "inner_step",
+                    )
             t0 = time.perf_counter()
             if streaming:
                 # fragment launches/applies are fused into the jitted step and
@@ -974,23 +1074,29 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     cfg.inner_steps * tokens_per_step / max(now - round_t0, 1e-9),
                 )
                 round_t0 = now
-            logger.log(
-                {
-                    **eval_metrics,
-                    "loss": last_loss,
-                    "perplexity": float(np.exp(min(last_loss, 50.0))),
-                    "lr": float(schedule(real_step - 1)),
-                    "effective_step": real_step * cfg.num_workers,
-                    "total_samples": real_step * cfg.batch_size * cfg.num_workers,
-                    "tokens_per_sec": tps,
-                    "outer_synced": int(synced),
-                    "avg_sync_time_s": sync_timer.avg_sync_time,
-                    "comm_share": sync_timer.total / total_time if total_time else 0.0,
-                    **round_budget,
-                    **sync_extras,
-                },
-                step=real_step,
-            )
+            # same phase name as the fused path: the logging tail is real
+            # per-step wall clock and must show in the trace/round budget,
+            # not as an unattributed gap (its seconds land in the NEXT
+            # round's t_log, as in fused mode — the span is still open
+            # when phase_totals snapshots above)
+            with trace_span("log"):
+                logger.log(
+                    {
+                        **eval_metrics,
+                        "loss": last_loss,
+                        "perplexity": float(np.exp(min(last_loss, 50.0))),
+                        "lr": float(schedule(real_step - 1)),
+                        "effective_step": real_step * cfg.num_workers,
+                        "total_samples": real_step * cfg.batch_size * cfg.num_workers,
+                        "tokens_per_sec": tps,
+                        "outer_synced": int(synced),
+                        "avg_sync_time_s": sync_timer.avg_sync_time,
+                        "comm_share": sync_timer.total / total_time if total_time else 0.0,
+                        **round_budget,
+                        **sync_extras,
+                    },
+                    step=real_step,
+                )
 
         if profiling:
             jax.profiler.stop_trace()
@@ -1016,12 +1122,25 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         # restore the previous tracer, and export the Chrome trace —
         # after a crash it shows exactly which phase the run died in.
         watchdog.stop("finished" if completed else "crashed")
+        if telemetry is not None:
+            # after watchdog.stop so a last-instant scrape reads the
+            # terminal state, before logger.finish so no observe() ever
+            # races a closed logger
+            telemetry.stop()
         set_tracer(prev_tracer)
-        if cfg.trace_out and logger.is_writer:
+        if cfg.trace_out:
+            # every process exports: rank 0 to the requested path,
+            # rank k to the rank-tagged shard next to it — `report
+            # merge-trace` folds them into one Perfetto timeline with
+            # pid = process index (the first direct picture of
+            # outer-step skew across a pod)
+            from nanodiloco_tpu.obs.tracer import trace_shard_path
+
+            out_path = trace_shard_path(cfg.trace_out, jax.process_index())
             try:
-                tracer.export_chrome(cfg.trace_out)
+                tracer.export_chrome(out_path)
                 if not quiet:
-                    print(f"[nanodiloco] host span trace -> {cfg.trace_out}")
+                    print(f"[nanodiloco] host span trace -> {out_path}")
             except OSError:
                 pass  # a full disk must not mask the real outcome
         logger.finish()

@@ -281,7 +281,11 @@ def resolve_run_name(local_name: str, max_len: int = 128) -> str:
     buf = np.zeros(max_len, np.uint8)
     enc = local_name.encode()[:max_len]
     buf[: len(enc)] = np.frombuffer(enc, np.uint8)
-    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    # .astype: some backends' broadcast returns the buffer upcast to
+    # int32 — bytes() of that interleaves three NULs per character and
+    # the run name becomes an invalid filename (seen with the gloo CPU
+    # collectives on jax 0.4.x)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf)).astype(np.uint8)
     return bytes(out).rstrip(b"\x00").decode(errors="replace")
 
 

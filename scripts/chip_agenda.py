@@ -14,7 +14,10 @@ ceremony:
      r2 asked to set ``attention_impl`` defaults from (the reference
      caps sequence at 1024, ref training_utils/utils.py:45,50; long
      context is this rebuild's differentiator);
-  3. a jax.profiler trace of a few steady-state mid-model steps.
+  3. a jax.profiler trace of a few steady-state mid-model steps;
+  4. a telemetry scrape: a short real run served over --metrics-port,
+     /healthz + /metrics pulled over the wire and the gauges recorded —
+     the production scrape path proven on the chip.
 
 Usage (each phase also runs alone):
     python scripts/chip_agenda.py               # everything
@@ -239,11 +242,101 @@ def phase_pallas() -> None:
                 os.environ[k] = v
 
 
+def phase_telemetry() -> None:
+    """Drive the live telemetry endpoint against a REAL (short) training
+    run on this backend: launch the CLI with --metrics-port, scrape
+    /healthz and /metrics over the wire while it trains, and record the
+    scraped gauges in the agenda ledger — proof the production scrape
+    path (server thread + logger mirror + watchdog health) works on the
+    chip, not just under the CPU test harness."""
+    import socket
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from nanodiloco_tpu.obs.telemetry import parse_metrics_text
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    tmp = tempfile.mkdtemp(prefix="nanodiloco-telemetry-")
+    model_cfg = os.path.join(tmp, "model.json")
+    with open(model_cfg, "w") as f:
+        # small-but-real shapes: one round compiles in minutes on the
+        # tunneled chip, seconds on CPU; the scrape window spans compile
+        json.dump({
+            "vocab_size": 2048, "hidden_size": 128, "intermediate_size": 256,
+            "num_attention_heads": 4, "num_hidden_layers": 2,
+            "max_position_embeddings": 256,
+        }, f)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nanodiloco_tpu",
+         "--total-steps", "6", "--inner-steps", "2",
+         "--batch-size", "8", "--per-device-batch-size", "4",
+         "--seq-length", "256", "--warmup-steps", "2",
+         "--llama-config-file", model_cfg, "--no-measure-comm", "--quiet",
+         "--metrics-port", str(port), "--log-dir", tmp,
+         "--run-name", "telemetry-probe"],
+        cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+    def get(path):
+        # HTTPError IS the response for a 503 healthz — the most
+        # interesting datum this phase can record; only a refused/
+        # timed-out connection means "server not up yet"
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    scraped, healthz = None, None
+    deadline = time.time() + float(
+        os.environ.get("NANODILOCO_AGENDA_TIMEOUT_TELEMETRY", "900")
+    ) - 60
+    while time.time() < deadline and proc.poll() is None:
+        try:
+            if healthz is None:
+                healthz = get("/healthz")[0]
+            m = parse_metrics_text(get("/metrics")[1])
+        except OSError:
+            time.sleep(0.2)
+            continue
+        if "nanodiloco_loss" in m:
+            scraped = m
+            break
+        time.sleep(0.1)
+    out, _ = proc.communicate()
+    if proc.returncode != 0:
+        record({"phase": "telemetry", "error": out[-400:]})
+        raise SystemExit(1)
+    if scraped is None:
+        record({"phase": "telemetry",
+                "error": "run finished before /metrics showed a loss"})
+        raise SystemExit(1)
+    record({
+        "phase": "telemetry",
+        "healthz": healthz,
+        "scraped": {
+            k: scraped[k] for k in (
+                "nanodiloco_loss", "nanodiloco_step",
+                "nanodiloco_tokens_per_sec", "nanodiloco_alarms_total",
+                "nanodiloco_outer_syncs_total", "nanodiloco_wire_bytes_total",
+                "nanodiloco_flops_per_token",
+            ) if k in scraped
+        },
+    })
+
+
 PHASES = {
     "bench": phase_bench,
     "sweep": phase_sweep,
     "pallas": phase_pallas,
     "profile": phase_profile,
+    "telemetry": phase_telemetry,
 }
 
 
@@ -280,6 +373,7 @@ PHASE_TIMEOUT_S = {
     "sweep": 3600,
     "pallas": 2700,
     "profile": 1200,
+    "telemetry": 900,
 }
 
 

@@ -46,8 +46,27 @@ def main() -> None:
     # .claude/skills/verify and tests/conftest.py)
     jax.config.update("jax_platforms", "cpu")
     n_local = args.local_devices if args.mode == "dist" else args.nproc * args.local_devices
-    jax.config.update("jax_num_cpu_devices", n_local)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_local)
+    except AttributeError:
+        # pre-0.5 jax: the option doesn't exist, but this fresh process
+        # has not initialized a backend yet, so XLA_FLAGS (read at
+        # backend INIT) still takes effect — same fallback as conftest
+        import os as _os
+
+        _os.environ["XLA_FLAGS"] = (
+            _os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_local}"
+        ).strip()
     if args.mode == "dist":
+        try:
+            # pre-0.5 jax creates the plain (collective-less) CPU client
+            # unless told otherwise, and the first all-reduce then dies
+            # with "Multiprocess computations aren't implemented on the
+            # CPU backend"; modern jax selects gloo automatically
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except AttributeError:
+            pass
         jax.distributed.initialize(
             coordinator_address=f"localhost:{args.port}",
             num_processes=args.nproc,
@@ -84,6 +103,10 @@ def main() -> None:
         checkpoint_every=1,
         quiet=False,
         measure_comm=False,
+        # every process writes a rank-tagged trace shard (trace.json /
+        # trace.rank1.json); `report merge-trace` folds them into the
+        # single cross-host timeline test_multihost asserts on
+        trace_out=os.path.join(args.out, "trace.json"),
     )
     summary = train(cfg)
     if jax.process_index() == 0:

@@ -63,11 +63,16 @@ def test_tracer_chrome_export_is_valid_and_nested(tmp_path):
         clk.t += 0.05
     path = tr.export_chrome(str(tmp_path / "trace.json"))
     doc = json.load(open(path))  # must be VALID json
-    evs = doc["traceEvents"]
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
     assert {e["name"] for e in evs} == {"outer_sync", "allreduce"}
     for e in evs:
-        assert e["ph"] == "X"
         assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+    # process/thread metadata: Perfetto lane names, not raw tid ints
+    assert any(e["name"] == "process_name" for e in meta)
+    tnames = [e for e in meta if e["name"] == "thread_name"]
+    assert tnames and tnames[0]["args"]["name"] == "MainThread"
+    assert tnames[0]["tid"] == evs[0]["tid"]
     parent = next(e for e in evs if e["name"] == "outer_sync")
     child = next(e for e in evs if e["name"] == "allreduce")
     # nested containment on the same tid is what Perfetto renders as a
@@ -383,7 +388,7 @@ def test_train_emits_trace_phases_and_wire_metrics(tmp_path, fused):
     # of the round wall-clock (the acceptance bar is >=95%; asserted a
     # little lower to keep CI noise out of the gate)
     doc = json.load(open(tmp_path / "trace.json"))
-    evs = doc["traceEvents"]
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
     names = {e["name"] for e in evs}
     assert {"data", "inner"} <= names
     assert ("sync" in names) != fused  # fused rounds contain their sync
@@ -403,6 +408,16 @@ def test_train_emits_trace_phases_and_wire_metrics(tmp_path, fused):
     recs = [json.loads(l) for l in open(tmp_path / f"{run}.jsonl")]
     syncs = [r for r in recs if r.get("outer_synced")]
     assert len(syncs) == 2
+
+    # the one-time XLA cost record (obs/costs): captured from the
+    # program each mode actually dispatches, per-token normalized, hand
+    # formula embedded at the same shapes
+    cost = [r["cost_analysis"] for r in recs
+            if isinstance(r.get("cost_analysis"), dict)]
+    assert len(cost) == 1
+    assert cost[0]["program"] == ("fused_round" if fused else "inner_step")
+    assert cost[0]["flops"] > 0 and cost[0]["flops_per_token"] > 0
+    assert cost[0]["flops_per_token_hand"] > 0
     for r in syncs:
         assert r["t_inner"] > 0 and "t_data" in r
         assert r["wire_bytes_per_sync"] > 0 and r["wire_compression"] == 1.0
@@ -421,6 +436,7 @@ def test_train_cli_flags_reach_config():
         "--trace-out", "/tmp/t.json", "--status-file", "/tmp/s.json",
         "--watch-loss-zscore", "4.5", "--watch-stall-factor", "0",
         "--watch-tps-collapse", "0.25", "--watch-loss-window", "64",
+        "--metrics-port", "0", "--no-cost-analysis",
     ])
     cfg = config_from_args(args)
     assert cfg.trace_out == "/tmp/t.json"
@@ -429,3 +445,253 @@ def test_train_cli_flags_reach_config():
     assert cfg.watch_stall_factor == 0.0
     assert cfg.watch_tps_collapse == 0.25
     assert cfg.watch_loss_window == 64
+    assert cfg.metrics_port == 0
+    assert cfg.cost_analysis is False
+    # both default OFF/ON respectively
+    dflt = config_from_args(build_parser().parse_args([]))
+    assert dflt.metrics_port is None and dflt.cost_analysis is True
+
+
+# -- metrics logger path contract --------------------------------------------
+
+
+def test_metrics_logger_path_is_none_without_out_dir(tmp_path):
+    from nanodiloco_tpu.training.metrics import MetricsLogger
+
+    fileless = MetricsLogger("r", out_dir=None, quiet=True, process_index=0)
+    assert fileless.path is None           # was AttributeError before
+    nonwriter = MetricsLogger("r", out_dir=str(tmp_path), quiet=True,
+                              process_index=1)
+    assert nonwriter.path is None          # non-writer ranks never open one
+    writer = MetricsLogger("r", out_dir=str(tmp_path), quiet=True,
+                           process_index=0)
+    assert writer.path == str(tmp_path / "r.jsonl")
+    for lg in (fileless, nonwriter, writer):
+        lg.finish()
+
+
+# -- watchdog live status document -------------------------------------------
+
+
+def test_watchdog_status_doc_and_alarm_kinds():
+    alarms = []
+    wd = _wd(alarms)
+    wd.heartbeat(3, loss=2.0)
+    doc = wd.status_doc()
+    assert doc["state"] == "running" and doc["step"] == 3
+    assert "alarm_kinds" not in doc
+    wd.observe_loss(4, float("nan"))
+    wd.observe_loss(5, 2.0)               # re-arm
+    wd.observe_loss(6, float("nan"))      # second episode
+    doc = wd.status_doc()
+    assert doc["alarm_kinds"] == {"nan_loss": 2}
+    assert wd.alarm_kinds == {"nan_loss": 2}
+    wd.stop("finished")
+    assert wd.status_doc()["state"] == "finished"
+
+
+# -- trace shards + merge ----------------------------------------------------
+
+
+def _shard(process_index, wall0, spans):
+    """Synthetic rank shard: spans = [(name, t0, dur)], a fixed wall
+    anchor standing in for the per-host clock."""
+    clk = FakeClock()
+    tr = SpanTracer(clock=clk, process_index=process_index)
+    for name, t0, dur in spans:
+        clk.t = t0
+        with tr.span(name):
+            clk.t = t0 + dur
+    doc = tr.to_chrome()
+    doc["otherData"]["wall_start_unix"] = wall0
+    return doc
+
+
+def test_trace_shard_path():
+    from nanodiloco_tpu.obs.tracer import trace_shard_path
+
+    assert trace_shard_path("/x/trace.json", 0) == "/x/trace.json"
+    assert trace_shard_path("/x/trace.json", 2) == "/x/trace.rank2.json"
+    assert trace_shard_path("/x/trace", 1) == "/x/trace.rank1.json"
+
+
+def test_merge_chrome_traces_aligns_and_separates_pids():
+    from nanodiloco_tpu.obs.tracer import merge_chrome_traces
+
+    # rank 1's wall clock starts 2 s after rank 0's; both record a sync
+    # span at local t0=1.0 — after merging, rank 1's must sit 2 s later
+    s0 = _shard(0, wall0=100.0, spans=[("sync", 1.0, 0.5)])
+    s1 = _shard(1, wall0=102.0, spans=[("sync", 1.0, 0.5)])
+    merged = merge_chrome_traces([s0, s1])
+    xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 2
+    pids = {e["pid"] for e in xs}
+    assert len(pids) == 2  # one lane per process
+    by_pid = {e["pid"]: e for e in xs}
+    p0, p1 = sorted(by_pid)
+    skew_us = by_pid[p1]["ts"] - by_pid[p0]["ts"]
+    assert skew_us == pytest.approx(2.0 * 1e6)
+    # every pid carries a process_name metadata event
+    meta_pids = {
+        e["pid"] for e in merged["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert meta_pids == pids
+    # pid collision (two shards both claiming rank 0) must NOT overlay
+    dup = merge_chrome_traces([s0, _shard(0, wall0=101.0,
+                                          spans=[("sync", 0.0, 0.1)])])
+    assert len({e["pid"] for e in dup["traceEvents"]}) == 2
+
+
+def test_report_merge_trace_cli(tmp_path, capsys):
+    from nanodiloco_tpu.cli import report_main
+
+    paths = []
+    for k, wall in ((0, 50.0), (1, 50.25)):
+        doc = _shard(k, wall, spans=[("inner", 0.0, 1.0), ("sync", 1.0, 0.2)])
+        p = str(tmp_path / f"trace.rank{k}.json")
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        paths.append(p)
+    out = str(tmp_path / "merged.json")
+    report_main(["merge-trace", *paths, "-o", out])
+    assert "2 process(es)" in capsys.readouterr().out
+    merged = json.load(open(out))  # valid JSON on disk
+    xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 4 and len({e["pid"] for e in xs}) == 2
+
+
+# -- XLA cost analytics ------------------------------------------------------
+
+
+def test_cost_analysis_probe_matches_hand_formula():
+    """The unrolled one-microbatch probe's FLOPs/token must land within
+    2x of bench.py's hand formula — the reconciliation `report cost`
+    performs, asserted at the source. Also pins the XLA loop-once
+    behaviour the probe exists to work around: the dispatched round
+    executable's billed FLOPs must NOT change with H or grad_accum (if
+    this starts failing, a jax upgrade began multiplying trip counts —
+    revisit obs/costs' caveat before trusting new numbers)."""
+    import dataclasses as _dc
+
+    import jax.numpy as jnp
+
+    from nanodiloco_tpu.obs.costs import train_flops_per_token
+    from nanodiloco_tpu.parallel.diloco import Diloco, DilocoConfig
+    from nanodiloco_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    # loss_chunk=0: the chunked CE pads B*S rows up to the 512-row chunk
+    # — real counted work at these tiny shapes that the hand formula
+    # (useful tokens only) can't see; the reconciliation runs unchunked
+    model = _dc.replace(SMALL_MODEL, loss_chunk=0)
+    W, B, S = 2, 2, 64
+    mesh = build_mesh(MeshConfig(diloco=W))
+
+    def build(H, accum):
+        dl = Diloco(
+            model,
+            DilocoConfig(num_workers=W, inner_steps=H, grad_accum=accum),
+            mesh,
+        )
+        return dl, dl.init_state(jax.random.key(0))
+
+    dl, state = build(2, 1)
+    probe = dl.microbatch_cost_analysis(state, (B, S))
+    assert probe and probe["flops"] > 0
+    hand = train_flops_per_token(model, S)
+    ratio = (probe["flops"] / (B * S)) / hand
+    assert 0.5 < ratio < 2.0, f"probe/hand FLOPs ratio {ratio:.3f}"
+
+    def round_billed(H, accum):
+        dl, state = build(H, accum)
+        tok = jax.random.randint(
+            jax.random.key(1), (H, W, accum, B, S), 0, model.vocab_size
+        )
+        analysis = dl.round_cost_analysis(state, tok, jnp.ones_like(tok))
+        assert analysis and analysis["flops"] > 0
+        return analysis["flops"]
+
+    assert round_billed(2, 1) == round_billed(4, 2)  # loop-once pinned
+
+
+def test_build_cost_record_and_analytic_mfu(monkeypatch):
+    from nanodiloco_tpu.obs.costs import analytic_mfu, build_cost_record
+
+    monkeypatch.setenv("BENCH_PEAK_TFLOPS", "100.0")
+    rec = build_cost_record(
+        program="fused_round",
+        billed={"flops": 5e8, "bytes_accessed": 1e9},
+        probe={"flops": 2e9}, probe_tokens=1000, num_devices=2,
+        model_cfg=SMALL_MODEL, seq=64,
+    )
+    assert rec["flops_per_token"] == pytest.approx(2e6)
+    assert rec["flops_billed"] == 5e8
+    assert rec["bytes_accessed_billed"] == 1e9
+    assert rec["flops_per_token_hand"] > 0
+    assert rec["peak_tflops"] == 100.0
+    # 1e6 tok/s x 2e6 flops/tok = 2e12 flop/s over 2 chips x 100 TF = 1%
+    assert analytic_mfu(rec, 1e6) == pytest.approx(0.01)
+    # no peak -> no MFU, never a fake ceiling; a probe-less record (the
+    # loss path the probe can't lower) still carries the billed numbers
+    monkeypatch.delenv("BENCH_PEAK_TFLOPS")
+    rec_cpu = build_cost_record(
+        program="x", billed={"flops": 2e9},
+    )
+    assert "flops_per_token" not in rec_cpu
+    if "peak_tflops" not in rec_cpu:
+        assert analytic_mfu(rec_cpu, 1e6) is None
+
+
+def _write_cost_run(path, tps, final_loss, peak=0.1):
+    with open(path, "w") as f:
+        f.write(json.dumps({"cost_analysis": {
+            "program": "fused_round", "flops": 1e9,
+            "tokens_counted": 1000, "flops_per_token": 1e6,
+            "flops_per_token_hand": 9e5, "peak_tflops": peak,
+            "num_devices": 1, "device_kind": "test",
+        }, "step": 0}) + "\n")
+        for i, loss in enumerate([final_loss + 1.0, final_loss], start=1):
+            f.write(json.dumps({
+                "loss": loss, "tokens_per_sec": tps, "step": i,
+                "outer_synced": 1, "wire_bytes_per_sync": 1000,
+                "wire_bytes_total": 1000 * i,
+            }) + "\n")
+
+
+def test_summarize_and_compare_gate_mfu_analytic(tmp_path):
+    from nanodiloco_tpu.training.metrics import compare_runs, summarize_run
+
+    base = str(tmp_path / "base.jsonl")
+    slow = str(tmp_path / "slow.jsonl")
+    _write_cost_run(base, tps=1000.0, final_loss=3.0)
+    _write_cost_run(slow, tps=500.0, final_loss=3.0)
+    sb, sc = summarize_run(base), summarize_run(slow)
+    assert sb["mfu_analytic"] == pytest.approx(1000.0 * 1e6 / (0.1 * 1e12))
+    assert sb["flops_per_token_analytic"] == pytest.approx(1e6)
+    diff = compare_runs(sb, sc)
+    assert "mfu_analytic" in diff["regressions"]  # halved tps = halved MFU
+    # a summary without the metric never gates (missing-metric rule)
+    sc2 = dict(sc)
+    del sc2["mfu_analytic"]
+    diff2 = compare_runs(sb, sc2)
+    assert diff2["metrics"]["mfu_analytic"]["gated"] is False
+
+
+def test_report_cost_cli(tmp_path, capsys):
+    from nanodiloco_tpu.cli import report_main
+
+    run = str(tmp_path / "run.jsonl")
+    _write_cost_run(run, tps=1000.0, final_loss=3.0)
+    report_main(["cost", run, "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["program"] == "fused_round"
+    assert out["mfu_analytic"] == pytest.approx(0.01)
+    assert out["analytic_vs_hand_ratio"] == pytest.approx(1e6 / 9e5, abs=1e-3)
+    assert out["wire_bytes_per_sync_analytic"] == 1000
+    assert out["wire_bytes_per_sync_ledger"] == 1000
+    assert out["wire_match"] is True
+    # a run without the record fails loudly, not with a zero MFU
+    bare = str(tmp_path / "bare.jsonl")
+    _write_run(bare, tps=10.0, final_loss=1.0)
+    with pytest.raises(SystemExit):
+        report_main(["cost", bare])

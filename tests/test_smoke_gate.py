@@ -1,0 +1,129 @@
+"""Tier-1 regression self-gate: every suite run trains a fresh 6-step
+smoke and `report compare`s it against the committed
+runs/smoke_baseline.json, exiting non-zero past the thresholds — so
+the gate PR 1 built is EXERCISED on every run, not just available.
+
+Gating policy: the loss metrics ride the default relative thresholds
+(the seeded smoke is deterministic, so a real change shows up far above
+2%); throughput is gated only against catastrophic collapse
+(--max-tps-drop 0.95) because CI machines differ — the committed
+tokens/sec is one machine's number and must not flake every other.
+
+Regenerate the baseline after an INTENTIONAL change to the smoke
+trajectory (optimizer semantics, data order, model defaults):
+
+    JAX_PLATFORMS=cpu python tests/test_smoke_gate.py
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+# direct-run regeneration entry executes from tests/: put the repo root
+# on the path first (no-op under pytest, which runs from the root)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nanodiloco_tpu.models.config import LlamaConfig  # noqa: E402
+
+BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "runs", "smoke_baseline.json",
+)
+
+SMOKE_MODEL = LlamaConfig(
+    vocab_size=384, hidden_size=32, intermediate_size=64,
+    num_attention_heads=4, num_hidden_layers=2, max_position_embeddings=64,
+)
+
+
+def smoke_config(log_dir: str):
+    """The ONE smoke definition both the gate and the baseline
+    regenerator run — they must never drift apart."""
+    from nanodiloco_tpu.training.train_loop import TrainConfig
+
+    return TrainConfig(
+        seed=1337, batch_size=4, per_device_batch_size=2, seq_length=32,
+        warmup_steps=2, total_steps=6, inner_steps=3, lr=1e-3,
+        num_workers=2, model=SMOKE_MODEL, log_dir=log_dir, quiet=True,
+        run_name="smoke", measure_comm=False,
+    )
+
+
+def _run_smoke(log_dir: str) -> str:
+    from nanodiloco_tpu.training.train_loop import train
+
+    train(smoke_config(log_dir))
+    return os.path.join(log_dir, "smoke.jsonl")
+
+
+def test_smoke_regression_gate(tmp_path):
+    from nanodiloco_tpu.cli import report_main
+
+    assert os.path.exists(BASELINE), (
+        f"committed baseline missing: {BASELINE} — regenerate with "
+        "`JAX_PLATFORMS=cpu python tests/test_smoke_gate.py`"
+    )
+    jsonl = _run_smoke(str(tmp_path))
+    # raises SystemExit(1) on regression — THE gate, live in tier-1
+    report_main(["compare", BASELINE, jsonl, "--max-tps-drop", "0.95"])
+
+
+def test_smoke_gate_actually_fires(tmp_path):
+    """The gate must be able to fail: the same fresh smoke against a
+    baseline whose loss is unreachably low exits non-zero (a gate that
+    can only pass is decoration)."""
+    from nanodiloco_tpu.cli import report_main
+
+    jsonl = _run_smoke(str(tmp_path))
+    rigged = str(tmp_path / "rigged.json")
+    with open(rigged, "w") as f:
+        json.dump({"published": {"final_loss": 0.001}}, f)
+    with pytest.raises(SystemExit) as e:
+        report_main(["compare", rigged, jsonl])
+    assert e.value.code == 1
+
+
+if __name__ == "__main__":
+    # baseline regeneration entry (never runs under pytest) — mirror
+    # conftest's backend exactly (cpu, 8 virtual devices) so the
+    # recorded trajectory is the one the gate will reproduce
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:  # pre-0.5 jax: conftest's XLA_FLAGS fallback
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    with tempfile.TemporaryDirectory() as td:
+        summary = summarize_run(_run_smoke(td))
+    published = {
+        k: summary[k]
+        for k in ("final_loss", "best_loss", "tokens_per_sec_last")
+        if k in summary
+    }
+    os.makedirs(os.path.dirname(BASELINE), exist_ok=True)
+    with open(BASELINE, "w") as f:
+        json.dump(
+            {
+                "published": published,
+                "note": (
+                    "6-step CPU smoke baseline for the tier-1 "
+                    "report-compare self-gate (tests/test_smoke_gate.py); "
+                    "tokens_per_sec is machine-relative and gated only "
+                    "against collapse"
+                ),
+                "config": "tests/test_smoke_gate.py::smoke_config",
+            },
+            f, indent=1,
+        )
+    print(f"wrote {BASELINE}: {published}")

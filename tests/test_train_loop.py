@@ -18,6 +18,16 @@ SMALL_MODEL = LlamaConfig(
 )
 
 
+def _metric_lines(path):
+    """Per-step metric records from a run JSONL; the one-time
+    ``{"cost_analysis": ...}`` record (obs/costs) is run metadata, not
+    a step line, and would break step-count/index assertions."""
+    return [
+        r for r in (json.loads(l) for l in open(path))
+        if "cost_analysis" not in r
+    ]
+
+
 def small_cfg(tmp_path, **kw):
     defaults = dict(
         seed=1337,
@@ -82,7 +92,7 @@ def test_train_loop_end_to_end(tmp_path):
     # metrics JSONL written with the reference metric set + real comm stats
     runs = os.listdir(tmp_path / "runs")
     assert len(runs) == 1
-    lines = [json.loads(l) for l in open(tmp_path / "runs" / runs[0])]
+    lines = _metric_lines(tmp_path / "runs" / runs[0])
     assert len(lines) == 6
     for k in ("loss", "perplexity", "lr", "effective_step", "total_samples",
               "tokens_per_sec", "avg_sync_time_s", "comm_share", "step"):
@@ -187,7 +197,7 @@ def test_train_loop_fused_rounds_matches_stepwise(tmp_path):
     for x, y in zip(jax.tree.leaves(a["state"].params), jax.tree.leaves(b["state"].params)):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=0, atol=0)
     runs = os.listdir(tmp_path / "b" / "runs")
-    lines = [json.loads(l) for l in open(tmp_path / "b" / "runs" / runs[0])]
+    lines = _metric_lines(tmp_path / "b" / "runs" / runs[0])
     assert len(lines) == 6
     assert [l["outer_synced"] for l in lines] == [0, 0, 1, 0, 0, 1]
 
@@ -203,7 +213,7 @@ def test_train_loop_eval_and_profile(tmp_path):
     assert summary["eval_perplexity"] > 1.0
     assert summary["eval_tokens"] > 0
     runs = os.listdir(tmp_path / "runs")
-    lines = [json.loads(l) for l in open(tmp_path / "runs" / runs[0])]
+    lines = _metric_lines(tmp_path / "runs" / runs[0])
     sync_lines = [l for l in lines if l["outer_synced"]]
     assert all("eval_loss" in l for l in sync_lines)
     assert not any("eval_loss" in l for l in lines if not l["outer_synced"])
@@ -365,7 +375,7 @@ def test_train_loop_moe_logs_router_stats(tmp_path):
         summary = train(small_cfg(out, model=moe_model, fused_rounds=fused))
         assert np.isfinite(summary["final_loss"])
         runs = os.listdir(out / "runs")
-        lines = [json.loads(l) for l in open(out / "runs" / runs[0])]
+        lines = _metric_lines(out / "runs" / runs[0])
         synced = [l for l in lines if l["outer_synced"]]
         assert synced, "no synced steps logged"
         for l in synced:
@@ -388,7 +398,7 @@ def test_train_loop_quarantine_logs_and_stays_healthy(tmp_path):
         summary["final_loss"], base["final_loss"], rtol=1e-5
     )
     runs = os.listdir(tmp_path / "on" / "runs")
-    lines = [json.loads(l) for l in open(tmp_path / "on" / "runs" / runs[0])]
+    lines = _metric_lines(tmp_path / "on" / "runs" / runs[0])
     synced = [l for l in lines if l["outer_synced"]]
     assert synced and all(l["quarantined_workers"] == 0 for l in synced)
     assert all("quarantined_workers" not in l for l in lines if not l["outer_synced"])
@@ -479,7 +489,7 @@ def test_elastic_resume_across_worker_counts(tmp_path):
                               checkpoint_dir=ckpt_dir))
     assert np.isfinite(summary["final_loss"])
     runs = os.listdir(tmp_path / "b" / "runs")
-    lines = [json.loads(l) for l in open(tmp_path / "b" / "runs" / runs[0])]
+    lines = _metric_lines(tmp_path / "b" / "runs" / runs[0])
     assert [l["step"] for l in lines] == [4, 5, 6]  # resumed, not replayed
 
 
@@ -534,7 +544,7 @@ def test_elastic_resume_streaming_across_worker_counts(tmp_path):
                               checkpoint_dir=ckpt_dir))
     assert np.isfinite(summary["final_loss"])
     runs = os.listdir(tmp_path / "b" / "runs")
-    lines = [json.loads(l) for l in open(tmp_path / "b" / "runs" / runs[0])]
+    lines = _metric_lines(tmp_path / "b" / "runs" / runs[0])
     assert [l["step"] for l in lines] == [4, 5, 6]  # resumed, not replayed
 
 
