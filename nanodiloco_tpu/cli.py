@@ -206,6 +206,28 @@ def build_parser() -> argparse.ArgumentParser:
                    help="watchdog: alarm when no loop heartbeat for this "
                         "many times the rolling round time (0 disables "
                         "the heartbeat thread)")
+    # --- resilience (nanodiloco_tpu/resilience) ---
+    p.add_argument("--watch-action", type=str, default="none",
+                   choices=["none", "checkpoint-exit"],
+                   help="what a FATAL watchdog alarm (stall/NaN) does: "
+                        "checkpoint-exit checkpoints at the next round "
+                        "boundary and exits with code 76 for the "
+                        "supervisor to catch (a hard-wedged loop is "
+                        "force-exited after a grace window); none keeps "
+                        "observe-only behavior")
+    p.add_argument("--preempt-signals", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="install SIGTERM/SIGINT handlers that checkpoint "
+                        "at the next round boundary and exit with the "
+                        "preempt code 75 — `supervise` resumes such exits "
+                        "immediately with no restart budget consumed")
+    p.add_argument("--fault-plan", type=str, default=None, metavar="JSON",
+                   help="schedule-driven fault injection "
+                        "(resilience/faults.py): a JSON plan of step-keyed "
+                        "faults (nan_params/io_error/stall/crash) fired "
+                        "through the real loop/checkpoint/feed hook points "
+                        "— deterministic by step, for proving recovery "
+                        "paths; unset = hooks are free no-ops")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="write a jax.profiler trace to this directory: one "
                         "whole warm round under fused dispatch (the "
@@ -300,6 +322,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         watch_loss_window=args.watch_loss_window,
         watch_tps_collapse=args.watch_tps_collapse,
         watch_stall_factor=args.watch_stall_factor,
+        watch_action=args.watch_action,
+        preempt_signals=args.preempt_signals,
+        fault_plan=args.fault_plan,
         profile_dir=args.profile_dir,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
@@ -498,7 +523,12 @@ def report_main(argv: list[str]) -> None:
     ``report cost RUN.jsonl``: reconcile the run's captured XLA
     cost_analysis record against its measured throughput and wire
     ledger — analytic MFU and analytic-vs-ledger wire bytes as a
-    computed artifact instead of a hand-derived table."""
+    computed artifact instead of a hand-derived table.
+
+    ``report faults RUN.jsonl``: the run's fault timeline — injected
+    faults, watchdog alarms, IO retries, preempt exits, and resumes, in
+    step order — reconstructed from the JSONL records the resilience
+    stack writes."""
     if argv[:1] == ["compare"]:
         report_compare_main(argv[1:])
         return
@@ -507,6 +537,9 @@ def report_main(argv: list[str]) -> None:
         return
     if argv[:1] == ["cost"]:
         report_cost_main(argv[1:])
+        return
+    if argv[:1] == ["faults"]:
+        report_faults_main(argv[1:])
         return
     p = argparse.ArgumentParser(prog="nanodiloco_tpu report")
     p.add_argument("jsonl", help="metrics JSONL written by training")
@@ -674,10 +707,63 @@ def report_cost_main(argv: list[str]) -> None:
         print(f"{k:>28}: {v}")
 
 
+def report_faults_main(argv: list[str]) -> None:
+    """``report faults RUN.jsonl``: one line per resilience event, in
+    record order (the JSONL is append-only, so record order IS time
+    order — even across restarts, which append to the same file)."""
+    p = argparse.ArgumentParser(prog="nanodiloco_tpu report faults")
+    p.add_argument("jsonl", help="metrics JSONL written by training")
+    p.add_argument("--json", action="store_true",
+                   help="print the event list as one JSON array")
+    args = p.parse_args(argv)
+
+    from nanodiloco_tpu.training.metrics import read_jsonl_records
+
+    recs, _torn = read_jsonl_records(args.jsonl)
+    events = []
+    for r in recs:
+        if r.get("fault"):
+            events.append({"event": "fault", "kind": r["fault"],
+                           **{k: v for k, v in r.items() if k != "fault"}})
+        elif r.get("alarm"):
+            events.append({"event": "alarm", "kind": r["alarm"],
+                           **{k: v for k, v in r.items() if k != "alarm"}})
+        elif r.get("retry"):
+            events.append({"event": "retry", "op": r["retry"],
+                           **{k: v for k, v in r.items() if k != "retry"}})
+        elif "resume" in r:
+            events.append({"event": "resume", **r})
+        elif r.get("preempt"):
+            events.append({"event": "preempt", "reason": r["preempt"],
+                           **{k: v for k, v in r.items() if k != "preempt"}})
+    if args.json:
+        print(json.dumps(events))
+        return
+    if not events:
+        print("no resilience events recorded (clean run)")
+        return
+    for e in events:
+        detail = " ".join(
+            f"{k}={v}" for k, v in e.items()
+            if k not in ("event", "kind", "op", "reason", "step")
+        )
+        label = e.get("kind") or e.get("op") or e.get("reason") or ""
+        print(f"step {e.get('step', '?'):>8}  {e['event']:<8} {label:<18} {detail}")
+
+
 def main(argv: list[str] | None = None) -> None:
     import sys
 
     argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "supervise":
+        # preemption-safe auto-resume wrapper: runs the train CLI as a
+        # child process (resilience/supervisor.py) — preempt exits (75)
+        # resume immediately, crashes restart with backoff + budget +
+        # crash-loop detection, persistent failure degrades worker count
+        from nanodiloco_tpu.resilience.supervisor import supervise_main
+
+        supervise_main(argv[1:])
+        return
     if argv and argv[0] == "generate":
         generate_main(argv[1:])
         return

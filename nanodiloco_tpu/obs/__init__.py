@@ -10,6 +10,13 @@ throughput against what XLA says the program costs (``costs``).
 Everything here is stdlib host-side Python — no new dependencies, no
 device work; only ``costs`` touches jax, and lazily, to read the
 compiler's own cost model.
+
+Observation feeds ACTION: the watchdog's fatal alarms (stall/NaN) can
+trigger the resilience stack's emergency checkpoint-and-exit via its
+``on_fatal`` callback (``--watch-action checkpoint-exit``), and the
+telemetry endpoint carries the resilience counters (faults fired, IO
+retries, resumes, supervisor restarts) alongside the training gauges —
+see ``nanodiloco_tpu/resilience``.
 """
 
 from nanodiloco_tpu.obs.tracer import (

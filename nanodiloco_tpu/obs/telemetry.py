@@ -86,6 +86,9 @@ class TelemetryServer:
         self._gauges: dict[str, float] = {}
         self._phases: dict[str, float] = {}
         self._alarms: dict[str, int] = {}
+        self._faults: dict[str, int] = {}    # injected-fault records by kind
+        self._retries: dict[str, int] = {}   # IO retry records by op
+        self._resumes = 0                    # checkpoint-resume records
         self._outer_syncs = 0
         self._wire_total = 0.0
         self._thread: threading.Thread | None = None
@@ -146,6 +149,16 @@ class TelemetryServer:
                     continue
                 if k == "alarm":
                     self._alarms[str(v)] = self._alarms.get(str(v), 0) + 1
+                elif k == "fault":
+                    self._faults[str(v)] = self._faults.get(str(v), 0) + 1
+                elif k == "retry":
+                    self._retries[str(v)] = self._retries.get(str(v), 0) + 1
+                elif k == "resume":
+                    self._resumes += 1
+                elif k == "restart_count" and isinstance(v, (int, float)):
+                    # supervisor-side restart counter, carried in by the
+                    # resume record so a scrape sees restart pressure
+                    self._gauges["nanodiloco_restarts"] = float(v)
                 elif k == "outer_synced":
                     self._outer_syncs += int(bool(v))
                 elif k == "wire_bytes_total":
@@ -169,12 +182,19 @@ class TelemetryServer:
             gauges = dict(self._gauges)
             phases = dict(self._phases)
             alarms = dict(self._alarms)
+            faults = dict(self._faults)
+            retries = dict(self._retries)
+            resumes = self._resumes
             syncs = self._outer_syncs
             wire_total = self._wire_total
         helps = {name: h for name, h in _GAUGE_KEYS.values()}
         helps["nanodiloco_flops_per_token"] = (
             "analytic FLOPs per token from the lowered program's "
             "XLA cost analysis"
+        )
+        helps["nanodiloco_restarts"] = (
+            "supervisor restarts preceding this process (from the "
+            "resume record)"
         )
         lines: list[str] = []
         for name in sorted(gauges):
@@ -200,6 +220,24 @@ class TelemetryServer:
                 f'nanodiloco_alarms_total{{kind="{kind}"}} {alarms[kind]}'
             )
         lines.append(f"nanodiloco_alarms_total {sum(alarms.values())}")
+        # resilience counters: injected faults by kind, IO retries by op,
+        # checkpoint resumes — the scrapeable half of the fault timeline
+        lines.append("# TYPE nanodiloco_faults counter")
+        lines.append("# HELP nanodiloco_faults injected faults fired, by kind")
+        for kind in sorted(faults):
+            lines.append(
+                f'nanodiloco_faults_total{{kind="{kind}"}} {faults[kind]}'
+            )
+        lines.append(f"nanodiloco_faults_total {sum(faults.values())}")
+        lines.append("# TYPE nanodiloco_retries counter")
+        lines.append("# HELP nanodiloco_retries IO retry attempts, by operation")
+        for op in sorted(retries):
+            lines.append(
+                f'nanodiloco_retries_total{{op="{op}"}} {retries[op]}'
+            )
+        lines.append(f"nanodiloco_retries_total {sum(retries.values())}")
+        lines.append("# TYPE nanodiloco_resumes counter")
+        lines.append(f"nanodiloco_resumes_total {resumes}")
         lines.append("# TYPE nanodiloco_outer_syncs counter")
         lines.append(f"nanodiloco_outer_syncs_total {syncs}")
         lines.append("# TYPE nanodiloco_wire_bytes counter")

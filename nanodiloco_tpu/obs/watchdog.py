@@ -61,11 +61,20 @@ class Watchdog:
         emit: Callable[[dict], None] | None = None,
         status_path: str | None = None,
         clock: Callable[[], float] = time.monotonic,
+        on_fatal: Callable[[str, int], None] | None = None,
+        fatal_kinds: tuple[str, ...] = ("stall", "nan_loss"),
     ) -> None:
         self.cfg = cfg or WatchdogConfig()
         self._emit = emit or (lambda rec: None)
         self.status_path = status_path
         self._clock = clock
+        # observe -> ACT: alarms of a fatal kind also invoke this
+        # callback (the train loop's --watch-action checkpoint-exit path
+        # hangs its emergency-stop latch here). May fire from the
+        # heartbeat daemon thread; exceptions are swallowed — the
+        # watchdog must never take training down by accident.
+        self._on_fatal = on_fatal
+        self._fatal_kinds = tuple(fatal_kinds)
         self._lock = threading.Lock()
         self._losses: deque[float] = deque(maxlen=max(2, self.cfg.loss_window))
         self._tps: deque[float] = deque(maxlen=max(2, self.cfg.loss_window))
@@ -90,6 +99,25 @@ class Watchdog:
             if not self._armed.get(kind, True):
                 return
             self._armed[kind] = False
+            self._alarm_count += 1
+            self._alarm_kinds[kind] = self._alarm_kinds.get(kind, 0) + 1
+            rec = {"alarm": kind, "step": step, **detail}
+            self._last_alarm = rec
+        self._emit(rec)
+        self._write_status()
+        if self._on_fatal is not None and kind in self._fatal_kinds:
+            try:
+                self._on_fatal(kind, step)
+            except Exception:
+                pass
+
+    def alarm(self, kind: str, step: int, **detail: Any) -> None:
+        """Explicitly-raised external alarm (e.g. the train loop's
+        checkpoint-save-failed degradation). Unlike the sentinels it is
+        per-EVENT, not per-episode: every call records, none is gated by
+        the armed flags, and none triggers the fatal action (the caller
+        already decided to degrade, not to die)."""
+        with self._lock:
             self._alarm_count += 1
             self._alarm_kinds[kind] = self._alarm_kinds.get(kind, 0) + 1
             rec = {"alarm": kind, "step": step, **detail}

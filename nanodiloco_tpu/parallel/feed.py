@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from nanodiloco_tpu.resilience import faults as _faults
+
 
 def device_set_slices(
     sharding: NamedSharding, global_shape: tuple[int, ...], devices
@@ -67,6 +69,11 @@ class BatchFeeder:
         return device_set_slices(self.sharding, global_shape, local)
 
     def __call__(self, array) -> jax.Array:
+        # fault-injection hook (resilience/faults): a scheduled `stall`
+        # fault sleeps HERE — the data path — so the watchdog's stall
+        # sentinel is exercised through the real heartbeat machinery.
+        # One `is None` check when no plan is installed.
+        _faults.maybe_stall()
         if not self.multihost:
             return jnp.asarray(array)
         array = np.asarray(array)

@@ -1,0 +1,65 @@
+"""Fault tolerance: injection, retry/backoff, preemption + supervision.
+
+DiLoCo's premise is training on loosely-coupled, PREEMPTIBLE hardware
+(arXiv:2311.08105) — yet a training loop that merely *observes* faults
+(the obs/ watchdog) still dies permanently on the first SIGTERM, stalled
+feed, or failed checkpoint write. This package closes the loop from
+detection → action → automatic recovery, and makes every recovery path
+provable in CI:
+
+- ``faults``: a schedule-driven fault plan (``--fault-plan plan.json``,
+  deterministic by step — no wall-clock randomness) firing at named hook
+  points threaded through the train loop, the checkpoint manager, and
+  the batch feeder. Hooks are zero-cost no-ops when no plan is
+  installed; the smoke gate asserts a no-op plan does not perturb the
+  training trajectory.
+- ``retry``: jittered exponential backoff with a deadline, wrapped
+  around checkpoint save/restore and dataset fetch — transient IO
+  failures retry; persistent ones degrade gracefully (a failing save
+  logs a watchdog alarm and training continues to the next cadence).
+- ``supervisor``: SIGTERM/SIGINT handlers checkpoint at the next round
+  boundary and exit with a distinct preempt code; the ``supervise`` CLI
+  runs training as a child process and restarts it from the latest
+  checkpoint — preempts resume immediately with no budget consumed,
+  crashes get exponential backoff with crash-loop detection, and
+  persistent failure degrades elastically to a lower worker count via
+  ``restore_elastic``.
+
+Everything here is stdlib host-side Python; ``faults`` touches jax only
+inside ``poison_worker_params`` (lazily), so importing the package costs
+nothing on the training hot path.
+"""
+
+from nanodiloco_tpu.resilience.faults import (
+    FaultPlan,
+    InjectedCrash,
+    InjectedIOError,
+    clear_plan,
+    active_plan,
+    install_plan,
+)
+from nanodiloco_tpu.resilience.retry import RetryError, RetryPolicy, retry_call
+from nanodiloco_tpu.resilience.supervisor import (
+    PREEMPT_EXIT_CODE,
+    WATCHDOG_EXIT_CODE,
+    Supervisor,
+    SupervisorConfig,
+    latest_checkpoint_step,
+)
+
+__all__ = [
+    "FaultPlan",
+    "InjectedCrash",
+    "InjectedIOError",
+    "active_plan",
+    "clear_plan",
+    "install_plan",
+    "RetryError",
+    "RetryPolicy",
+    "retry_call",
+    "PREEMPT_EXIT_CODE",
+    "WATCHDOG_EXIT_CODE",
+    "Supervisor",
+    "SupervisorConfig",
+    "latest_checkpoint_step",
+]

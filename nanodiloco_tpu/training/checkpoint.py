@@ -11,13 +11,15 @@ a restore resumes bit-exactly mid-round.
 from __future__ import annotations
 
 import os
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
 from nanodiloco_tpu.parallel.diloco import DilocoState
+from nanodiloco_tpu.resilience import faults as _faults
+from nanodiloco_tpu.resilience.retry import RetryPolicy, retry_call
 
 
 def _path_names(path) -> tuple:
@@ -45,8 +47,36 @@ def _path_leaf_map(tree) -> dict:
 
 
 class CheckpointManager:
-    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+    """``retry``: a resilience RetryPolicy wrapped around every save and
+    restore attempt (jittered exponential backoff with a deadline) —
+    None keeps the raw single-attempt behavior. ``on_event`` receives a
+    ``{"retry": op, "attempt": ..., ...}`` record per backoff (the train
+    loop passes the metrics logger, so IO flakiness lands in the same
+    JSONL the fault timeline reads from)."""
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        retry: RetryPolicy | None = None,
+        on_event: Callable[[dict], None] | None = None,
+        synchronous: bool = True,
+    ) -> None:
         self.directory = os.path.abspath(directory)
+        self.retry = retry
+        self._on_event = on_event or (lambda rec: None)
+        # Synchronous (default): every save commits before save() returns,
+        # so a write error surfaces AT the failing save — straight into
+        # the retry/alarm path — and a crash one step later can never
+        # lose a checkpoint the run believed it had. The async mode
+        # (synchronous=False) keeps orbax's background write for
+        # wall-clock overlap, at the cost of deferred errors (bounded by
+        # check_async_errors at the next save) — and is NOT trustworthy
+        # on this environment's legacy jax/orbax stack: a pending
+        # background write racing the train loop reproducibly corrupts
+        # the process heap (glibc aborts under the CPU test harness) and
+        # tears checkpoint contents (the seed's non-bit-exact resume).
+        self.synchronous = synchronous
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -58,8 +88,51 @@ class CheckpointManager:
             item_handlers=ocp.StandardCheckpointHandler(),
         )
 
+    def _attempt(self, op: str, fn: Callable[[], Any]) -> Any:
+        """Run one save/restore under the retry policy (or bare), with a
+        per-backoff event record for the run's JSONL."""
+
+        def note(attempt: int, exc: BaseException, delay: float) -> None:
+            self._on_event({
+                "retry": op, "attempt": attempt,
+                "delay_s": round(delay, 3),
+                "error": f"{type(exc).__name__}: {exc}"[:300],
+            })
+
+        if self.retry is None:
+            return fn()
+        return retry_call(fn, op=op, policy=self.retry, on_retry=note)
+
+    def check_async_errors(self) -> None:
+        """Surface a failed BACKGROUND write now. Orbax saves commit on a
+        background thread; without this, a failed write only reports at
+        teardown ``wait()`` — the run spends its whole life believing it
+        has checkpoints it doesn't. Called at the top of every ``save``
+        (a bounded, non-blocking check) so the failure routes into the
+        same retry/alarm path as a synchronous save error."""
+        check = getattr(self._mngr, "check_for_errors", None)
+        if check is not None:
+            check()
+
     def save(self, step: int, state: DilocoState, force: bool = False) -> None:
-        self._mngr.save(step, args=ocp.args.StandardSave(state), force=force)
+        if not self.synchronous:
+            # async mode: snapshot the live buffers BEFORE the background
+            # write — orbax's writer reads the arrays while the caller's
+            # next jitted dispatch DONATES them, and a torn read lands
+            # garbage in the checkpoint (the seed's flaky non-bit-exact
+            # resume). One device-side copy per save, freed at commit.
+            state = jax.tree.map(jnp.copy, state)
+
+        def attempt():
+            self.check_async_errors()
+            _faults.check_io("save")
+            self._mngr.save(step, args=ocp.args.StandardSave(state), force=force)
+            if self.synchronous:
+                # commit before returning: an IO failure surfaces HERE,
+                # inside the retry wrapper, never at a later teardown
+                self._mngr.wait_until_finished()
+
+        self._attempt("ckpt_save", attempt)
 
     def wait(self) -> None:
         self._mngr.wait_until_finished()
@@ -75,7 +148,14 @@ class CheckpointManager:
         step = self.latest_step if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {self.directory}")
-        return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract_state))
+
+        def attempt():
+            _faults.check_io("restore")
+            return self._mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract_state)
+            )
+
+        return self._attempt("ckpt_restore", attempt)
 
     def restore_raw(
         self, step: int | None = None, only: set[str] | None = None

@@ -229,6 +229,29 @@ def summarize_run(path: str) -> dict[str, Any]:
         for a in alarms:
             kinds[a["alarm"]] = kinds.get(a["alarm"], 0) + 1
         out["alarm_kinds"] = kinds
+    # resilience stack (PR: resilience/): injected faults, resumes,
+    # preempt exits, IO retries — the fault timeline's summary keys
+    # (`report faults` prints the full ordered list)
+    faults = [r for r in recs if r.get("fault")]
+    if faults:
+        out["faults"] = len(faults)
+        fkinds: dict[str, int] = {}
+        for f in faults:
+            fkinds[f["fault"]] = fkinds.get(f["fault"], 0) + 1
+        out["fault_kinds"] = fkinds
+    resumes = [r for r in recs if "resume" in r]
+    if resumes:
+        out["resumes"] = len(resumes)
+        restarts = [r.get("restart_count") for r in resumes
+                    if r.get("restart_count") is not None]
+        if restarts:
+            out["restarts"] = int(max(restarts))
+    preempts = [r for r in recs if r.get("preempt")]
+    if preempts:
+        out["preempt_exits"] = len(preempts)
+    retries = [r for r in recs if r.get("retry")]
+    if retries:
+        out["io_retries"] = len(retries)
     wire = series("wire_bytes_per_sync")
     if wire:
         totals = series("wire_bytes_total")

@@ -25,6 +25,8 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import signal
+import threading
 import time
 from typing import Any
 
@@ -37,6 +39,13 @@ from nanodiloco_tpu.models.config import LlamaConfig
 from nanodiloco_tpu.obs import SpanTracer, Watchdog, WatchdogConfig, set_tracer, trace_span
 from nanodiloco_tpu.parallel.diloco import Diloco, DilocoConfig
 from nanodiloco_tpu.parallel.mesh import MeshConfig, build_mesh
+from nanodiloco_tpu.resilience import faults as _faults
+from nanodiloco_tpu.resilience.retry import RetryPolicy, retry_call
+from nanodiloco_tpu.resilience.supervisor import (
+    PREEMPT_EXIT_CODE,
+    RESTART_ENV,
+    WATCHDOG_EXIT_CODE,
+)
 from nanodiloco_tpu.training.metrics import MetricsLogger, SyncTimer
 from nanodiloco_tpu.training.optim import warmup_cosine_schedule
 from nanodiloco_tpu.utils.utils import (
@@ -46,6 +55,28 @@ from nanodiloco_tpu.utils.utils import (
     resolve_run_name,
     set_seed_all,
 )
+
+
+class _EmergencyExit(Exception):
+    """Internal control flow for the graceful-stop paths (preemption,
+    watchdog checkpoint-exit): raised at a round boundary AFTER the
+    emergency checkpoint, caught at the bottom of ``train`` once
+    teardown has run, and converted to ``SystemExit(code)`` so the
+    supervisor reads a distinct exit class."""
+
+    def __init__(self, code: int, reason: str) -> None:
+        super().__init__(f"{reason} (exit code {code})")
+        self.code = code
+        self.reason = reason
+
+
+def _stall_escalate_s() -> float:
+    """Grace window between a stall alarm (under ``--watch-action
+    checkpoint-exit``) and the hard ``os._exit``: a wedged loop cannot
+    reach its own boundary check, so the watchdog thread must eventually
+    pull the plug from outside — the latest cadence checkpoint is the
+    resume point. Env-overridable for the chip agenda and tests."""
+    return float(os.environ.get("NANODILOCO_STALL_ESCALATE_S", "120"))
 
 
 @dataclasses.dataclass
@@ -157,6 +188,25 @@ class TrainConfig:
     watch_loss_window: int = 32
     watch_tps_collapse: float = 0.4
     watch_stall_factor: float = 5.0
+    # --- resilience (resilience/) ---
+    # what a FATAL watchdog alarm (stall / nan_loss) does:
+    # "checkpoint-exit" checkpoints at the next round boundary and exits
+    # with the distinct watchdog code for the supervisor to catch (a
+    # hard-wedged loop is force-exited after a grace window — the latest
+    # cadence checkpoint stands); "none" keeps PR-1 observe-only behavior
+    watch_action: str = "none"
+    # install SIGTERM/SIGINT handlers that checkpoint at the next round
+    # boundary and exit with the preempt code (75) — the half of
+    # preemption the training process owns; the supervise CLI owns the
+    # restart. Main-thread only (signal handlers cannot install
+    # elsewhere); harmless off the CLI path.
+    preempt_signals: bool = True
+    # schedule-driven fault injection (resilience/faults.py): a JSON
+    # plan of step-keyed faults (nan_params / io_error / stall / crash)
+    # fired through the real loop/checkpoint/feed hook points. None =
+    # every hook is a single is-None check (asserted ~free by the smoke
+    # gate).
+    fault_plan: str | None = None
     checkpoint_dir: str | None = None
     checkpoint_every: int = 1        # in outer syncs
     resume: bool = True
@@ -176,9 +226,13 @@ class TrainConfig:
 def _finite_worker_mean(losses: jax.Array) -> jax.Array:
     """Mean over the trailing (worker) axis, restricted to finite
     entries — the logged loss under quarantine (a healed worker's NaN
-    must not reach the dashboard). All-non-finite rows read 0.0."""
+    must not reach the dashboard). An ALL-non-finite row propagates NaN:
+    every worker diverging at once is a fully dead round, and the old
+    0.0 read made it masquerade as a perfect loss — the watchdog's
+    nan_loss sentinel (and /healthz) must see it."""
     fin = jnp.isfinite(losses)
-    return jnp.where(fin, losses, 0.0).sum(-1) / jnp.maximum(fin.sum(-1), 1)
+    mean = jnp.where(fin, losses, 0.0).sum(-1) / jnp.maximum(fin.sum(-1), 1)
+    return jnp.where(fin.any(-1), mean, jnp.nan)
 
 
 def train(cfg: TrainConfig) -> dict[str, Any]:
@@ -195,6 +249,34 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     quiet = cfg.quiet or jax.process_index() != 0
     if cfg.total_steps % cfg.inner_steps:
         raise ValueError("total_steps must divide evenly by inner_steps")
+    if cfg.watch_action not in ("none", "checkpoint-exit"):
+        raise ValueError(
+            f"unknown watch_action: {cfg.watch_action!r} "
+            "(use 'none' or 'checkpoint-exit')"
+        )
+    # fault plan: parsed and validated up front (a typo'd plan must fail
+    # the launch, not fire garbage mid-run), then ARMED before the
+    # startup IO so step-0 io_error faults can hit the initial dataset
+    # fetch and checkpoint restore — the retry paths worth proving most.
+    # A stale plan from an earlier train() that died before its teardown
+    # is cleared either way.
+    _faults.clear_plan()
+    fault_plan = None
+    if cfg.fault_plan:
+        fault_plan = _faults.FaultPlan.load(cfg.fault_plan)
+        for f in fault_plan.faults:
+            if f["kind"] == "nan_params" and f["worker"] >= cfg.num_workers:
+                raise ValueError(
+                    f"fault plan poisons worker {f['worker']} but the run "
+                    f"has only {cfg.num_workers} worker(s)"
+                )
+        fault_plan.advance(0)  # step-0 faults are due from startup on
+        _faults.install_plan(fault_plan)
+        if not quiet:
+            print(
+                f"[nanodiloco] fault plan armed: {len(fault_plan.faults)} "
+                f"fault(s) from {cfg.fault_plan}"
+            )
 
     if cfg.data_layout not in ("packed", "padded"):
         raise ValueError(f"unknown data_layout: {cfg.data_layout!r}")
@@ -384,7 +466,18 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         if cfg.dataset_path:
             from nanodiloco_tpu.data import load_hf_dataset_texts
 
-            texts = load_hf_dataset_texts(cfg.dataset_path)
+            def _fetch_texts():
+                _faults.check_io("fetch")  # injection hook (io_error op=fetch)
+                return load_hf_dataset_texts(cfg.dataset_path)
+
+            # dataset reads hit remote/network filesystems in production;
+            # a transient failure retries with backoff instead of killing
+            # the launch (resilience/retry)
+            texts = retry_call(
+                _fetch_texts, op="dataset_fetch",
+                policy=RetryPolicy(max_attempts=3, base_delay_s=0.5,
+                                   max_delay_s=4.0, deadline_s=60.0),
+            )
         else:
             texts = synthetic_corpus(seed=cfg.seed)
         if padded:
@@ -446,10 +539,31 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     schedule = warmup_cosine_schedule(cfg.lr, cfg.warmup_steps, cfg.total_steps)
 
     ckpt = None
+    logger: MetricsLogger | None = None
+    resume_rec: dict | None = None
+    # retry events from the STARTUP restore fire before the logger
+    # exists — buffer them and flush once it does, so a flaky restore
+    # shows in the run's fault timeline like any other IO event
+    pre_logger_events: list[dict] = []
+
+    def _ckpt_event(rec: dict) -> None:
+        if logger is not None:
+            logger.log(rec)
+        else:
+            pre_logger_events.append(rec)
+
     if cfg.checkpoint_dir:
         from nanodiloco_tpu.training.checkpoint import CheckpointManager, abstract_state_like
 
-        ckpt = CheckpointManager(cfg.checkpoint_dir)
+        ckpt = CheckpointManager(
+            cfg.checkpoint_dir,
+            # transient IO (GCS 503s, NFS hiccups) retries with backoff;
+            # persistent failure surfaces to the guarded save sites below,
+            # which alarm and keep training (resilience/retry)
+            retry=RetryPolicy(max_attempts=4, base_delay_s=0.25,
+                              max_delay_s=4.0, deadline_s=60.0),
+            on_event=_ckpt_event,
+        )
         # Self-describing checkpoints: the generate CLI (and any later
         # consumer) rebuilds the model from this sidecar alone, without
         # the training flags. Process 0 only — on a multi-host pod the
@@ -489,6 +603,18 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         "moments reset (LR schedule continues)"
                     )
                 state = ckpt.restore_elastic(state)
+            # the resume record (logged once the logger exists): the
+            # JSONL's fault timeline needs restarts to be visible in the
+            # same stream as the faults that caused them
+            try:
+                restart_count = int(os.environ.get(RESTART_ENV, "0") or 0)
+            except ValueError:
+                restart_count = 0
+            resume_rec = {
+                "resume": int(ckpt.latest_step),
+                "elastic": saved_w != cfg.num_workers,
+                "restart_count": restart_count,
+            }
 
     # resolve_run_name broadcasts process 0's name so a pod produces ONE
     # run identity (an explicit --run-name is already identical on all
@@ -507,6 +633,11 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         config={**dataclasses.asdict(cfg.model), **cfg.wandb_config},
         quiet=cfg.quiet,
     )
+    for rec in pre_logger_events:
+        logger.log(rec)
+    pre_logger_events.clear()
+    if resume_rec is not None:
+        logger.log(resume_rec, step=resume_rec["resume"])
     sync_timer = SyncTimer()
 
     # --- observability: span tracer + watchdog (nanodiloco_tpu/obs) ---------
@@ -526,6 +657,44 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         process_index=jax.process_index(),
     )
     prev_tracer = set_tracer(tracer)
+    # --- resilience: emergency-stop latch (resilience/supervisor) -----------
+    # ONE latch for every graceful-stop source — SIGTERM/SIGINT preemption
+    # and fatal watchdog alarms under --watch-action checkpoint-exit. The
+    # loop polls it at round boundaries: checkpoint, log a preempt record,
+    # exit with the latched code (distinct per source) for the supervisor
+    # to classify. First request wins; later ones are echoes.
+    stop_latch: dict[str, Any] = {"reason": None, "code": None}
+
+    def _request_stop(reason: str, code: int) -> None:
+        if stop_latch["reason"] is None:
+            stop_latch["reason"], stop_latch["code"] = reason, code
+
+    on_fatal = None
+    # liveness flag + timer registry: the escalation timer must NEVER
+    # fire after train() has already exited (an embedding process —
+    # tests, a notebook — would be os._exit'd out from under itself);
+    # teardown cancels the timers and drops the flag, and the callback
+    # re-checks the flag to close the cancel race
+    _run_alive = {"v": True}
+    _stall_timers: list[threading.Timer] = []
+    if cfg.watch_action == "checkpoint-exit":
+        def on_fatal(kind: str, step: int) -> None:
+            _request_stop(f"watchdog:{kind}", WATCHDOG_EXIT_CODE)
+            if kind == "stall":
+                # a stalled loop may never reach its own boundary check;
+                # after a grace window the watchdog thread pulls the plug
+                # from outside — the latest cadence checkpoint is the
+                # resume point (a wedge that clears in time exits
+                # cleanly through the latch instead)
+                t = threading.Timer(
+                    _stall_escalate_s(),
+                    lambda: os._exit(WATCHDOG_EXIT_CODE)
+                    if _run_alive["v"] else None,
+                )
+                t.daemon = True
+                t.start()
+                _stall_timers.append(t)
+
     watchdog = Watchdog(
         WatchdogConfig(
             loss_zscore=cfg.watch_loss_zscore,
@@ -535,8 +704,44 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         ),
         emit=lambda rec: logger.log(rec),
         status_path=cfg.status_file if logger.is_writer else None,
+        on_fatal=on_fatal,
     )
     watchdog.start()
+    # SIGTERM/SIGINT -> graceful preemption: checkpoint at the next round
+    # boundary, exit PREEMPT_EXIT_CODE (75). Main-thread-only (the OS
+    # contract for signal handlers); previous handlers restored at
+    # teardown so an embedding process (tests, notebooks) is unchanged.
+    prev_sig: dict[int, Any] = {}
+    if cfg.preempt_signals and threading.current_thread() is threading.main_thread():
+        def _on_signal(signum, frame):
+            if stop_latch["reason"] is not None:
+                # SECOND signal: the operator means NOW — a run wedged
+                # before its next round boundary (hung compile, stalled
+                # fetch) must stay interruptible. Restore the previous
+                # disposition and re-deliver, so Ctrl-C/SIGTERM regain
+                # their ordinary teeth.
+                try:
+                    signal.signal(
+                        signum, prev_sig.get(signum, signal.SIG_DFL)
+                    )
+                except (ValueError, OSError):
+                    pass
+                os.kill(os.getpid(), signum)
+                return
+            _request_stop("preempt", PREEMPT_EXIT_CODE)
+            if not quiet:
+                print(
+                    f"[nanodiloco] signal {signum}: checkpointing at the "
+                    f"next round boundary, then exiting {PREEMPT_EXIT_CODE} "
+                    "(signal again to abort immediately)",
+                    flush=True,
+                )
+
+        for _sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev_sig[_sig] = signal.signal(_sig, _on_signal)
+            except (ValueError, OSError):  # exotic embedding; stay passive
+                pass
     # live telemetry endpoint (obs/telemetry.py): /metrics gauges are fed
     # by the logger's own log() path (one source of truth with the
     # JSONL); /healthz pulls the watchdog's live status document — the
@@ -576,7 +781,94 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     }
     wire_bytes_total = 0
 
+    # --- resilience helpers shared by both dispatch loops -------------------
+    def _pump_faults(cursor_step: int, state):
+        """Fault-plan hook point at the top of each dispatch unit (per
+        step stepwise, per round fused): advance the cursor, poison due
+        nan_params replicas (the SAME surgery the hand-crafted
+        quarantine tests perform), log every fired fault into the JSONL
+        timeline, and fire a due crash LAST so its own record lands
+        first."""
+        if fault_plan is None:
+            return state
+        fault_plan.advance(cursor_step)
+        for f in fault_plan.take_due("nan_params"):
+            state = _faults.poison_worker_params(state, f["worker"])
+        crash = fault_plan.take_due("crash")
+        for rec in fault_plan.drain_fired():
+            # the record keeps the fault's SCHEDULED step; fired_at_step
+            # is the dispatch boundary it actually hit (they differ in
+            # fused mode, where the hook granularity is a round)
+            logger.log(
+                {"fault": rec.pop("kind"), **rec, "fired_at_step": cursor_step}
+            )
+        if crash:
+            if crash[0].get("raise") and ckpt is not None:
+                # raise-mode is the in-process TEST variant of a crash:
+                # the host process survives, so the async writer must be
+                # flushed and closed or its thread dies messily at GC.
+                # The hard default (os._exit) skips all of this — that
+                # IS the fault being simulated.
+                try:
+                    ckpt.wait()
+                    ckpt.close()
+                except Exception:
+                    pass
+            _faults.fire_crash(crash[0])
+        return state
+
+    def _guarded_save(step_: int, state_, force: bool = False) -> None:
+        """Checkpoint save that DEGRADES instead of aborting: retries
+        happen inside the manager (backoff + deadline); a persistently
+        failing save logs a watchdog alarm and training continues — the
+        next cadence retries. Killing a healthy run because storage
+        blipped would throw away exactly the work checkpoints exist to
+        protect."""
+        try:
+            with trace_span("ckpt"):
+                ckpt.save(step_, state_, force=force)
+        except Exception as e:
+            watchdog.alarm(
+                "ckpt_save_failed", step_,
+                error=f"{type(e).__name__}: {e}"[:300],
+            )
+
+    def _maybe_graceful_exit(real_step: int, state) -> None:
+        """Poll the emergency-stop latch at a round boundary: checkpoint
+        (unless this boundary already saved), flush the async write so
+        the checkpoint is committed before the process dies, log the
+        preempt record, and leave via _EmergencyExit -> SystemExit(code)
+        once teardown has run."""
+        if stop_latch["reason"] is None:
+            return
+        reason, code = stop_latch["reason"], stop_latch["code"]
+        if ckpt is not None:
+            if ckpt.latest_step != real_step:
+                _guarded_save(real_step, state, force=True)
+            try:
+                ckpt.wait()
+            except Exception as e:
+                watchdog.alarm(
+                    "ckpt_save_failed", real_step,
+                    error=f"{type(e).__name__}: {e}"[:300],
+                )
+        logger.log(
+            {
+                "preempt": reason, "exit_code": code,
+                "checkpoint_step": ckpt.latest_step if ckpt else None,
+            },
+            step=real_step,
+        )
+        if not quiet:
+            print(
+                f"[nanodiloco] {reason}: checkpoint at step "
+                f"{ckpt.latest_step if ckpt else None}, exiting {code}",
+                flush=True,
+            )
+        raise _EmergencyExit(code, reason)
+
     completed = False
+    emergency: _EmergencyExit | None = None
     try:
         evaluator = None
         if cfg.eval_every:
@@ -720,6 +1012,10 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             )
             try:
                 for rnd in range(first_round, last_round + 1):
+                    # fault hook at the round's dispatch boundary: the
+                    # whole round is ONE program, so a fault scheduled
+                    # for any step it covers fires here, before dispatch
+                    state = _pump_faults(rnd * cfg.inner_steps, state)
                     with trace_span("data"):
                         toks, masks = pending.result()
                     pending = None
@@ -795,8 +1091,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         pending = prefetcher.submit(dl.stack_round_batches, batches)
                     real_step = rnd * cfg.inner_steps
                     if ckpt and rnd % cfg.checkpoint_every == 0:
-                        with trace_span("ckpt"):
-                            ckpt.save(real_step, state)
+                        _guarded_save(real_step, state)
                     eval_metrics = {}
                     # fetch the snapshot only when a consumer actually runs
                     # THIS round (the MoE probe runs every round; eval only
@@ -916,15 +1211,31 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         tokens_per_sec=round(tps, 1),
                     )
                     last_loss = float(losses_h[-1])
+                    # preempt / watchdog emergency stop — at the round
+                    # boundary, with the checkpoint flushed before exit
+                    _maybe_graceful_exit(real_step, state)
             finally:
                 if pending is not None:
                     pending.cancel()
-                prefetcher.shutdown(wait=False)
+                # JOIN the worker thread (wait=True): on an abnormal exit
+                # (injected crash, preemption, a real exception) an
+                # in-flight stack_round_batches keeps dispatching jax
+                # work — and compiling into the persistent compile cache
+                # — concurrently with whatever the process does next;
+                # observed as glibc heap corruption when a follow-up
+                # train() started while the orphan was still running.
+                # Normal completion has no in-flight work, so the join is
+                # free; the bounded worst case is one round of batch
+                # assembly (plus an injected stall's sleep).
+                prefetcher.shutdown(wait=True)
 
         round_ok = None  # per-round device-side [W] finiteness (quarantine)
         quarantined_last_round = 0
         round_t0 = time.perf_counter()  # sync-to-sync wall-clock (watchdog)
         for real_step in ([] if fused else range(start_step + 1, cfg.total_steps + 1)):
+            # fault hook per dispatch unit (one inner step here): a
+            # scheduled fault fires at exactly its step
+            state = _pump_faults(real_step, state)
             if cfg.profile_dir and real_step == profile_start:
                 jax.profiler.start_trace(cfg.profile_dir)
                 profiling = True
@@ -959,8 +1270,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     if ckpt and (
                         real_step // cfg.inner_steps
                     ) % cfg.checkpoint_every == 0:
-                        with trace_span("ckpt"):
-                            ckpt.save(real_step, state)
+                        _guarded_save(real_step, state)
             else:
                 with trace_span("inner"):
                     state, loss = dl.inner_step(state, dl.feed(tokens), dl.feed(mask))
@@ -1000,8 +1310,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         jax.block_until_ready(state.params)
                     state = dl._offload(state)
                     if ckpt and (real_step // cfg.inner_steps) % cfg.checkpoint_every == 0:
-                        with trace_span("ckpt"):
-                            ckpt.save(real_step, state)
+                        _guarded_save(real_step, state)
 
             if profiling and real_step >= profile_stop:
                 jax.profiler.stop_trace()
@@ -1097,13 +1406,32 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     },
                     step=real_step,
                 )
+            if synced:
+                # preempt / watchdog emergency stop — round boundaries
+                # only (the preempt contract: a checkpoint within one
+                # round of the signal, at a resumable sync point)
+                _maybe_graceful_exit(real_step, state)
 
         if profiling:
             jax.profiler.stop_trace()
+        if fault_plan is not None:
+            # a fault fired during the FINAL dispatch unit (e.g. a stall
+            # in the last round's feed) has no later _pump_faults to
+            # drain it — flush the timeline before the run closes
+            for rec in fault_plan.drain_fired():
+                logger.log({"fault": rec.pop("kind"), **rec})
         if ckpt:
             if ckpt.latest_step != cfg.total_steps:  # orbax refuses overwrites
-                ckpt.save(cfg.total_steps, state, force=True)
-            ckpt.wait()
+                _guarded_save(cfg.total_steps, state, force=True)
+            try:
+                ckpt.wait()
+            except Exception as e:
+                # a failed BACKGROUND write surfacing at the final flush:
+                # the run's work is done — record loudly, don't destroy it
+                watchdog.alarm(
+                    "ckpt_save_failed", cfg.total_steps,
+                    error=f"{type(e).__name__}: {e}"[:300],
+                )
             ckpt.close()
         final_eval = {}
         if evaluator is not None:
@@ -1114,6 +1442,14 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 else evaluator(dl._fetch(state).snapshot, eval_set)
             )
         completed = True
+    except _EmergencyExit as e:
+        # the graceful-stop paths (preempt / watchdog checkpoint-exit):
+        # the checkpoint is already saved and flushed; close the manager
+        # here (the normal-path close above was skipped), run the shared
+        # teardown below, then leave with the latched exit code
+        emergency = e
+        if ckpt is not None:
+            ckpt.close()
     finally:
         # teardown runs on EVERY exit (an exception mid-train must not
         # leak the process-global tracer or leave the heartbeat daemon
@@ -1121,7 +1457,13 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         # logger (a post-close alarm would write to a closed file),
         # restore the previous tracer, and export the Chrome trace —
         # after a crash it shows exactly which phase the run died in.
-        watchdog.stop("finished" if completed else "crashed")
+        watchdog.stop(
+            "finished" if completed else (
+                "preempted"
+                if emergency is not None and emergency.code == PREEMPT_EXIT_CODE
+                else "crashed"
+            )
+        )
         if telemetry is not None:
             # after watchdog.stop so a last-instant scrape reads the
             # terminal state, before logger.finish so no observe() ever
@@ -1144,6 +1486,23 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             except OSError:
                 pass  # a full disk must not mask the real outcome
         logger.finish()
+        # un-arm the resilience machinery: the fault plan, signal
+        # handlers, and stall-escalation timers are process-global and
+        # must not leak into (or kill) whatever this process does next
+        _run_alive["v"] = False
+        for _t in _stall_timers:
+            _t.cancel()
+        if fault_plan is not None:
+            _faults.clear_plan()
+        for _sig, _h in prev_sig.items():
+            try:
+                signal.signal(_sig, _h)
+            except (ValueError, OSError):
+                pass
+    if emergency is not None:
+        # distinct exit class for the supervisor: 75 = clean preemption
+        # (resume immediately, no budget), 76 = watchdog-forced exit
+        raise SystemExit(emergency.code)
     total_time = compute_time + sync_timer.total
     if fused:
         sync_summary = fused_sync_metrics

@@ -18,6 +18,10 @@ ceremony:
   4. a telemetry scrape: a short real run served over --metrics-port,
      /healthz + /metrics pulled over the wire and the gauges recorded —
      the production scrape path proven on the chip.
+  5. a resilience drill: launch a live run, SIGTERM it mid-round, assert
+     a clean preemption checkpoint + the preempt exit code (75), then
+     let `supervise` resume it to completion from that checkpoint — the
+     preempt/resume loop proven on the chip, not just in the CPU tests.
 
 Usage (each phase also runs alone):
     python scripts/chip_agenda.py               # everything
@@ -331,12 +335,112 @@ def phase_telemetry() -> None:
     })
 
 
+def phase_resilience() -> None:
+    """The preemption drill against a REAL (short) training run on this
+    backend: SIGTERM the live CLI mid-round, assert a clean preemption
+    checkpoint lands with the distinct preempt exit code, then run
+    `supervise` over the same flags and assert it resumes from that
+    checkpoint (no restart budget consumed) and completes within one
+    round of where the preempt left off."""
+    import signal
+    import tempfile
+
+    from nanodiloco_tpu.resilience.supervisor import (
+        PREEMPT_EXIT_CODE,
+        latest_checkpoint_step,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="nanodiloco-resilience-")
+    ckpt = os.path.join(tmp, "ckpt")
+    model_cfg = os.path.join(tmp, "model.json")
+    with open(model_cfg, "w") as f:
+        json.dump({
+            "vocab_size": 2048, "hidden_size": 128, "intermediate_size": 256,
+            "num_attention_heads": 4, "num_hidden_layers": 2,
+            "max_position_embeddings": 256,
+        }, f)
+    inner = 2
+    args = [
+        "--total-steps", "40", "--inner-steps", str(inner),
+        "--batch-size", "8", "--per-device-batch-size", "4",
+        "--seq-length", "256", "--warmup-steps", "2",
+        "--llama-config-file", model_cfg, "--no-measure-comm",
+        "--no-cost-analysis", "--quiet",
+        "--checkpoint-dir", ckpt, "--log-dir", tmp,
+        "--run-name", "resilience-probe",
+    ]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nanodiloco_tpu", *args],
+        cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    jsonl = os.path.join(tmp, "resilience-probe.jsonl")
+    budget = float(
+        os.environ.get("NANODILOCO_AGENDA_TIMEOUT_RESILIENCE", "1200")
+    )
+    deadline = time.time() + budget * 0.4
+    # preempt once the run is demonstrably live (a metric line exists)
+    while time.time() < deadline and proc.poll() is None:
+        if os.path.exists(jsonl) and os.path.getsize(jsonl) > 0:
+            break
+        time.sleep(0.2)
+    if proc.poll() is not None:
+        record({"phase": "resilience",
+                "error": proc.communicate()[0][-400:]})
+        raise SystemExit(1)
+    proc.send_signal(signal.SIGTERM)
+    t0 = time.time()
+    out, _ = proc.communicate()
+    preempt_s = time.time() - t0
+    step = latest_checkpoint_step(ckpt)
+    if proc.returncode != PREEMPT_EXIT_CODE or step is None or step % inner:
+        record({
+            "phase": "resilience",
+            "error": f"preempt exit {proc.returncode} (want "
+                     f"{PREEMPT_EXIT_CODE}), checkpoint step {step}",
+            "tail": out[-400:],
+        })
+        raise SystemExit(1)
+    sup = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu", "supervise",
+         "--max-restarts", "1", "--checkpoint-dir", ckpt, "--", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        timeout=budget * 0.5,
+    )
+    if sup.returncode != 0:
+        record({"phase": "resilience",
+                "error": f"supervised resume exit {sup.returncode}",
+                "tail": (sup.stdout or "")[-400:]})
+        raise SystemExit(1)
+    # the resume record proves the supervised run continued from the
+    # preempt checkpoint instead of restarting at step 0
+    resumed_from = None
+    with open(jsonl) as f:
+        for ln in f:
+            try:
+                r = json.loads(ln)
+            except ValueError:
+                continue
+            if "resume" in r:
+                resumed_from = r["resume"]
+    record({
+        "phase": "resilience",
+        "preempt_exit_code": proc.returncode,
+        "preempt_checkpoint_step": step,
+        "preempt_latency_s": round(preempt_s, 2),
+        "resumed_from_step": resumed_from,
+        "final_checkpoint_step": latest_checkpoint_step(ckpt),
+        "supervised_exit_code": sup.returncode,
+    })
+
+
 PHASES = {
     "bench": phase_bench,
     "sweep": phase_sweep,
     "pallas": phase_pallas,
     "profile": phase_profile,
     "telemetry": phase_telemetry,
+    "resilience": phase_resilience,
 }
 
 
@@ -374,6 +478,7 @@ PHASE_TIMEOUT_S = {
     "pallas": 2700,
     "profile": 1200,
     "telemetry": 900,
+    "resilience": 1200,
 }
 
 

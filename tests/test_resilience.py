@@ -1,0 +1,926 @@
+"""Resilience stack: fault injection, retry/backoff, preemption, supervision.
+
+The fault-matrix contract: every injected fault must produce the same
+outcome as the corresponding hand-crafted-state unit test (nan_params ≡
+the quarantine surgery tests in test_diloco.py), and every recovery
+path (crash resume, preempt resume, save-failure degradation) must be
+provable deterministically — no wall-clock randomness, no real
+accelerator, no luck. Multi-process variants (real CLI + supervise) are
+marked ``slow``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanodiloco_tpu.models.config import LlamaConfig
+from nanodiloco_tpu.resilience.faults import (
+    CRASH_EXIT_CODE,
+    FaultPlan,
+    InjectedCrash,
+    clear_plan,
+    install_plan,
+    poison_worker_params,
+)
+from nanodiloco_tpu.resilience.retry import (
+    RetryError,
+    RetryPolicy,
+    backoff_delays,
+    retry_call,
+)
+from nanodiloco_tpu.resilience.supervisor import (
+    PREEMPT_EXIT_CODE,
+    WATCHDOG_EXIT_CODE,
+    Supervisor,
+    SupervisorConfig,
+    latest_checkpoint_step,
+)
+from nanodiloco_tpu.training.train_loop import TrainConfig, _finite_worker_mean, train
+
+SMALL_MODEL = LlamaConfig(
+    vocab_size=384, hidden_size=32, intermediate_size=64,
+    num_attention_heads=4, num_hidden_layers=2, max_position_embeddings=64,
+)
+
+
+def small_cfg(tmp_path, **kw):
+    defaults = dict(
+        seed=1337, batch_size=4, per_device_batch_size=2, seq_length=32,
+        warmup_steps=2, total_steps=9, inner_steps=3, lr=1e-3, num_workers=2,
+        model=SMALL_MODEL, log_dir=str(tmp_path / "runs"), quiet=True,
+        measure_comm=False,
+    )
+    defaults.update(kw)
+    return TrainConfig(**defaults)
+
+
+def write_plan(tmp_path, faults, name="plan.json"):
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        json.dump({"faults": faults}, f)
+    return path
+
+
+def run_jsonl(tmp_path, run_name):
+    return str(tmp_path / "runs" / f"{run_name}.jsonl")
+
+
+def read_lines(path):
+    return [json.loads(l) for l in open(path)]
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    # a test that dies mid-train must not leave its plan armed for the
+    # next test's train() (train() clears on every exit, this is belt
+    # and braces for asserts that fire before train runs)
+    yield
+    clear_plan()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan parsing / firing mechanics
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_validates_schema():
+    with pytest.raises(ValueError, match="unknown kind"):
+        FaultPlan([{"kind": "meteor", "step": 1}])
+    with pytest.raises(ValueError, match="integer step"):
+        FaultPlan([{"kind": "crash", "step": -1}])
+    with pytest.raises(ValueError, match="integer step"):
+        FaultPlan([{"kind": "crash", "step": "soon"}])
+    with pytest.raises(ValueError, match="op must be"):
+        FaultPlan([{"kind": "io_error", "step": 1, "op": "delete"}])
+    with pytest.raises(ValueError, match="integer worker"):
+        FaultPlan([{"kind": "nan_params", "step": 1}])
+    with pytest.raises(ValueError, match='"faults"'):
+        FaultPlan.from_dict({"fault": []})
+
+
+def test_fault_plan_fires_once_by_step_cursor():
+    p = FaultPlan([
+        {"kind": "nan_params", "step": 4, "worker": 0},
+        {"kind": "stall", "step": 2, "seconds": 0.01},
+        {"kind": "io_error", "step": 1, "op": "save", "count": 2},
+    ])
+    assert p.take_due("nan_params") == []      # cursor at -1: nothing due
+    assert p.stall_seconds() == 0.0
+    assert not p.io_should_fail("save")
+    p.advance(4)
+    assert len(p.take_due("nan_params")) == 1
+    assert p.take_due("nan_params") == []      # once
+    assert p.stall_seconds() == 0.01 and p.stall_seconds() == 0.0
+    assert p.io_should_fail("save") and p.io_should_fail("save")
+    assert not p.io_should_fail("save")        # count exhausted
+    assert not p.io_should_fail("restore")     # op-scoped
+    kinds = [r["kind"] for r in p.drain_fired()]
+    assert sorted(kinds) == ["io_error", "nan_params", "stall"]
+    assert p.drain_fired() == []
+
+
+def test_fault_plan_marker_survives_process_death(tmp_path):
+    """The crash fault kills the process; the SAME plan file reloaded
+    after resume must not re-fire it (else the supervisor crash-loops a
+    deterministic fault forever)."""
+    plan_path = write_plan(tmp_path, [{"kind": "crash", "step": 3}])
+    p1 = FaultPlan.load(plan_path)
+    p1.advance(5)
+    assert len(p1.take_due("crash")) == 1
+    p2 = FaultPlan.load(plan_path)  # "after the restart"
+    p2.advance(5)
+    assert p2.take_due("crash") == []
+
+
+def test_hooks_are_noops_without_a_plan():
+    from nanodiloco_tpu.resilience import faults
+
+    assert faults.active_plan() is None
+    faults.check_io("save")   # must not raise
+    faults.maybe_stall()      # must not sleep
+
+
+# ---------------------------------------------------------------------------
+# retry/backoff
+# ---------------------------------------------------------------------------
+
+def test_retry_succeeds_after_transient_failures():
+    calls = {"n": 0}
+    notes = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("blip")
+        return "ok"
+
+    out = retry_call(
+        flaky, op="t", policy=RetryPolicy(max_attempts=4, base_delay_s=0.01),
+        on_retry=lambda a, e, d: notes.append((a, str(e), d)),
+        sleep=lambda s: None,
+    )
+    assert out == "ok" and calls["n"] == 3
+    assert [a for a, _, _ in notes] == [1, 2]
+
+
+def test_retry_exhausts_attempts_and_raises():
+    def dead():
+        raise OSError("disk on fire")
+
+    with pytest.raises(RetryError, match="disk on fire"):
+        retry_call(
+            dead, op="t", policy=RetryPolicy(max_attempts=3, base_delay_s=0.01),
+            sleep=lambda s: None,
+        )
+
+
+def test_retry_respects_deadline():
+    clock = {"t": 0.0}
+    slept = []
+
+    def dead():
+        raise OSError("x")
+
+    with pytest.raises(RetryError):
+        retry_call(
+            dead, op="t",
+            policy=RetryPolicy(max_attempts=100, base_delay_s=10.0,
+                               max_delay_s=10.0, deadline_s=12.0),
+            sleep=lambda s: (slept.append(s), clock.__setitem__("t", clock["t"] + s)),
+            clock=lambda: clock["t"],
+        )
+    assert len(slept) <= 2  # the deadline cut the schedule short
+
+
+def test_retry_non_retryable_propagates_immediately():
+    def broken():
+        raise TypeError("programming error")
+
+    with pytest.raises(TypeError):
+        retry_call(broken, op="t", retry_on=(OSError,), sleep=lambda s: None)
+
+
+def test_backoff_delays_exponential_and_jitter_bounded():
+    import random
+
+    pol = RetryPolicy(max_attempts=5, base_delay_s=1.0, max_delay_s=4.0)
+    for seed in range(5):
+        d = backoff_delays(pol, random.Random(seed))
+        assert len(d) == 4
+        for i, cap in enumerate([1.0, 2.0, 4.0, 4.0]):
+            assert cap / 2.0 <= d[i] <= cap
+
+
+# ---------------------------------------------------------------------------
+# satellite: _finite_worker_mean must propagate an all-dead round
+# ---------------------------------------------------------------------------
+
+def test_finite_worker_mean_all_dead_propagates_nan():
+    """A fully-diverged round used to read 0.0 — a perfect fake loss
+    that kept the nan_loss sentinel silent. All-non-finite rows must
+    read NaN; partial rows keep the finite mean."""
+    losses = jnp.asarray([[1.0, jnp.nan], [jnp.nan, jnp.inf], [2.0, 4.0]])
+    out = np.asarray(_finite_worker_mean(losses))
+    assert out[0] == pytest.approx(1.0)
+    assert np.isnan(out[1])
+    assert out[2] == pytest.approx(3.0)
+
+
+# ---------------------------------------------------------------------------
+# fault matrix: nan_params ≡ the hand-crafted quarantine surgery
+# ---------------------------------------------------------------------------
+
+def test_injected_nan_equals_handcrafted_poison():
+    """The injection helper must perform EXACTLY the surgery the
+    hand-crafted quarantine unit tests perform (test_diloco.py poisons
+    with ``p.at[k].set(nan)``): same poisoned state, and therefore the
+    same quarantine/heal outcome through a fused round."""
+    from nanodiloco_tpu.parallel.diloco import Diloco, DilocoConfig
+    from nanodiloco_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    W, H = 4, 2
+    mesh = build_mesh(MeshConfig(diloco=W))
+    cfg = DilocoConfig(num_workers=W, inner_steps=H, warmup_steps=0,
+                       total_steps=20, lr=1e-3, quarantine_nonfinite=True)
+    dl = Diloco(SMALL_MODEL, cfg, mesh)
+    state = dl.init_state(jax.random.key(0))
+    base = jax.tree.map(np.asarray, state)
+    mk = lambda: jax.tree.map(jnp.asarray, base)
+
+    injected = poison_worker_params(mk(), 2)
+    hand = mk().replace(params=jax.tree.map(
+        lambda p: p.at[2].set(jnp.nan), mk().params
+    ))
+    for a, b in zip(jax.tree.leaves(injected.params), jax.tree.leaves(hand.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def batch(t):
+        k1, k2 = jax.random.split(jax.random.key(100 + t))
+        toks = jax.random.randint(k1, (W, 1, 2, 16), 0, SMALL_MODEL.vocab_size)
+        del k2
+        return toks, jnp.ones_like(toks)
+
+    batches = [batch(t) for t in range(H)]
+    s_inj, l_inj = dl.run_round(injected, iter(batches))
+    s_hand, l_hand = dl.run_round(hand, iter(batches))
+    np.testing.assert_array_equal(np.asarray(l_inj), np.asarray(l_hand))
+    for a, b in zip(jax.tree.leaves(s_inj.snapshot), jax.tree.leaves(s_hand.snapshot)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert np.isfinite(np.asarray(a)).all()  # quarantined AND healed
+
+
+def test_nan_fault_through_live_loop_quarantines_and_heals(tmp_path):
+    """nan_params through the REAL driver (fused default): the fault
+    record lands in the JSONL, the sync covering the step quarantines
+    exactly one worker, and the run ends fully finite — the end-to-end
+    proof the hand-crafted unit tests could not give."""
+    plan = write_plan(tmp_path, [{"kind": "nan_params", "step": 4, "worker": 1}])
+    summary = train(small_cfg(
+        tmp_path, quarantine_nonfinite=True, fault_plan=plan,
+        run_name="nanfault",
+    ))
+    assert np.isfinite(summary["final_loss"])
+    for leaf in jax.tree.leaves(summary["state"].params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    lines = read_lines(run_jsonl(tmp_path, "nanfault"))
+    faults = [l for l in lines if l.get("fault")]
+    assert faults == [
+        {"fault": "nan_params", "step": 4, "worker": 1, "fired_at_step": 6}
+    ]
+    by_sync = {l["step"]: l.get("quarantined_workers")
+               for l in lines if l.get("outer_synced")}
+    assert by_sync[6] == 1          # the sync covering step 4
+    assert by_sync[3] == 0 and by_sync[9] == 0  # healed after
+
+
+def test_nan_fault_stepwise_fires_at_exact_step(tmp_path):
+    plan = write_plan(tmp_path, [{"kind": "nan_params", "step": 4, "worker": 0}])
+    summary = train(small_cfg(
+        tmp_path, quarantine_nonfinite=True, fault_plan=plan,
+        fused_rounds=False, run_name="nansw",
+    ))
+    assert np.isfinite(summary["final_loss"])
+    lines = read_lines(run_jsonl(tmp_path, "nansw"))
+    faults = [l for l in lines if l.get("fault")]
+    assert faults[0]["step"] == 4 and faults[0]["fired_at_step"] == 4
+    by_sync = {l["step"]: l.get("quarantined_workers")
+               for l in lines if l.get("outer_synced")}
+    assert by_sync[6] == 1
+
+
+# ---------------------------------------------------------------------------
+# io_error: retry then degrade
+# ---------------------------------------------------------------------------
+
+def test_io_error_fault_retries_and_recovers(tmp_path):
+    """Two consecutive injected save failures must be absorbed by the
+    retry path: training completes, the retry records land in the JSONL,
+    and checkpoints still exist."""
+    plan = write_plan(tmp_path, [
+        {"kind": "io_error", "step": 3, "op": "save", "count": 2},
+    ])
+    ck = str(tmp_path / "ckpt")
+    summary = train(small_cfg(
+        tmp_path, checkpoint_dir=ck, fault_plan=plan, run_name="ioretry",
+    ))
+    assert np.isfinite(summary["final_loss"])
+    assert latest_checkpoint_step(ck) == 9
+    lines = read_lines(run_jsonl(tmp_path, "ioretry"))
+    retries = [l for l in lines if l.get("retry") == "ckpt_save"]
+    assert len(retries) == 2
+    assert [l for l in lines if l.get("fault") == "io_error"]
+    # absorbed: no alarm, the run never knew
+    assert not [l for l in lines if l.get("alarm") == "ckpt_save_failed"]
+
+
+def test_persistent_save_failure_degrades_not_aborts(tmp_path):
+    """A save that fails past the whole retry budget must log a
+    ckpt_save_failed alarm and KEEP TRAINING — aborting would destroy
+    exactly the work checkpoints exist to protect. The next cadence
+    (after the fault's attempts are spent) saves normally."""
+    # enough attempts to outlast one save's retry budget (4 attempts),
+    # not the next save's
+    plan = write_plan(tmp_path, [
+        {"kind": "io_error", "step": 3, "op": "save", "count": 4},
+    ])
+    ck = str(tmp_path / "ckpt")
+    summary = train(small_cfg(
+        tmp_path, checkpoint_dir=ck, fault_plan=plan, run_name="iodead",
+    ))
+    assert np.isfinite(summary["final_loss"])  # the run survived
+    lines = read_lines(run_jsonl(tmp_path, "iodead"))
+    alarms = [l for l in lines if l.get("alarm") == "ckpt_save_failed"]
+    assert len(alarms) == 1 and "Injected" in alarms[0]["error"]
+    assert summary["alarms"] >= 1
+    # later cadences succeeded once the fault was spent
+    assert latest_checkpoint_step(ck) == 9
+
+
+def test_checkpoint_manager_surfaces_async_error_at_next_save(tmp_path, monkeypatch):
+    """Satellite: a failed BACKGROUND write must surface at the NEXT
+    save call (routed into the retry path), not only at teardown
+    wait() — until then the run believes it has checkpoints it
+    doesn't."""
+    from nanodiloco_tpu.training.checkpoint import CheckpointManager
+
+    events = []
+    mngr = CheckpointManager(
+        str(tmp_path / "ck"),
+        retry=RetryPolicy(max_attempts=2, base_delay_s=0.01, deadline_s=5.0),
+        on_event=events.append,
+    )
+    boom = [RuntimeError("async write exploded")]
+
+    def check():
+        if boom:
+            raise boom.pop()
+
+    monkeypatch.setattr(mngr._mngr, "check_for_errors", check, raising=False)
+    state = {"x": jnp.zeros((2,))}
+    # first attempt surfaces the background failure; the retry's second
+    # attempt finds check_for_errors clean and saves
+    mngr.save(3, state)
+    mngr.wait()
+    assert mngr.latest_step == 3
+    assert len(events) == 1 and events[0]["retry"] == "ckpt_save"
+    assert "async write exploded" in events[0]["error"]
+    mngr.close()
+
+
+def test_restore_hits_io_fault_and_retries(tmp_path):
+    """io_error op=restore exercises the restore-side retry wrap."""
+    from nanodiloco_tpu.training.checkpoint import CheckpointManager, abstract_state_like
+
+    ck = str(tmp_path / "ck")
+    events = []
+    mngr = CheckpointManager(
+        ck, retry=RetryPolicy(max_attempts=3, base_delay_s=0.01, deadline_s=5.0),
+        on_event=events.append,
+    )
+    state = {"x": jnp.arange(4.0)}
+    mngr.save(1, state)
+    mngr.wait()
+    plan = FaultPlan([{"kind": "io_error", "step": 0, "op": "restore", "count": 1}])
+    plan.advance(0)
+    install_plan(plan)
+    try:
+        out = mngr.restore(jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
+        ))
+    finally:
+        clear_plan()
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.arange(4.0))
+    assert len(events) == 1 and events[0]["retry"] == "ckpt_restore"
+    mngr.close()
+
+
+def test_restore_io_fault_fires_through_train(tmp_path):
+    """A step-0 io_error op=restore must hit the STARTUP restore of a
+    resumed train() (the plan is armed before the startup IO): the
+    retry absorbs it and the resumed run completes."""
+    ck = str(tmp_path / "ckpt")
+    train(small_cfg(tmp_path / "a", total_steps=3, checkpoint_dir=ck,
+                    run_name="part"))
+    plan = write_plan(tmp_path, [
+        {"kind": "io_error", "step": 0, "op": "restore", "count": 1},
+    ])
+    summary = train(small_cfg(tmp_path / "b", checkpoint_dir=ck,
+                              fault_plan=plan, run_name="res"))
+    assert np.isfinite(summary["final_loss"])
+    lines = read_lines(run_jsonl(tmp_path / "b", "res"))
+    assert [l for l in lines if l.get("retry") == "ckpt_restore"]
+    assert [l for l in lines if "resume" in l][0]["resume"] == 3
+
+
+# ---------------------------------------------------------------------------
+# stall through the feed
+# ---------------------------------------------------------------------------
+
+def test_stall_fault_sleeps_in_feed_and_is_recorded(tmp_path):
+    plan = write_plan(tmp_path, [{"kind": "stall", "step": 4, "seconds": 0.4}])
+    t0 = time.perf_counter()
+    summary = train(small_cfg(tmp_path, fault_plan=plan, run_name="stall"))
+    elapsed = time.perf_counter() - t0
+    assert np.isfinite(summary["final_loss"])
+    lines = read_lines(run_jsonl(tmp_path, "stall"))
+    stalls = [l for l in lines if l.get("fault") == "stall"]
+    assert len(stalls) == 1 and stalls[0]["seconds"] == 0.4
+    assert elapsed >= 0.4  # the sleep really happened in the data path
+
+
+def test_feed_stall_trips_watchdog_for_real():
+    """The injected feed stall must trip the watchdog's stall sentinel
+    through the REAL heartbeat machinery (not an injected clock): beats
+    establish a cadence, the stalled feed call opens a silent gap, and
+    check_stall fires on the real monotonic clock."""
+    from nanodiloco_tpu.obs import Watchdog, WatchdogConfig
+    from nanodiloco_tpu.parallel.feed import BatchFeeder
+    from nanodiloco_tpu.parallel.mesh import MeshConfig, build_mesh
+    from jax.sharding import PartitionSpec as P
+
+    alarms = []
+    wd = Watchdog(
+        WatchdogConfig(stall_factor=2.0, min_stall_s=0.3),
+        emit=alarms.append,
+    )
+    for step in range(4):  # ~20ms cadence
+        wd.heartbeat(step)
+        time.sleep(0.02)
+    feeder = BatchFeeder(build_mesh(MeshConfig()), P(None))
+    plan = FaultPlan([{"kind": "stall", "step": 0, "seconds": 0.5}])
+    plan.advance(0)
+    install_plan(plan)
+    try:
+        feeder(np.zeros((2, 2), np.int32))  # sleeps 0.5 s in the feed
+    finally:
+        clear_plan()
+    assert wd.check_stall() is True
+    assert alarms and alarms[0]["alarm"] == "stall"
+
+
+# ---------------------------------------------------------------------------
+# crash + resume (the acceptance criterion, in-process raise mode)
+# ---------------------------------------------------------------------------
+
+def test_crash_resume_matches_uninterrupted_at_every_boundary(tmp_path):
+    """A crash at an arbitrary step, resumed from the latest checkpoint,
+    must match the uninterrupted run's loss at EVERY subsequent round
+    boundary bit-exactly (classic path), and end with bit-identical
+    params."""
+    full = train(small_cfg(tmp_path / "a", run_name="full"))
+    full_lines = read_lines(run_jsonl(tmp_path / "a", "full"))
+
+    plan = write_plan(tmp_path, [{"kind": "crash", "step": 5, "raise": True}])
+    ck = str(tmp_path / "ckpt")
+    with pytest.raises(InjectedCrash):
+        train(small_cfg(tmp_path / "b", checkpoint_dir=ck, fault_plan=plan,
+                        run_name="crashed"))
+    # the boundary save is async and the crash (by design) does not wait
+    # for it; orbax's background writer commits shortly after
+    deadline = time.time() + 30
+    while latest_checkpoint_step(ck) != 3 and time.time() < deadline:
+        time.sleep(0.1)
+    assert latest_checkpoint_step(ck) == 3  # the pre-crash boundary
+    # resume with the SAME plan file: the fired marker prevents a
+    # deterministic crash loop
+    resumed = train(small_cfg(tmp_path / "c", checkpoint_dir=ck,
+                              fault_plan=plan, run_name="resumed"))
+    res_lines = read_lines(run_jsonl(tmp_path / "c", "resumed"))
+    assert [l for l in res_lines if "resume" in l][0]["resume"] == 3
+    full_by_step = {l["step"]: l["loss"] for l in full_lines if "loss" in l}
+    for l in res_lines:
+        if "loss" in l:
+            assert l["loss"] == full_by_step[l["step"]], l["step"]
+    for x, y in zip(jax.tree.leaves(full["state"].params),
+                    jax.tree.leaves(resumed["state"].params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_crash_exit_code_is_distinct():
+    assert CRASH_EXIT_CODE not in (0, PREEMPT_EXIT_CODE, WATCHDOG_EXIT_CODE)
+
+
+# ---------------------------------------------------------------------------
+# preemption: SIGTERM -> boundary checkpoint -> exit 75 -> resume
+# ---------------------------------------------------------------------------
+
+def test_sigterm_checkpoints_at_boundary_and_exits_preempt_code(tmp_path):
+    ck = str(tmp_path / "ckpt")
+    stop_poll = threading.Event()
+
+    def kill_when_armed():
+        # fire only once train() has installed its preempt handler — a
+        # SIGTERM before that hits the interpreter default and kills the
+        # test process itself
+        deadline = time.time() + 120
+        while time.time() < deadline and not stop_poll.is_set():
+            if callable(signal.getsignal(signal.SIGTERM)):
+                os.kill(os.getpid(), signal.SIGTERM)
+                return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=kill_when_armed, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(SystemExit) as e:
+            train(small_cfg(tmp_path, total_steps=30_000, checkpoint_dir=ck,
+                            run_name="pre"))
+    finally:
+        stop_poll.set()
+        t.join(timeout=5)
+    assert e.value.code == PREEMPT_EXIT_CODE
+    step = latest_checkpoint_step(ck)
+    assert step is not None and step % 3 == 0 and step > 0  # a round boundary
+    lines = read_lines(run_jsonl(tmp_path, "pre"))
+    pre = [l for l in lines if l.get("preempt")]
+    assert pre and pre[0]["preempt"] == "preempt"
+    assert pre[0]["exit_code"] == PREEMPT_EXIT_CODE
+    assert pre[0]["checkpoint_step"] == step
+    # the preempted run resumes to a completion that matches an
+    # uninterrupted run (same seed, deterministic data order)
+    resumed = train(small_cfg(tmp_path / "resume", total_steps=step + 3,
+                              checkpoint_dir=ck, run_name="res"))
+    full = train(small_cfg(tmp_path / "full", total_steps=step + 3,
+                           run_name="full"))
+    for x, y in zip(jax.tree.leaves(full["state"].params),
+                    jax.tree.leaves(resumed["state"].params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_watchdog_nan_checkpoint_exit(tmp_path):
+    """--watch-action checkpoint-exit: a nan_loss alarm (quarantine OFF,
+    so the NaN reaches the logged loss) exits with the watchdog code at
+    the next round boundary, for the supervisor to classify as a
+    crash."""
+    plan = write_plan(tmp_path, [{"kind": "nan_params", "step": 2, "worker": 0}])
+    ck = str(tmp_path / "ckpt")
+    with pytest.raises(SystemExit) as e:
+        train(small_cfg(tmp_path, fault_plan=plan, checkpoint_dir=ck,
+                        watch_action="checkpoint-exit", run_name="wexit"))
+    assert e.value.code == WATCHDOG_EXIT_CODE
+    lines = read_lines(run_jsonl(tmp_path, "wexit"))
+    assert [l for l in lines if l.get("alarm") == "nan_loss"]
+    pre = [l for l in lines if l.get("preempt")]
+    assert pre and pre[0]["preempt"] == "watchdog:nan_loss"
+    assert pre[0]["exit_code"] == WATCHDOG_EXIT_CODE
+
+
+def test_watch_action_validated(tmp_path):
+    with pytest.raises(ValueError, match="watch_action"):
+        train(small_cfg(tmp_path, watch_action="explode"))
+
+
+def test_fault_plan_worker_bound_validated(tmp_path):
+    plan = write_plan(tmp_path, [{"kind": "nan_params", "step": 1, "worker": 7}])
+    with pytest.raises(ValueError, match="only 2 worker"):
+        train(small_cfg(tmp_path, fault_plan=plan))
+
+
+# ---------------------------------------------------------------------------
+# supervisor policy (fake children: fast, deterministic)
+# ---------------------------------------------------------------------------
+
+CHILD = r"""
+import os, sys
+cnt_file = sys.argv[1]
+codes = [int(c) for c in sys.argv[2].split(",")]
+ckpt_dir = sys.argv[3] if len(sys.argv) > 3 and sys.argv[3] != "-" else None
+n = int(open(cnt_file).read()) if os.path.exists(cnt_file) else 0
+open(cnt_file, "w").write(str(n + 1))
+if ckpt_dir:
+    os.makedirs(os.path.join(ckpt_dir, str((n + 1) * 3)), exist_ok=True)
+argv_log = os.environ.get("CHILD_ARGV_LOG")
+if argv_log:
+    with open(argv_log, "a") as f:
+        f.write(" ".join(sys.argv[4:]) + "\n")
+sys.exit(codes[min(n, len(codes) - 1)])
+"""
+
+
+def child_cmd(tmp_path, codes, ckpt="-", extra=()):
+    return [sys.executable, "-c", CHILD, str(tmp_path / "count"), codes,
+            ckpt, *extra]
+
+
+def test_supervisor_preempt_resumes_without_budget(tmp_path):
+    """Two preempt exits then success, with a ZERO crash budget: the
+    supervisor must restart immediately (no backoff sleep) and exit 0 —
+    preemption is the operating mode, not a failure."""
+    events = []
+    slept = []
+    sup = Supervisor(
+        child_cmd(tmp_path, f"{PREEMPT_EXIT_CODE},{PREEMPT_EXIT_CODE},0"),
+        SupervisorConfig(max_restarts=0),
+        emit=events.append, sleep=slept.append,
+    )
+    assert sup.run() == 0
+    assert sup.restarts == 2 and sup.budget_used == 0
+    assert slept == []
+    kinds = [e["event"] for e in events]
+    assert kinds.count("preempt_resume") == 2 and kinds[-1] == "finished"
+
+
+def test_supervisor_crash_burns_budget_and_gives_up(tmp_path):
+    """Progress-less crashes count DOUBLE: with budget 3, the second
+    no-progress crash (cost 2 + 2 = 4 > 3) ends the job."""
+    events = []
+    sup = Supervisor(
+        child_cmd(tmp_path, "9"),
+        SupervisorConfig(max_restarts=3, degrade_after=99),
+        emit=events.append, sleep=lambda s: None,
+    )
+    assert sup.run() == 9
+    assert sup.budget_used == 4
+    assert [e for e in events if e["event"] == "giveup"]
+    crashes = [e for e in events if e["event"] == "crash"]
+    assert all(e["advanced"] is False for e in crashes)
+
+
+def test_supervisor_progress_halves_crash_cost(tmp_path):
+    """A crash AFTER checkpoint progress costs 1; the fake child commits
+    a new checkpoint step every launch, so budget 3 covers exactly 3
+    crashes before the 4th ends the job."""
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+    events = []
+    sup = Supervisor(
+        child_cmd(tmp_path, "9", ckpt=str(ck)),
+        SupervisorConfig(max_restarts=3, degrade_after=99,
+                         checkpoint_dir=str(ck)),
+        emit=events.append, sleep=lambda s: None,
+    )
+    assert sup.run() == 9
+    crashes = [e for e in events if e["event"] == "crash"]
+    assert all(e["advanced"] is True for e in crashes)
+    assert sup.budget_used == 4 and len(crashes) == 4
+
+
+def test_supervisor_watchdog_exit_counts_as_crash(tmp_path):
+    events = []
+    sup = Supervisor(
+        child_cmd(tmp_path, f"{WATCHDOG_EXIT_CODE},0"),
+        SupervisorConfig(max_restarts=3),
+        emit=events.append, sleep=lambda s: None,
+    )
+    assert sup.run() == 0
+    crash = [e for e in events if e["event"] == "crash"][0]
+    assert crash["reason"] == "watchdog" and sup.budget_used == 2
+
+
+def test_supervisor_degrades_worker_count(tmp_path, monkeypatch):
+    """After degrade_after consecutive no-progress crashes, the child is
+    relaunched with --num-workers halved (elastic resume restores the
+    snapshot at the new width), floored at min_workers."""
+    argv_log = str(tmp_path / "argv.log")
+    monkeypatch.setenv("CHILD_ARGV_LOG", argv_log)
+    events = []
+    sup = Supervisor(
+        child_cmd(tmp_path, "9", extra=("--num-workers", "4")),
+        SupervisorConfig(max_restarts=50, degrade_after=2, min_workers=1),
+        emit=events.append, sleep=lambda s: None,
+    )
+    assert sup.run() == 9
+    degrades = [(e["workers_from"], e["workers_to"])
+                for e in events if e["event"] == "degrade"]
+    assert degrades == [(4, 2), (2, 1)]
+    assert sup.workers == 1
+    launches = open(argv_log).read().splitlines()
+    assert "--num-workers 4" in launches[0]
+    assert "--num-workers 1" in launches[-1]
+
+
+def test_latest_checkpoint_step_reads_committed_dirs_only(tmp_path):
+    assert latest_checkpoint_step(str(tmp_path / "missing")) is None
+    d = tmp_path / "ck"
+    d.mkdir()
+    assert latest_checkpoint_step(str(d)) is None
+    (d / "3").mkdir()
+    (d / "12").mkdir()
+    (d / "15.orbax-checkpoint-tmp-123").mkdir()  # staged, uncommitted
+    (d / "model_config.json").write_text("{}")
+    assert latest_checkpoint_step(str(d)) == 12
+
+
+# ---------------------------------------------------------------------------
+# watchdog explicit alarms + telemetry counters
+# ---------------------------------------------------------------------------
+
+def test_watchdog_explicit_alarm_is_per_event():
+    from nanodiloco_tpu.obs import Watchdog
+
+    recs = []
+    wd = Watchdog(emit=recs.append)
+    wd.alarm("ckpt_save_failed", 3, error="x")
+    wd.alarm("ckpt_save_failed", 6, error="y")
+    assert wd.alarm_count == 2
+    assert wd.alarm_kinds == {"ckpt_save_failed": 2}
+    assert [r["step"] for r in recs] == [3, 6]
+
+
+def test_watchdog_on_fatal_fires_for_fatal_kinds_only():
+    from nanodiloco_tpu.obs import Watchdog
+
+    fatal = []
+    wd = Watchdog(emit=lambda r: None, on_fatal=lambda k, s: fatal.append(k))
+    wd.observe_loss(1, float("nan"))
+    wd.observe_throughput(2, 1.0)
+    assert fatal == ["nan_loss"]
+
+
+def test_telemetry_resilience_counters():
+    from nanodiloco_tpu.obs.telemetry import TelemetryServer, parse_metrics_text
+
+    srv = TelemetryServer(port=0)
+    try:
+        srv.observe({"fault": "crash", "step": 5})
+        srv.observe({"fault": "nan_params", "step": 4})
+        srv.observe({"retry": "ckpt_save", "attempt": 1})
+        srv.observe({"resume": 3, "restart_count": 2, "elastic": False})
+        m = parse_metrics_text(srv.render_metrics())
+        assert m['nanodiloco_faults_total{kind="crash"}'] == 1
+        assert m["nanodiloco_faults_total"] == 2
+        assert m['nanodiloco_retries_total{op="ckpt_save"}'] == 1
+        assert m["nanodiloco_resumes_total"] == 1
+        assert m["nanodiloco_restarts"] == 2
+    finally:
+        srv._httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# report / summarize: the fault timeline is reconstructable
+# ---------------------------------------------------------------------------
+
+def test_summarize_and_report_faults_timeline(tmp_path, capsys):
+    from nanodiloco_tpu.cli import report_faults_main
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    path = str(tmp_path / "run.jsonl")
+    recs = [
+        {"loss": 5.0, "step": 1},
+        {"fault": "io_error", "step": 3, "op": "save", "count": 0},
+        {"retry": "ckpt_save", "attempt": 1, "delay_s": 0.1, "error": "x"},
+        {"alarm": "ckpt_save_failed", "step": 3, "error": "x"},
+        {"fault": "crash", "step": 5, "code": 71, "fired_at_step": 6},
+        {"resume": 3, "restart_count": 1, "elastic": False, "step": 3},
+        {"loss": 4.0, "step": 4, "outer_synced": 1},
+        {"preempt": "preempt", "exit_code": 75, "step": 6},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    s = summarize_run(path)
+    assert s["faults"] == 2
+    assert s["fault_kinds"] == {"io_error": 1, "crash": 1}
+    assert s["resumes"] == 1 and s["restarts"] == 1
+    assert s["preempt_exits"] == 1 and s["io_retries"] == 1
+    report_faults_main([path, "--json"])
+    events = json.loads(capsys.readouterr().out)
+    assert [e["event"] for e in events] == [
+        "fault", "retry", "alarm", "fault", "resume", "preempt"
+    ]
+
+
+def test_cli_resilience_flags(tmp_path):
+    from nanodiloco_tpu.cli import build_parser, config_from_args
+
+    plan = write_plan(tmp_path, [])
+    args = build_parser().parse_args([
+        "--fault-plan", plan, "--watch-action", "checkpoint-exit",
+        "--no-preempt-signals",
+    ])
+    cfg = config_from_args(args)
+    assert cfg.fault_plan == plan
+    assert cfg.watch_action == "checkpoint-exit"
+    assert cfg.preempt_signals is False
+    dflt = config_from_args(build_parser().parse_args([]))
+    assert dflt.fault_plan is None and dflt.watch_action == "none"
+    assert dflt.preempt_signals is True
+
+
+# ---------------------------------------------------------------------------
+# multi-process variants (real CLI + supervise) — slow
+# ---------------------------------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli_args(tmp_path, total_steps, ckpt, run_name, extra=()):
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    model_cfg = tmp_path / "model.json"
+    model_cfg.write_text(json.dumps({
+        "vocab_size": 384, "hidden_size": 32, "intermediate_size": 64,
+        "num_attention_heads": 4, "num_hidden_layers": 2,
+        "max_position_embeddings": 64,
+    }))
+    return [
+        "--total-steps", str(total_steps), "--inner-steps", "3",
+        "--batch-size", "4", "--per-device-batch-size", "2",
+        "--seq-length", "32", "--warmup-steps", "2",
+        "--llama-config-file", str(model_cfg), "--no-measure-comm",
+        "--no-cost-analysis", "--quiet",
+        "--checkpoint-dir", ckpt, "--log-dir", str(tmp_path / "runs"),
+        "--run-name", run_name, *extra,
+    ]
+
+
+@pytest.mark.slow
+def test_real_process_sigterm_preempt_and_supervised_resume(tmp_path):
+    """The full multi-process story: SIGTERM a live CLI run mid-round ->
+    preempt checkpoint + exit 75; then `supervise` resumes it to
+    completion from that checkpoint."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    ck = str(tmp_path / "ckpt")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nanodiloco_tpu",
+         *_cli_args(tmp_path, 30_000, ck, "live")],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    jsonl = tmp_path / "runs" / "live.jsonl"
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        if jsonl.exists() and jsonl.read_text().strip():
+            break
+        assert proc.poll() is None, proc.communicate()[0][-2000:]
+        time.sleep(0.2)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=120)
+    assert proc.returncode == PREEMPT_EXIT_CODE, out[-2000:]
+    step = latest_checkpoint_step(ck)
+    assert step is not None and step % 3 == 0
+
+    sup = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu", "supervise",
+         "--max-restarts", "1", "--",
+         *_cli_args(tmp_path, step + 6, ck, "supervised")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert sup.returncode == 0, sup.stdout[-2000:] + sup.stderr[-2000:]
+    assert latest_checkpoint_step(ck) == step + 6
+    lines = read_lines(str(tmp_path / "runs" / "supervised.jsonl"))
+    assert [l for l in lines if "resume" in l][0]["resume"] == step
+
+
+@pytest.mark.slow
+def test_real_process_crash_fault_supervised_bit_exact(tmp_path):
+    """Acceptance: a hard crash (os._exit) at an arbitrary step under
+    `supervise` resumes from the latest checkpoint and matches the
+    uninterrupted run's loss at every subsequent round boundary."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    full = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu",
+         *_cli_args(tmp_path / "full", 12, str(tmp_path / "full-ck"), "full")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert full.returncode == 0, full.stdout[-2000:] + full.stderr[-2000:]
+    plan = write_plan(tmp_path, [{"kind": "crash", "step": 8}])
+    ck = str(tmp_path / "ckpt")
+    sup = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu", "supervise",
+         "--max-restarts", "4", "--backoff-base", "0.1", "--",
+         *_cli_args(tmp_path, 12, ck, "faulted",
+                    extra=("--fault-plan", plan))],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert sup.returncode == 0, sup.stdout[-2000:] + sup.stderr[-2000:]
+    full_lines = read_lines(str(tmp_path / "full" / "runs" / "full.jsonl"))
+    fault_lines = read_lines(str(tmp_path / "runs" / "faulted.jsonl"))
+    full_by_step = {l["step"]: l["loss"] for l in full_lines
+                    if "loss" in l and l.get("outer_synced")}
+    got_by_step = {}
+    for l in fault_lines:  # restarts append; later records win
+        if "loss" in l and l.get("outer_synced"):
+            got_by_step[l["step"]] = l["loss"]
+    assert set(full_by_step) == set(got_by_step)
+    for step, loss in full_by_step.items():
+        assert got_by_step[step] == loss, step
+    assert [l for l in fault_lines if l.get("fault") == "crash"]
+    assert [l for l in fault_lines if "resume" in l]

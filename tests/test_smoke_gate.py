@@ -38,23 +38,24 @@ SMOKE_MODEL = LlamaConfig(
 )
 
 
-def smoke_config(log_dir: str):
+def smoke_config(log_dir: str, **kw):
     """The ONE smoke definition both the gate and the baseline
-    regenerator run — they must never drift apart."""
+    regenerator run — they must never drift apart. ``kw`` lets variant
+    gates (the no-op fault plan below) ride the same definition."""
     from nanodiloco_tpu.training.train_loop import TrainConfig
 
     return TrainConfig(
         seed=1337, batch_size=4, per_device_batch_size=2, seq_length=32,
         warmup_steps=2, total_steps=6, inner_steps=3, lr=1e-3,
         num_workers=2, model=SMOKE_MODEL, log_dir=log_dir, quiet=True,
-        run_name="smoke", measure_comm=False,
+        run_name="smoke", measure_comm=False, **kw,
     )
 
 
-def _run_smoke(log_dir: str) -> str:
+def _run_smoke(log_dir: str, **kw) -> str:
     from nanodiloco_tpu.training.train_loop import train
 
-    train(smoke_config(log_dir))
+    train(smoke_config(log_dir, **kw))
     return os.path.join(log_dir, "smoke.jsonl")
 
 
@@ -68,6 +69,30 @@ def test_smoke_regression_gate(tmp_path):
     jsonl = _run_smoke(str(tmp_path))
     # raises SystemExit(1) on regression — THE gate, live in tier-1
     report_main(["compare", BASELINE, jsonl, "--max-tps-drop", "0.95"])
+
+
+def test_smoke_gate_under_noop_fault_plan(tmp_path):
+    """The resilience hook points (fault plan armed, no fault ever due)
+    must not perturb the training trajectory: the same smoke under a
+    no-op plan must be STEP-FOR-STEP IDENTICAL to a plan-free smoke and
+    still pass the committed-baseline gate — zero-cost-when-unused,
+    asserted, not assumed."""
+    from nanodiloco_tpu.cli import report_main
+
+    plan = str(tmp_path / "noop_plan.json")
+    with open(plan, "w") as f:
+        json.dump({"faults": [
+            {"kind": "crash", "step": 10_000_000},
+            {"kind": "stall", "step": 10_000_000, "seconds": 1.0},
+            {"kind": "io_error", "step": 10_000_000, "op": "save"},
+            {"kind": "nan_params", "step": 10_000_000, "worker": 0},
+        ]}, f)
+    bare = _run_smoke(str(tmp_path / "bare"))
+    hooked = _run_smoke(str(tmp_path / "hooked"), fault_plan=plan)
+    bare_losses = [json.loads(l).get("loss") for l in open(bare)]
+    hooked_losses = [json.loads(l).get("loss") for l in open(hooked)]
+    assert bare_losses == hooked_losses
+    report_main(["compare", BASELINE, hooked, "--max-tps-drop", "0.95"])
 
 
 def test_smoke_gate_actually_fires(tmp_path):

@@ -19,12 +19,15 @@ SMALL_MODEL = LlamaConfig(
 
 
 def _metric_lines(path):
-    """Per-step metric records from a run JSONL; the one-time
-    ``{"cost_analysis": ...}`` record (obs/costs) is run metadata, not
-    a step line, and would break step-count/index assertions."""
+    """Per-step metric records from a run JSONL; one-time metadata
+    records — ``{"cost_analysis": ...}`` (obs/costs) and the resilience
+    timeline's ``resume``/``fault``/``retry``/``preempt``/``alarm``
+    records — are not step lines and would break step-count/index
+    assertions."""
+    meta_keys = ("cost_analysis", "resume", "fault", "retry", "preempt", "alarm")
     return [
         r for r in (json.loads(l) for l in open(path))
-        if "cost_analysis" not in r
+        if not any(k in r for k in meta_keys)
     ]
 
 
