@@ -426,6 +426,99 @@ def generate_main(argv: list[str]) -> None:
         print(text_in + tokenizer.decode(ids_out))
 
 
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nanodiloco_tpu serve",
+        description="Continuous-batching inference server over a trained "
+                    "checkpoint (nanodiloco_tpu/serve): POST /v1/generate, "
+                    "GET /healthz, GET /metrics.",
+    )
+    p.add_argument("--checkpoint-dir", type=str, required=True,
+                   help="self-describing checkpoint written by training "
+                        "with --checkpoint-dir (model_config.json sidecar)")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step to load (default: latest)")
+    p.add_argument("--tokenizer", type=str, default=None,
+                   help="override the tokenizer recorded at training time")
+    p.add_argument("--port", type=int, default=0,
+                   help="HTTP port; 0 (default) picks a free port, printed "
+                        "at startup")
+    p.add_argument("--host", type=str, default="0.0.0.0")
+    p.add_argument("--slots", type=int, default=4,
+                   help="decode batch size B: concurrent requests decoded "
+                        "per tick; each slot owns a KV-cache region")
+    p.add_argument("--max-len", type=int, default=1024,
+                   help="per-slot cache length: prompt + max_new_tokens "
+                        "must fit (the compiled shape; longer requests "
+                        "get 400)")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="admission queue depth; a full queue answers 429 "
+                        "(backpressure)")
+    p.add_argument("--max-new-tokens", type=int, default=64,
+                   help="default completion length for requests that omit "
+                        "max_new_tokens")
+    p.add_argument("--max-new-tokens-cap", type=int, default=256,
+                   help="upper bound a request may ask for")
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="default per-request deadline: queued past it = "
+                        "expired, decoding past it = retired with partial "
+                        "output (unset = no deadline)")
+    p.add_argument("--request-timeout-s", type=float, default=600.0,
+                   help="HTTP-level wait bound per request")
+    p.add_argument("--force-cpu-devices", type=int, default=None, metavar="N",
+                   help="serve on N virtual CPU devices instead of the "
+                        "accelerator")
+    return p
+
+
+def serve_main(argv: list[str]) -> None:
+    args = build_serve_parser().parse_args(argv)
+    if args.force_cpu_devices:
+        from nanodiloco_tpu.utils import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(args.force_cpu_devices)
+    import signal
+    import threading
+    import time
+
+    from nanodiloco_tpu.data import get_tokenizer
+    from nanodiloco_tpu.serve import InferenceEngine, Scheduler, ServeServer
+
+    model_cfg, sidecar, params = _load_checkpoint_snapshot(
+        args.checkpoint_dir, args.step
+    )
+    tokenizer = get_tokenizer(args.tokenizer or sidecar.get("tokenizer"))
+    max_len = min(args.max_len, model_cfg.max_position_embeddings)
+    engine = InferenceEngine(
+        params, model_cfg, num_slots=args.slots, max_len=max_len,
+    )
+    scheduler = Scheduler(engine, max_queue=args.max_queue)
+    server = ServeServer(
+        scheduler, tokenizer,
+        port=args.port, host=args.host,
+        default_max_new_tokens=args.max_new_tokens,
+        max_new_tokens_cap=args.max_new_tokens_cap,
+        request_timeout_s=args.request_timeout_s,
+        default_deadline_s=args.deadline_s,
+    ).start()
+    print(
+        f"serving {args.checkpoint_dir} on {args.host}:{server.port} "
+        f"(slots={args.slots}, max_len={max_len}); POST /v1/generate",
+        flush=True,
+    )
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:  # not the main thread (embedded use)
+            break
+    try:
+        while not stop.is_set():
+            time.sleep(0.2)
+    finally:
+        server.stop()
+
+
 def _load_checkpoint_snapshot(checkpoint_dir: str, step: int | None):
     """(model_cfg, sidecar dict, snapshot params) from a self-describing
     checkpoint — only the merged global model is materialized, NOT the
@@ -766,6 +859,9 @@ def main(argv: list[str] | None = None) -> None:
         return
     if argv and argv[0] == "generate":
         generate_main(argv[1:])
+        return
+    if argv and argv[0] == "serve":
+        serve_main(argv[1:])
         return
     if argv and argv[0] == "export-hf":
         export_hf_main(argv[1:])

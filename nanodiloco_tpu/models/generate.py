@@ -371,9 +371,13 @@ def generate(
 
 def pad_prompts(prompts: list[list[int]], pad_id: int = 0):
     """Left-pad variable-length prompts to a common length; returns
-    (tokens [B, P], valid [B, P]) ready for ``generate``."""
+    (tokens [B, P], valid [B, P]) ready for ``generate``. An empty ROW is
+    allowed (all-pad, valid all zero — the caller decides whether an
+    empty prompt is meaningful); an empty LIST is not."""
     import numpy as np
 
+    if not prompts:
+        raise ValueError("pad_prompts needs at least one prompt")
     p = max(len(x) for x in prompts)
     toks = np.full((len(prompts), p), pad_id, np.int32)
     valid = np.zeros((len(prompts), p), np.int32)
@@ -382,3 +386,195 @@ def pad_prompts(prompts: list[list[int]], pad_id: int = 0):
             toks[i, p - len(x):] = x
             valid[i, p - len(x):] = 1
     return jnp.asarray(toks), jnp.asarray(valid)
+
+
+# ---------------------------------------------------------------------------
+# Slot-addressed serving programs (nanodiloco_tpu/serve)
+#
+# The continuous-batching engine owns ONE cache [L, B, S_max, Hkv, hd]
+# whose B rows are independent request slots at independent positions.
+# Two programs cover its whole life:
+#   - prefill_slot_fn: write one request's prompt K/V into its slot
+#     (the same ``_cached_block`` the one-shot ``generate`` prefill
+#     uses, so the two paths can never drift) and sample the first
+#     token; compiled once per (config, prompt_len, B, S_max).
+#   - decode_slots_fn: advance ALL slots one token with PER-SLOT
+#     positions, PRNG keys, and sampling params; compiled once per
+#     (config, B, S_max) — admitting or retiring a request never
+#     recompiles anything.
+# Sampling params ride as traced arrays (``_sample_slots`` mirrors
+# ``_sample`` op for op) so a new request with new temperature/top_k/
+# top_p reuses the same executable.
+# ---------------------------------------------------------------------------
+
+
+def _sample_slots(logits, keys, temperature, top_k, top_p):
+    """Per-slot ``_sample``: [B, V] logits with PER-ROW key / temperature /
+    top_k / top_p arrays -> [B] int32. Same op sequence as ``_sample``
+    (division, k-th-largest cut, nucleus threshold over the top_k
+    survivors, categorical), with the static Python gates replaced by
+    no-op thresholds (-inf) so every row shares one traced program:
+    temperature 0 = greedy, top_k 0 = no cut, top_p >= 1 = no nucleus."""
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = temperature[:, None]
+    scaled = logits / jnp.where(t > 0.0, t, 1.0)
+    # k-th largest of the scaled logits == lax.top_k(...)[0][..., -1:]
+    sl = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
+    kth = jnp.take_along_axis(
+        sl, jnp.clip(top_k[:, None] - 1, 0, v - 1), axis=-1
+    )
+    kth = jnp.where(top_k[:, None] > 0, kth, -jnp.inf)
+    filt = jnp.where(scaled < kth, MASK_VALUE, scaled)
+    # nucleus over the top_k-filtered logits (same composition order and
+    # same keep rule as _sample: mass strictly BEFORE a token < p)
+    sl2 = jnp.flip(jnp.sort(filt, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sl2, axis=-1)
+    keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p[:, None]
+    thresh = jnp.min(jnp.where(keep, sl2, jnp.inf), axis=-1, keepdims=True)
+    thresh = jnp.where(top_p[:, None] < 1.0, thresh, -jnp.inf)
+    filt = jnp.where(filt < thresh, MASK_VALUE, filt)
+    drawn = jax.vmap(jax.random.categorical)(keys, filt).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, drawn, greedy)
+
+
+def _decode_slots_block(params, cfg: LlamaConfig, tokens, cache, pos,
+                        key_valid, active):
+    """One decode step for B independent slots: ``tokens`` [B] at PER-SLOT
+    positions ``pos`` [B]. The math is ``_cached_block`` with T=1 except
+    the scalar write offset becomes a per-row one: RoPE phases come from
+    each row's own position and the cache write is a per-row masked
+    select at ``pos[b]`` (same values ``dynamic_update_slice`` would
+    write). ``active`` [B] zeroes dead slots out of MoE routing so a
+    free slot never spends expert capacity. Returns
+    (logits [B, V] float32, updated cache)."""
+    cdt = jnp.dtype(cfg.dtype)
+    b = tokens.shape[0]
+    s_max = cache["k"].shape[2]
+    nh, nkv, hd = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    g = nh // nkv
+    scale = 1.0 / math.sqrt(hd)
+
+    x = params["embed"].astype(cdt)[tokens[:, None]]  # [B, 1, d]
+
+    # per-slot RoPE at global position pos[b] (rope_tables' formula with a
+    # per-row offset; float32 tables cast to compute dtype at application,
+    # exactly as apply_rope does)
+    inv_freq = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
+    )
+    freqs = pos.astype(jnp.float32)[:, None] * inv_freq[None, :]  # [B, hd/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)                # [B, hd]
+    cos = jnp.cos(emb)[:, None, None, :].astype(cdt)              # [B,1,1,hd]
+    sin = jnp.sin(emb)[:, None, None, :].astype(cdt)
+
+    def rope(t):  # [B, 1, H, hd] rotate-half with per-row phases
+        half = t.shape[-1] // 2
+        t1, t2 = t[..., :half], t[..., half:]
+        return t * cos + jnp.concatenate([-t2, t1], axis=-1) * sin
+
+    ki = jnp.arange(s_max)
+    ok = (ki[None, None, :] <= pos[:, None, None]) & (key_valid[:, None, :] > 0)
+    mask = jnp.where(ok, 0.0, MASK_VALUE)[:, None]        # [B, 1, T=1, S]
+    write = (ki[None, :] == pos[:, None])[:, :, None, None]  # [B, S, 1, 1]
+    token_valid = active[:, None]                          # [B, 1]
+
+    def layer_body(x, scanned):
+        layer, ck, cv = scanned
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ layer["wq"].astype(cdt)).reshape(b, 1, nh, hd)
+        k = (h @ layer["wk"].astype(cdt)).reshape(b, 1, nkv, hd)
+        v = (h @ layer["wv"].astype(cdt)).reshape(b, 1, nkv, hd)
+        q = rope(q)
+        k = rope(k)
+        ck = jnp.where(write, k[:, 0][:, None], ck)
+        cv = jnp.where(write, v[:, 0][:, None], cv)
+
+        qg = q.reshape(b, 1, nkv, g, hd)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck).astype(jnp.float32)
+        scores = scores * scale + mask[:, :, None]
+        probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
+        attn = jnp.einsum("bkgts,bskd->btkgd", probs, cv).reshape(b, 1, nh * hd)
+        x = x + attn @ layer["wo"].astype(cdt)
+
+        x, _aux = mlp_block(cfg, x, layer, valid=token_valid)
+        return x, (ck, cv)
+
+    x, (ck, cv) = jax.lax.scan(
+        layer_body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x[:, -1], params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head", None)
+    if head is None:
+        head = params["embed"].T
+    logits = (x @ head.astype(cdt)).astype(jnp.float32)
+    return logits, {"k": ck, "v": cv}
+
+
+def _serve_donate():
+    # donating the cache makes each tick update in place on accelerators;
+    # CPU has no donation and would warn on every call
+    return () if jax.default_backend() == "cpu" else (1,)
+
+
+@functools.lru_cache(maxsize=4)
+def prefill_slot_fn(cfg: LlamaConfig):
+    """Jitted ``(params, cache, prompt [1,P], prompt_valid [1,P], slot,
+    key, temperature, top_k, top_p) -> (first_token scalar, cache)``.
+    Writes the prompt's K/V into cache slot ``slot`` (traced — one
+    executable serves every slot) via the SAME ``_cached_block`` program
+    the one-shot ``generate`` prefill runs, then samples the first token
+    with ``_sample_slots``. Retraces only per prompt length."""
+
+    def run(params, cache, prompt, prompt_valid, slot, key,
+            temperature, top_k, top_p):
+        l, _b, s_max, nkv, hd = cache["k"].shape
+        p = prompt.shape[1]
+        ck = jax.lax.dynamic_slice(
+            cache["k"], (0, slot, 0, 0, 0), (l, 1, s_max, nkv, hd)
+        )
+        cv = jax.lax.dynamic_slice(
+            cache["v"], (0, slot, 0, 0, 0), (l, 1, s_max, nkv, hd)
+        )
+        # positions >= P are future decode writes: valid, causally pruned
+        key_valid = jnp.concatenate(
+            [prompt_valid, jnp.ones((1, s_max - p), jnp.int32)], axis=1
+        )
+        logits, sub = _cached_block(
+            params, cfg, prompt, {"k": ck, "v": cv}, jnp.int32(0),
+            key_valid, prompt_valid, block=0,
+        )
+        cache = {
+            "k": jax.lax.dynamic_update_slice(
+                cache["k"], sub["k"], (0, slot, 0, 0, 0)
+            ),
+            "v": jax.lax.dynamic_update_slice(
+                cache["v"], sub["v"], (0, slot, 0, 0, 0)
+            ),
+        }
+        tok0 = _sample_slots(
+            logits, key[None], temperature[None], top_k[None], top_p[None]
+        )[0]
+        return tok0, cache
+
+    return jax.jit(run, donate_argnums=_serve_donate())
+
+
+@functools.lru_cache(maxsize=4)
+def decode_slots_fn(cfg: LlamaConfig):
+    """Jitted ``(params, cache, tokens [B], pos [B], key_valid [B,S],
+    key_data [B,2] uint32, temperature [B], top_k [B], top_p [B],
+    active [B]) -> (next_tokens [B], cache)``: one tick advancing every
+    slot. PRNG keys travel as raw key data so the host can stage each
+    slot's precomputed key sequence in numpy."""
+
+    def run(params, cache, tokens, pos, key_valid, key_data,
+            temperature, top_k, top_p, active):
+        logits, cache = _decode_slots_block(
+            params, cfg, tokens, cache, pos, key_valid, active
+        )
+        keys = jax.random.wrap_key_data(key_data)
+        nxt = _sample_slots(logits, keys, temperature, top_k, top_p)
+        return nxt, cache
+
+    return jax.jit(run, donate_argnums=_serve_donate())

@@ -175,9 +175,9 @@ class TelemetryServer:
     # -- render --------------------------------------------------------------
 
     def render_metrics(self) -> str:
-        """OpenMetrics text. Counters use the spec's family-name /
-        ``_total``-sample split; ``# EOF`` terminates the exposition
-        (a truncated scrape must be detectable as truncated)."""
+        """OpenMetrics text via the shared ``render_exposition`` (the
+        serve endpoint, nanodiloco_tpu/serve/server.py, uses the same
+        renderer so every /metrics in the project speaks one dialect)."""
         with self._lock:
             gauges = dict(self._gauges)
             phases = dict(self._phases)
@@ -196,58 +196,40 @@ class TelemetryServer:
             "supervisor restarts preceding this process (from the "
             "resume record)"
         )
-        lines: list[str] = []
-        for name in sorted(gauges):
-            lines.append(f"# TYPE {name} gauge")
-            if name in helps:
-                lines.append(f"# HELP {name} {helps[name]}")
-            lines.append(f"{name} {_fmt(gauges[name])}")
+        families: list = [
+            (name, "gauge", helps.get(name), [(None, gauges[name])])
+            for name in sorted(gauges)
+        ]
         if phases:
-            lines.append("# TYPE nanodiloco_phase_seconds gauge")
-            lines.append(
-                "# HELP nanodiloco_phase_seconds last round's host-side "
-                "phase budget"
-            )
-            for ph in sorted(phases):
-                lines.append(
-                    f'nanodiloco_phase_seconds{{phase="{ph}"}} '
-                    f"{_fmt(phases[ph])}"
-                )
-        lines.append("# TYPE nanodiloco_alarms counter")
-        lines.append("# HELP nanodiloco_alarms watchdog alarms by kind")
-        for kind in sorted(alarms):
-            lines.append(
-                f'nanodiloco_alarms_total{{kind="{kind}"}} {alarms[kind]}'
-            )
-        lines.append(f"nanodiloco_alarms_total {sum(alarms.values())}")
-        # resilience counters: injected faults by kind, IO retries by op,
-        # checkpoint resumes — the scrapeable half of the fault timeline
-        lines.append("# TYPE nanodiloco_faults counter")
-        lines.append("# HELP nanodiloco_faults injected faults fired, by kind")
-        for kind in sorted(faults):
-            lines.append(
-                f'nanodiloco_faults_total{{kind="{kind}"}} {faults[kind]}'
-            )
-        lines.append(f"nanodiloco_faults_total {sum(faults.values())}")
-        lines.append("# TYPE nanodiloco_retries counter")
-        lines.append("# HELP nanodiloco_retries IO retry attempts, by operation")
-        for op in sorted(retries):
-            lines.append(
-                f'nanodiloco_retries_total{{op="{op}"}} {retries[op]}'
-            )
-        lines.append(f"nanodiloco_retries_total {sum(retries.values())}")
-        lines.append("# TYPE nanodiloco_resumes counter")
-        lines.append(f"nanodiloco_resumes_total {resumes}")
-        lines.append("# TYPE nanodiloco_outer_syncs counter")
-        lines.append(f"nanodiloco_outer_syncs_total {syncs}")
-        lines.append("# TYPE nanodiloco_wire_bytes counter")
-        lines.append(
-            "# HELP nanodiloco_wire_bytes cumulative per-worker outer-sync "
-            "wire bytes"
-        )
-        lines.append(f"nanodiloco_wire_bytes_total {_fmt(wire_total)}")
-        lines.append("# EOF")
-        return "\n".join(lines) + "\n"
+            families.append((
+                "nanodiloco_phase_seconds", "gauge",
+                "last round's host-side phase budget",
+                [(f'phase="{ph}"', phases[ph]) for ph in sorted(phases)],
+            ))
+        # resilience counters: alarms/injected faults by kind, IO retries
+        # by op, checkpoint resumes — the scrapeable fault timeline
+        for name, help_text, label, by in (
+            ("nanodiloco_alarms", "watchdog alarms by kind", "kind", alarms),
+            ("nanodiloco_faults", "injected faults fired, by kind", "kind",
+             faults),
+            ("nanodiloco_retries", "IO retry attempts, by operation", "op",
+             retries),
+        ):
+            families.append((
+                name, "counter", help_text,
+                [(f'{label}="{k}"', by[k]) for k in sorted(by)]
+                + [(None, sum(by.values()))],
+            ))
+        families.append(("nanodiloco_resumes", "counter", None,
+                         [(None, resumes)]))
+        families.append(("nanodiloco_outer_syncs", "counter", None,
+                         [(None, syncs)]))
+        families.append((
+            "nanodiloco_wire_bytes", "counter",
+            "cumulative per-worker outer-sync wire bytes",
+            [(None, wire_total)],
+        ))
+        return render_exposition(families)
 
     def health(self) -> tuple[int, dict]:
         """(status code, document). Unhealthy (503) = stalled, crashed,
@@ -271,6 +253,29 @@ class TelemetryServer:
 
 def _fmt(v: float) -> str:
     return repr(int(v)) if float(v).is_integer() and abs(v) < 2**53 else repr(v)
+
+
+def render_exposition(families) -> str:
+    """OpenMetrics text from ``(name, type, help, samples)`` families,
+    where ``samples`` is ``[(labels_or_None, value)]`` (labels as a
+    pre-rendered ``key="value"`` string). Counters follow the spec's
+    family-name / ``_total``-sample split; ``# EOF`` terminates the
+    exposition (a truncated scrape must be detectable as truncated).
+    Shared by the training telemetry endpoint above and the serving
+    endpoint (nanodiloco_tpu/serve/server.py)."""
+    lines: list[str] = []
+    for name, mtype, help_text, samples in families:
+        lines.append(f"# TYPE {name} {mtype}")
+        if help_text:
+            lines.append(f"# HELP {name} {help_text}")
+        sample_name = name + "_total" if mtype == "counter" else name
+        for labels, value in samples:
+            if labels:
+                lines.append(f"{sample_name}{{{labels}}} {_fmt(value)}")
+            else:
+                lines.append(f"{sample_name} {_fmt(value)}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
 
 
 def parse_metrics_text(text: str) -> dict[str, float]:

@@ -1,0 +1,33 @@
+"""Continuous-batching inference serving over the static-shape KV cache.
+
+Layers (each usable alone):
+- ``engine.InferenceEngine`` — slot-based decode engine: B cache slots,
+  per-request prefill into a free slot, one compiled step advancing all
+  live slots per tick.
+- ``scheduler.Scheduler`` — FIFO admission queue with backpressure,
+  slot allocation, deadlines; deterministic and model-free (any object
+  with the engine's prefill/step/release surface works).
+- ``server.ServeServer`` — stdlib HTTP daemon: ``POST /v1/generate``,
+  ``GET /healthz``, ``GET /metrics`` (OpenMetrics serve gauges).
+"""
+
+from nanodiloco_tpu.serve.client import http_get, http_post_json
+from nanodiloco_tpu.serve.engine import InferenceEngine
+from nanodiloco_tpu.serve.scheduler import (
+    GenRequest,
+    QueueFull,
+    Scheduler,
+    Ticket,
+)
+from nanodiloco_tpu.serve.server import ServeServer
+
+__all__ = [
+    "InferenceEngine",
+    "http_get",
+    "http_post_json",
+    "GenRequest",
+    "QueueFull",
+    "Scheduler",
+    "Ticket",
+    "ServeServer",
+]

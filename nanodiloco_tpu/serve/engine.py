@@ -1,0 +1,199 @@
+"""Slot-based continuous-batching engine over the static-shape KV cache.
+
+Orca-style (Yu et al., OSDI'22) iteration-level scheduling on TPU terms:
+the engine owns ONE preallocated cache ``[L, B, S_max, Hkv, hd]`` whose
+B rows are independent request slots. A request's life:
+
+- ``prefill(slot, request)`` runs the prompt through the SAME cached
+  prefill program the one-shot ``generate`` uses, writing K/V into the
+  slot's cache row at positions ``[0, P)``, and samples the first token.
+- every ``step()`` advances ALL slots one token with a single compiled
+  program (per-slot positions, PRNG keys, and sampling params are traced
+  arrays) — admitting a new request or retiring a finished one never
+  recompiles and never stops the other slots' streams.
+- ``release(slot)`` frees the row. Nothing is zeroed: a retired slot's
+  stale K/V is causally unreachable to the next occupant (its prefill
+  overwrites ``[0, P)`` and decode never attends past its own position).
+
+Determinism contract (tested): a request's token stream is exactly the
+stream ``generate()`` produces alone with the same seed and sampling
+params. The per-request PRNG schedule is replicated on the host at
+admission — ``key, k0 = split(key(seed))`` for the first token, then
+``split(key, max_new_tokens - 1)`` for the decode steps (the full array
+is materialized up front because ``split(key, n)[i]`` depends on ``n``
+on this jax) — and each tick feeds every slot its own next key.
+
+Known divergence, inherited from ``generate`` and narrowed here: dense-
+dispatch token-choice MoE sizes expert capacity from the tokens in the
+call, so a decode tick routes over B slots where ``generate`` routes
+over 1. With ample capacity (or ``moe_dispatch="ragged"``) routing is
+per-token independent and identical; dead slots are masked out of
+routing entirely (``active``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nanodiloco_tpu.models.config import LlamaConfig
+from nanodiloco_tpu.models.generate import (
+    decode_slots_fn,
+    init_kv_cache,
+    prefill_slot_fn,
+)
+
+
+class InferenceEngine:
+    """The slot backend the scheduler drives. Not thread-safe: all calls
+    must come from one thread (the scheduler's tick loop)."""
+
+    def __init__(
+        self,
+        params,
+        cfg: LlamaConfig,
+        *,
+        num_slots: int = 4,
+        max_len: int = 1024,
+    ) -> None:
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1; got {num_slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2; got {max_len}")
+        if cfg.num_experts and cfg.router_type == "experts_choose":
+            raise ValueError(
+                "expert-choice routing is training-only (see generate()); "
+                "use router_type='tokens_choose' for serving"
+            )
+        self.params = params
+        self.cfg = cfg
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.vocab_size = cfg.vocab_size
+        self.cache = init_kv_cache(cfg, self.num_slots, self.max_len)
+        self._prefill = prefill_slot_fn(cfg)
+        self._decode = decode_slots_fn(cfg)
+
+        b, s = self.num_slots, self.max_len
+        self._tokens = np.zeros(b, np.int32)       # next input token per slot
+        self._pos = np.zeros(b, np.int32)          # next cache write position
+        self._key_valid = np.zeros((b, s), np.int32)
+        self._active = np.zeros(b, np.int32)
+        self._temp = np.zeros(b, np.float32)
+        self._topk = np.zeros(b, np.int32)
+        self._topp = np.ones(b, np.float32)
+        # per-slot precomputed decode key data [max_new-1, 2] uint32
+        self._keys: list[np.ndarray | None] = [None] * b
+        self._step_idx = [0] * b
+        self._dummy_key = np.asarray(
+            jax.random.key_data(jax.random.key(0)), np.uint32
+        )
+        # device-resident copies of the slot state that only changes at
+        # admit/release (key_valid alone is [B, S_max] — re-uploading it
+        # every tick would put an H2D transfer on the per-token path)
+        self._dev: dict | None = None
+
+    # -- request validation (shared with the server's 400 path) -------------
+
+    def validate(self, prompt, max_new_tokens: int) -> None:
+        """Raises ValueError when a request cannot be served by this
+        engine's static shapes."""
+        if len(prompt) < 1:
+            raise ValueError("prompt must have at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1; got {max_new_tokens}"
+            )
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)} tokens) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the engine's max_len "
+                f"({self.max_len})"
+            )
+        bad = [t for t in prompt if not 0 <= int(t) < self.vocab_size]
+        if bad:
+            raise ValueError(
+                f"prompt tokens {bad[:4]} outside the model vocabulary "
+                f"({self.vocab_size})"
+            )
+
+    # -- slot lifecycle ------------------------------------------------------
+
+    def prefill(self, slot: int, request) -> int:
+        """Admit ``request`` into ``slot``: write its prompt K/V, stage
+        its sampling state, and return the first sampled token."""
+        ids = list(request.prompt)
+        self.validate(ids, request.max_new_tokens)
+        p = len(ids)
+        temp = float(request.temperature)
+        top_k = min(int(request.top_k), self.vocab_size)
+        top_p = float(request.top_p)
+
+        # the one-shot generate()'s exact key schedule, replayed per slot
+        key = jax.random.key(int(request.seed))
+        karr = jax.random.split(key)  # karr[0] = rest, karr[1] = k0
+        tok0, self.cache = self._prefill(
+            self.params, self.cache,
+            jnp.asarray([ids], jnp.int32), jnp.ones((1, p), jnp.int32),
+            jnp.int32(slot), karr[1],
+            jnp.float32(temp), jnp.int32(top_k), jnp.float32(top_p),
+        )
+        n = int(request.max_new_tokens)
+        self._keys[slot] = (
+            np.asarray(jax.random.key_data(jax.random.split(karr[0], n - 1)),
+                       np.uint32)
+            if n > 1 else np.zeros((0, 2), np.uint32)
+        )
+        self._step_idx[slot] = 0
+        self._pos[slot] = p
+        self._key_valid[slot] = 1
+        self._tokens[slot] = int(tok0)
+        self._temp[slot] = temp
+        self._topk[slot] = top_k
+        self._topp[slot] = top_p
+        self._active[slot] = 1
+        self._dev = None  # slot state changed: re-stage on the next step
+        return int(tok0)
+
+    def step(self) -> np.ndarray:
+        """Advance every slot one token (one compiled tick). Returns the
+        [B] sampled tokens; entries for inactive slots are meaningless."""
+        b = self.num_slots
+        keys_now = np.empty((b, 2), np.uint32)
+        for s in range(b):
+            ks = self._keys[s]
+            if self._active[s] and ks is not None and self._step_idx[s] < len(ks):
+                keys_now[s] = ks[self._step_idx[s]]
+            else:
+                keys_now[s] = self._dummy_key
+        if self._dev is None:
+            self._dev = {
+                "key_valid": jnp.asarray(self._key_valid),
+                "temp": jnp.asarray(self._temp),
+                "topk": jnp.asarray(self._topk),
+                "topp": jnp.asarray(self._topp),
+                "active": jnp.asarray(self._active),
+            }
+        nxt, self.cache = self._decode(
+            self.params, self.cache,
+            jnp.asarray(self._tokens), jnp.asarray(self._pos),
+            self._dev["key_valid"], jnp.asarray(keys_now),
+            self._dev["temp"], self._dev["topk"],
+            self._dev["topp"], self._dev["active"],
+        )
+        nxt = np.asarray(nxt)
+        for s in range(b):
+            if self._active[s]:
+                self._pos[s] += 1
+                self._step_idx[s] += 1
+                self._tokens[s] = nxt[s]
+        return nxt
+
+    def release(self, slot: int) -> None:
+        self._active[slot] = 0
+        self._key_valid[slot] = 0
+        self._keys[slot] = None
+        self._pos[slot] = 0
+        self._tokens[slot] = 0
+        self._dev = None

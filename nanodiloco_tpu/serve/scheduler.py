@@ -1,0 +1,349 @@
+"""Admission queue + deterministic tick loop for the serving engine.
+
+The scheduler is the testable half of continuous batching: it owns WHICH
+request runs in WHICH slot WHEN, and nothing else. The model lives
+behind a three-method backend surface (``prefill(slot, request) ->
+first_token``, ``step() -> [B] tokens``, ``release(slot)``), so every
+scheduling decision — admission order, slot refill mid-decode, EOS
+retirement, queue-full backpressure, deadline expiry — is provable with
+a scripted fake backend and an injected clock, no model and no RNG
+ambiguity (the same injectable-clock discipline as ``obs/watchdog.py``
+and ``resilience/retry.py``).
+
+Tick anatomy (one call, strictly ordered, deterministic):
+1. expire queued requests whose deadline passed (they never held a slot);
+2. admit from the FIFO queue into free slots, lowest slot index first —
+   each admission prefills and may finish immediately (stop token or
+   ``max_new_tokens == 1``), freeing the slot for the NEXT queued
+   request within the same pass;
+3. if any slot is live, ONE decode step advances them all; finished
+   slots (stop token / length / deadline) are retired and their slots
+   are free for the next tick's admission pass — requests join and
+   leave the batch mid-stream, there is no barrier between requests.
+
+Threading: ``submit`` may be called from any thread (the HTTP handlers);
+``tick`` must be called from exactly one thread. The queue is the only
+shared state and sits under a lock; everything else belongs to the tick
+thread. Completion is delivered through a ``Ticket`` the submitter
+waits on.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Callable
+
+
+class QueueFull(RuntimeError):
+    """Raised by ``submit`` when the admission queue is at capacity —
+    the server's 429 backpressure signal."""
+
+
+@dataclasses.dataclass(frozen=True)
+class GenRequest:
+    """One generation request. ``deadline_s`` is a RELATIVE budget from
+    submission; a request past it is expired (queued) or retired with
+    its partial output (running)."""
+
+    prompt: tuple[int, ...]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    stop_token: int | None = None
+    deadline_s: float | None = None
+
+
+class Ticket:
+    """Handle returned by ``submit``: ``wait(timeout)`` blocks until the
+    scheduler finishes the request and returns the result dict
+    (``None`` on timeout). ``cancel()`` asks the scheduler to drop the
+    request at its next opportunity — a queued request never takes a
+    slot, a decoding one is retired with its partial output — so an
+    abandoned client (HTTP timeout, disconnect) stops spending slot
+    capacity on tokens nobody will read."""
+
+    def __init__(self, rid: int) -> None:
+        self.rid = rid
+        self.result: dict | None = None
+        self._event = threading.Event()
+        self._cancelled = threading.Event()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def wait(self, timeout: float | None = None) -> dict | None:
+        self._event.wait(timeout)
+        return self.result
+
+
+@dataclasses.dataclass
+class _Queued:
+    ticket: Ticket
+    request: GenRequest
+    submitted_at: float
+    deadline_at: float | None
+
+
+@dataclasses.dataclass
+class _Running:
+    ticket: Ticket
+    request: GenRequest
+    submitted_at: float
+    deadline_at: float | None
+    admitted_at: float
+    first_token_at: float
+    tokens: list[int]
+
+
+class Scheduler:
+    """FIFO admission + slot allocation over a backend with ``num_slots``
+    slots. ``clock`` is injectable (monotonic seconds)."""
+
+    def __init__(
+        self,
+        backend,
+        *,
+        max_queue: int = 64,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1; got {max_queue}")
+        self.backend = backend
+        self._clock = clock
+        self.max_queue = int(max_queue)
+        self._slots: list[_Running | None] = [None] * backend.num_slots
+        self._queue: collections.deque[_Queued] = collections.deque()
+        self._lock = threading.Lock()
+        self._next_rid = 0
+        # stats (read by the server's gauges; written by the tick thread
+        # except rejected, which submit bumps under the queue lock)
+        self._served = 0
+        self._rejected = 0
+        self._expired = 0
+        self._cancelled = 0
+        self._errors = 0
+        self._tokens_out = 0
+        self._decode_tokens = 0
+        self._decode_s = 0.0
+        self._ttft: collections.deque[float] = collections.deque(maxlen=512)
+
+    # -- submission (any thread) --------------------------------------------
+
+    def submit(self, request: GenRequest) -> Ticket:
+        now = self._clock()
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                self._rejected += 1
+                raise QueueFull(
+                    f"admission queue is full ({self.max_queue} waiting)"
+                )
+            ticket = Ticket(self._next_rid)
+            self._next_rid += 1
+            deadline = (
+                now + request.deadline_s
+                if request.deadline_s is not None else None
+            )
+            self._queue.append(_Queued(ticket, request, now, deadline))
+        return ticket
+
+    # -- the tick loop (one thread) ------------------------------------------
+
+    def tick(self) -> int:
+        """One deterministic scheduling round (see module docstring).
+        Returns the number of live slots after the tick, so a serving
+        loop can idle when there is no work."""
+        now = self._clock()
+        # 1. drop queued requests whose deadline passed or whose client
+        # cancelled (they never held a slot)
+        dropped: list[tuple[_Queued, str]] = []
+        with self._lock:
+            still = collections.deque()
+            for q in self._queue:
+                if q.ticket.cancelled:
+                    dropped.append((q, "cancelled"))
+                elif q.deadline_at is not None and now >= q.deadline_at:
+                    dropped.append((q, "deadline"))
+                else:
+                    still.append(q)
+            self._queue = still
+        for q, reason in dropped:
+            if reason == "deadline":
+                self._expired += 1
+            else:
+                self._cancelled += 1
+            self._finish(q.ticket, q.request, [], reason,
+                         q.submitted_at, None, None, now)
+
+        # 2. admit into free slots, FIFO, lowest slot first; a request
+        # that finishes at prefill (one token / instant stop) leaves its
+        # slot free for the next queued request within the same pass
+        slot = 0
+        while slot < len(self._slots):
+            if self._slots[slot] is not None:
+                slot += 1
+                continue
+            q = self._pop_queue()
+            if q is None:
+                break
+            if q.ticket.cancelled:  # cancelled between sweep and pop
+                self._cancelled += 1
+                self._finish(q.ticket, q.request, [], "cancelled",
+                             q.submitted_at, None, None, self._clock())
+                continue
+            t_admit = self._clock()
+            try:
+                tok0 = self.backend.prefill(slot, q.request)
+            except ValueError as e:
+                # a bad REQUEST must not kill the loop; anything else
+                # (OOM, a donated-then-deleted cache) propagates and
+                # kills the tick loop — a broken engine must flip
+                # /healthz to 503, not limp along half-alive
+                self._errors += 1
+                self._finish(q.ticket, q.request, [], "error",
+                             q.submitted_at, None, None, self._clock(),
+                             error=str(e))
+                continue
+            t_first = self._clock()
+            with self._lock:  # stats() sorts this deque from HTTP threads
+                self._ttft.append(t_first - q.submitted_at)
+            self._tokens_out += 1
+            run = _Running(q.ticket, q.request, q.submitted_at,
+                           q.deadline_at, t_admit, t_first, [tok0])
+            reason = self._finish_reason(run, t_first)
+            if reason is None:
+                self._slots[slot] = run
+                slot += 1
+            else:
+                # prefill already activated the slot in the backend; an
+                # unreleased instant-finish would decode as a zombie
+                self._backend_release(slot)
+                self._retire(run, reason, t_first)
+
+        # 3. one decode step for everyone live
+        live = [s for s in range(len(self._slots)) if self._slots[s] is not None]
+        if live:
+            t0 = self._clock()
+            toks = self.backend.step()
+            t1 = self._clock()
+            self._decode_s += t1 - t0
+            self._tokens_out += len(live)
+            self._decode_tokens += len(live)
+            for s in live:
+                run = self._slots[s]
+                run.tokens.append(int(toks[s]))
+                reason = self._finish_reason(run, t1)
+                if reason is not None:
+                    self._backend_release(s)
+                    self._slots[s] = None
+                    self._retire(run, reason, t1)
+        return sum(1 for s in self._slots if s is not None)
+
+    def _backend_release(self, slot: int) -> None:
+        release = getattr(self.backend, "release", None)
+        if release is not None:
+            release(slot)
+
+    def _pop_queue(self) -> _Queued | None:
+        with self._lock:
+            return self._queue.popleft() if self._queue else None
+
+    def _finish_reason(self, run: _Running, now: float) -> str | None:
+        req = run.request
+        if req.stop_token is not None and run.tokens[-1] == req.stop_token:
+            return "stop"
+        if len(run.tokens) >= req.max_new_tokens:
+            return "length"
+        if run.ticket.cancelled:
+            return "cancelled"
+        if run.deadline_at is not None and now >= run.deadline_at:
+            return "deadline"
+        return None
+
+    def _retire(self, run: _Running, reason: str, now: float) -> None:
+        if reason == "cancelled":
+            self._cancelled += 1
+        else:
+            self._served += 1
+        self._finish(run.ticket, run.request, run.tokens, reason,
+                     run.submitted_at, run.admitted_at, run.first_token_at,
+                     now)
+
+    def _finish(self, ticket: Ticket, request: GenRequest, tokens: list[int],
+                reason: str, submitted_at: float, admitted_at: float | None,
+                first_token_at: float | None, now: float,
+                error: str | None = None) -> None:
+        result = {
+            "rid": ticket.rid,
+            "tokens": list(tokens),
+            "finish_reason": reason,
+            # time spent WAITING for a slot (a never-admitted request
+            # waited its whole life); ttft additionally includes prefill
+            "queued_s": (
+                (admitted_at if admitted_at is not None else now)
+                - submitted_at
+            ),
+            "ttft_s": (
+                first_token_at - submitted_at
+                if first_token_at is not None else None
+            ),
+            "decode_s": (
+                now - first_token_at if first_token_at is not None else 0.0
+            ),
+            "total_s": now - submitted_at,
+        }
+        if error is not None:
+            result["error"] = error
+        ticket.result = result
+        ticket._event.set()
+
+    # -- observability -------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        """Cheap accessor for the serving loop's idle check."""
+        with self._lock:
+            return len(self._queue)
+
+    def stats(self) -> dict:
+        """Snapshot for the serve gauges. TTFT percentiles come from a
+        rolling window of the last 512 admissions."""
+        with self._lock:
+            depth = len(self._queue)
+            ttft_snapshot = list(self._ttft)  # tick appends under the lock
+        ttft = sorted(ttft_snapshot)
+
+        def pct(p: float) -> float | None:
+            if not ttft:
+                return None
+            return ttft[min(len(ttft) - 1, int(p * len(ttft)))]
+
+        return {
+            "queue_depth": depth,
+            "slots_busy": sum(1 for s in self._slots if s is not None),
+            "slots_total": len(self._slots),
+            "served": self._served,
+            "rejected": self._rejected,
+            "expired": self._expired,
+            "cancelled": self._cancelled,
+            "errors": self._errors,
+            "tokens_out": self._tokens_out,
+            "decode_s": self._decode_s,
+            "decode_tokens_per_sec": (
+                self._decode_tokens / self._decode_s
+                if self._decode_s > 0 else None
+            ),
+            "ttft_last_s": ttft_snapshot[-1] if ttft_snapshot else None,
+            "ttft_p50_s": pct(0.50),
+            "ttft_p95_s": pct(0.95),
+        }

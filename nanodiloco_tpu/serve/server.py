@@ -1,0 +1,319 @@
+"""Stdlib HTTP serving daemon over the scheduler + engine.
+
+Same pattern and lifecycle as the training telemetry endpoint
+(``obs/telemetry.py``): ``http.server`` on daemon threads, no new
+dependencies, ``port=0`` picks a free port exposed as ``.port``. The
+server owns the scheduler's tick loop on one dedicated thread; HTTP
+handler threads only ``submit`` and wait on their ticket, so the
+engine is single-threaded by construction.
+
+Endpoints:
+- ``POST /v1/generate`` — JSON in: ``{"prompt": str}`` or
+  ``{"token_ids": [int]}`` plus optional ``max_new_tokens``,
+  ``temperature``, ``top_k``, ``top_p``, ``seed``, ``stop`` (bool:
+  finish at the tokenizer's EOS, default true), ``stop_token`` (int
+  override), ``deadline_s``. JSON out: generated ``text`` (when a
+  tokenizer is configured) + ``token_ids`` (truncated at the stop
+  token, like the ``generate`` CLI) + ``finish_reason`` + ``timing``
+  (queued/TTFT/decode seconds). 400 on a malformed request, 429 when
+  the admission queue is full (backpressure — the client retries
+  later), 503 once the engine loop has died.
+- ``GET /healthz`` — 200 while the tick loop is alive, 503 after it
+  died; body carries queue depth and slot occupancy.
+- ``GET /metrics`` — OpenMetrics serve gauges (queue depth, slot
+  occupancy, TTFT last/p50/p95, decode tokens/s) and counters
+  (requests by outcome, tokens), rendered by the same
+  ``render_exposition`` the training telemetry endpoint uses.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from nanodiloco_tpu.obs.telemetry import (
+    OPENMETRICS_CONTENT_TYPE,
+    render_exposition,
+)
+from nanodiloco_tpu.serve.scheduler import GenRequest, QueueFull, Scheduler
+
+
+class ServeServer:
+    """HTTP front end + tick-loop owner. ``tokenizer`` is optional: with
+    one, ``prompt`` strings are accepted and ``text`` is returned, and
+    its EOS id is the default stop token; without, clients send
+    ``token_ids``."""
+
+    def __init__(
+        self,
+        scheduler: Scheduler,
+        tokenizer=None,
+        *,
+        port: int = 0,
+        host: str = "0.0.0.0",
+        default_max_new_tokens: int = 64,
+        max_new_tokens_cap: int = 256,
+        request_timeout_s: float = 600.0,
+        default_deadline_s: float | None = None,
+        idle_sleep_s: float = 0.002,
+    ) -> None:
+        self._scheduler = scheduler
+        self._tokenizer = tokenizer
+        self._default_new = int(default_max_new_tokens)
+        self._cap_new = int(max_new_tokens_cap)
+        self._timeout_s = float(request_timeout_s)
+        self._default_deadline_s = default_deadline_s
+        self._idle_sleep_s = float(idle_sleep_s)
+        self._stop = threading.Event()
+        self._loop_thread: threading.Thread | None = None
+        self._http_thread: threading.Thread | None = None
+        self._loop_error: str | None = None
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # scrapes must not spam stdout
+                pass
+
+            def _reply(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_json(self, code: int, doc: dict) -> None:
+                self._reply(code, (json.dumps(doc) + "\n").encode(),
+                            "application/json")
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._reply(200, server.render_metrics().encode(),
+                                OPENMETRICS_CONTENT_TYPE)
+                elif path == "/healthz":
+                    code, doc = server.health()
+                    self._reply_json(code, doc)
+                else:
+                    self._reply(404, b"not found\n", "text/plain")
+
+            def do_POST(self):
+                if self.path.split("?", 1)[0] != "/v1/generate":
+                    self._reply(404, b"not found\n", "text/plain")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    doc = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(doc, dict):
+                        raise ValueError("request body must be a JSON object")
+                except ValueError as e:
+                    self._reply_json(400, {"error": f"bad JSON: {e}"})
+                    return
+                code, out = server.handle_generate(doc)
+                self._reply_json(code, out)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ServeServer":
+        # engine loop FIRST: the socket already accepts connections from
+        # __init__, and a request handled before the loop thread exists
+        # would get a spurious 503 from loop_alive()
+        if self._loop_thread is None:
+            self._loop_thread = threading.Thread(
+                target=self._loop, name="nanodiloco-serve-engine", daemon=True,
+            )
+            self._loop_thread.start()
+        if self._http_thread is None:
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="nanodiloco-serve-http", daemon=True,
+            )
+            self._http_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=10)
+            self._loop_thread = None
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=5)
+            self._http_thread = None
+
+    def _loop(self) -> None:
+        """The engine's single driver thread: tick until stopped; idle
+        politely when no slot is live and the queue is empty."""
+        while not self._stop.is_set():
+            try:
+                live = self._scheduler.tick()
+            except Exception as e:  # pragma: no cover - defensive
+                # a dead loop must flip /healthz to 503, not vanish
+                self._loop_error = f"{type(e).__name__}: {e}"
+                return
+            if live == 0 and self._scheduler.queue_depth() == 0:
+                time.sleep(self._idle_sleep_s)
+
+    def loop_alive(self) -> bool:
+        t = self._loop_thread
+        return t is not None and t.is_alive() and self._loop_error is None
+
+    # -- request handling ----------------------------------------------------
+
+    def handle_generate(self, doc: dict) -> tuple[int, dict]:
+        if not self.loop_alive():
+            return 503, {"error": "engine loop is not running",
+                         "detail": self._loop_error}
+        try:
+            request = self._parse_request(doc)
+        except (ValueError, TypeError) as e:  # TypeError: e.g. int(None)
+            return 400, {"error": str(e)}
+        try:
+            ticket = self._scheduler.submit(request)
+        except QueueFull as e:
+            return 429, {"error": str(e)}
+        deadline = request.deadline_s
+        timeout = self._timeout_s if deadline is None else deadline + 5.0
+        result = ticket.wait(timeout)
+        if result is None:
+            # nobody is left to read the stream: cancel so the scheduler
+            # frees the slot instead of decoding to completion
+            ticket.cancel()
+            return 504, {"error": f"request timed out after {timeout:.0f}s"}
+        if result["finish_reason"] == "error":
+            # client mistakes were already rejected with 400 at parse
+            # time (backend.validate); a prefill failure here is a
+            # server-side fault (OOM, corrupt params) — 5xx, retryable
+            return 500, {"error": result.get("error", "engine prefill failed")}
+        tokens = result["tokens"]
+        if request.stop_token is not None and request.stop_token in tokens:
+            tokens = tokens[: tokens.index(request.stop_token)]
+        out = {
+            "id": result["rid"],
+            "finish_reason": result["finish_reason"],
+            "token_ids": tokens,
+            "prompt_tokens": len(request.prompt),
+            "completion_tokens": len(tokens),
+            "timing": {
+                "queued_s": result["queued_s"],
+                "ttft_s": result["ttft_s"],
+                "decode_s": result["decode_s"],
+                "total_s": result["total_s"],
+            },
+        }
+        if self._tokenizer is not None:
+            out["text"] = self._tokenizer.decode([int(t) for t in tokens])
+        return 200, out
+
+    def _parse_request(self, doc: dict) -> GenRequest:
+        if "token_ids" in doc:
+            ids = doc["token_ids"]
+            if (not isinstance(ids, list) or not ids
+                    or not all(isinstance(t, int) for t in ids)):
+                raise ValueError("token_ids must be a non-empty list of ints")
+        elif "prompt" in doc:
+            if self._tokenizer is None:
+                raise ValueError(
+                    "this server has no tokenizer; send token_ids"
+                )
+            if not isinstance(doc["prompt"], str) or not doc["prompt"]:
+                raise ValueError("prompt must be a non-empty string")
+            ids = self._tokenizer.encode(doc["prompt"])
+            if not ids:
+                raise ValueError("prompt is empty after tokenization")
+        else:
+            raise ValueError("request needs 'prompt' or 'token_ids'")
+        max_new = int(doc.get("max_new_tokens", self._default_new))
+        if not 1 <= max_new <= self._cap_new:
+            raise ValueError(
+                f"max_new_tokens must be in [1, {self._cap_new}]; got {max_new}"
+            )
+        temperature = float(doc.get("temperature", 0.0))
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0; got {temperature}")
+        top_k = int(doc.get("top_k", 0))
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0; got {top_k}")
+        top_p = float(doc.get("top_p", 1.0))
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
+        stop_token = doc.get("stop_token")
+        if stop_token is None and doc.get("stop", True):
+            stop_token = getattr(self._tokenizer, "eos_id", None)
+        deadline = doc.get("deadline_s", self._default_deadline_s)
+        # reject impossible shapes at submit time (400), not in the loop
+        backend = self._scheduler.backend
+        if hasattr(backend, "validate"):
+            backend.validate(ids, max_new)
+        return GenRequest(
+            prompt=tuple(int(t) for t in ids),
+            max_new_tokens=max_new,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            seed=int(doc.get("seed", 0)),
+            stop_token=None if stop_token is None else int(stop_token),
+            deadline_s=None if deadline is None else float(deadline),
+        )
+
+    # -- observability -------------------------------------------------------
+
+    def health(self) -> tuple[int, dict]:
+        s = self._scheduler.stats()
+        alive = self.loop_alive()
+        doc = {
+            "healthy": alive,
+            "queue_depth": s["queue_depth"],
+            "slots_busy": s["slots_busy"],
+            "slots_total": s["slots_total"],
+            "served": s["served"],
+        }
+        if self._loop_error:
+            doc["error"] = self._loop_error
+        return (200 if alive else 503), doc
+
+    def render_metrics(self) -> str:
+        s = self._scheduler.stats()
+        gauges = [
+            ("nanodiloco_serve_queue_depth",
+             "requests waiting for a slot", s["queue_depth"]),
+            ("nanodiloco_serve_slots_busy",
+             "decode slots with a live request", s["slots_busy"]),
+            ("nanodiloco_serve_slots_total",
+             "decode slots in the engine batch", s["slots_total"]),
+            ("nanodiloco_serve_ttft_seconds",
+             "last request's time to first token", s["ttft_last_s"]),
+            ("nanodiloco_serve_ttft_p50_seconds",
+             "median TTFT over the last 512 admissions", s["ttft_p50_s"]),
+            ("nanodiloco_serve_ttft_p95_seconds",
+             "p95 TTFT over the last 512 admissions", s["ttft_p95_s"]),
+            ("nanodiloco_serve_decode_tokens_per_sec",
+             "aggregate decode throughput across live slots",
+             s["decode_tokens_per_sec"]),
+        ]
+        families: list = [
+            (name, "gauge", help_text, [(None, value)])
+            for name, help_text, value in gauges
+            if value is not None
+        ]
+        outcomes = [("served", s["served"]), ("rejected", s["rejected"]),
+                    ("expired", s["expired"]), ("cancelled", s["cancelled"]),
+                    ("error", s["errors"])]
+        families.append((
+            "nanodiloco_serve_requests", "counter",
+            "requests by terminal outcome",
+            [(f'outcome="{k}"', v) for k, v in outcomes]
+            + [(None, sum(v for _, v in outcomes))],
+        ))
+        families.append((
+            "nanodiloco_serve_tokens", "counter",
+            "tokens sampled (prefill + decode)", [(None, s["tokens_out"])],
+        ))
+        return render_exposition(families)

@@ -22,6 +22,10 @@ ceremony:
      a clean preemption checkpoint + the preempt exit code (75), then
      let `supervise` resume it to completion from that checkpoint — the
      preempt/resume loop proven on the chip, not just in the CPU tests.
+  6. a serving drill: train a tiny checkpoint, launch the `serve` CLI
+     on it, drive 2 OVERLAPPING requests over a real socket, and scrape
+     the serve gauges off /metrics — continuous batching proven on the
+     chip end to end.
 
 Usage (each phase also runs alone):
     python scripts/chip_agenda.py               # everything
@@ -434,6 +438,134 @@ def phase_resilience() -> None:
     })
 
 
+def phase_serve() -> None:
+    """The serving path on this backend end to end: train a tiny REAL
+    checkpoint, launch the `serve` CLI on it, drive TWO overlapping
+    requests over a real socket from concurrent clients, and scrape the
+    serve gauges off /metrics into the agenda ledger (same contract as
+    the telemetry phase: the production scrape path, proven on the
+    chip, not just under the CPU test harness)."""
+    import socket
+    import tempfile
+    import threading
+
+    from nanodiloco_tpu.obs.telemetry import parse_metrics_text
+    from nanodiloco_tpu.serve.client import http_get, http_post_json
+
+    tmp = tempfile.mkdtemp(prefix="nanodiloco-serve-")
+    ckpt = os.path.join(tmp, "ckpt")
+    model_cfg = os.path.join(tmp, "model.json")
+    with open(model_cfg, "w") as f:
+        json.dump({
+            "vocab_size": 2048, "hidden_size": 128, "intermediate_size": 256,
+            "num_attention_heads": 4, "num_hidden_layers": 2,
+            "max_position_embeddings": 256,
+        }, f)
+    budget = float(os.environ.get("NANODILOCO_AGENDA_TIMEOUT_SERVE", "900"))
+    train = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu",
+         "--total-steps", "4", "--inner-steps", "2",
+         "--batch-size", "8", "--per-device-batch-size", "4",
+         "--seq-length", "256", "--warmup-steps", "2",
+         "--llama-config-file", model_cfg, "--no-measure-comm",
+         "--no-cost-analysis", "--quiet",
+         "--checkpoint-dir", ckpt, "--log-dir", tmp,
+         "--run-name", "serve-probe"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=budget * 0.5,
+    )
+    if train.returncode != 0:
+        record({"phase": "serve",
+                "error": (train.stderr or train.stdout)[-400:]})
+        raise SystemExit(1)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nanodiloco_tpu", "serve",
+         "--checkpoint-dir", ckpt, "--port", str(port),
+         "--host", "127.0.0.1", "--slots", "2", "--max-len", "128",
+         "--max-new-tokens-cap", "64"],
+        cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+    def get(path):
+        return http_get(f"http://127.0.0.1:{port}{path}", timeout=5)
+
+    def post(doc, timeout=120):
+        return http_post_json(
+            f"http://127.0.0.1:{port}/v1/generate", doc, timeout=timeout
+        )
+
+    try:
+        deadline = time.time() + budget * 0.4
+        up = False
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                up = get("/healthz")[0] == 200
+            except OSError:
+                up = False
+            if up:  # keep polling through transient startup 503s
+                break
+            time.sleep(0.3)
+        if not up:
+            record({"phase": "serve", "error":
+                    "server never answered /healthz"})
+            raise SystemExit(1)
+        # two OVERLAPPING requests: both in flight at once, both batched
+        # into the same decode ticks
+        results = {}
+
+        def client(i):
+            results[i] = post({
+                "prompt": "The quick brown fox" if i == 0 else "Once upon",
+                "max_new_tokens": 24, "temperature": 0.8, "top_k": 20,
+                "seed": i, "stop": False,
+            })
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=budget * 0.3)
+        bad = {i: r for i, r in results.items() if r[0] != 200}
+        if len(results) < 2 or bad:
+            record({"phase": "serve",
+                    "error": f"requests failed: {bad or 'client hung'}"})
+            raise SystemExit(1)
+        m = parse_metrics_text(get("/metrics")[1])
+        record({
+            "phase": "serve",
+            "completion_tokens": [
+                results[i][1]["completion_tokens"] for i in (0, 1)
+            ],
+            "ttft_s": [
+                round(results[i][1]["timing"]["ttft_s"], 3) for i in (0, 1)
+            ],
+            "scraped": {
+                k: m[k] for k in (
+                    "nanodiloco_serve_requests_total",
+                    'nanodiloco_serve_requests_total{outcome="served"}',
+                    "nanodiloco_serve_tokens_total",
+                    "nanodiloco_serve_slots_total",
+                    "nanodiloco_serve_decode_tokens_per_sec",
+                    "nanodiloco_serve_ttft_p50_seconds",
+                ) if k in m
+            },
+        })
+    finally:
+        import signal as _signal
+
+        if proc.poll() is None:
+            proc.send_signal(_signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 PHASES = {
     "bench": phase_bench,
     "sweep": phase_sweep,
@@ -441,6 +573,7 @@ PHASES = {
     "profile": phase_profile,
     "telemetry": phase_telemetry,
     "resilience": phase_resilience,
+    "serve": phase_serve,
 }
 
 
@@ -479,6 +612,7 @@ PHASE_TIMEOUT_S = {
     "profile": 1200,
     "telemetry": 900,
     "resilience": 1200,
+    "serve": 900,
 }
 
 

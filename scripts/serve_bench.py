@@ -1,0 +1,192 @@
+"""Closed-loop load generator for the continuous-batching server.
+
+N client threads each drive M sequential requests (closed loop: a
+client's next request waits for its previous answer) with mixed prompt
+lengths against an in-process ``ServeServer`` over a REAL socket, then
+report TTFT p50/p95 and aggregate decode tokens/s — the serving twin of
+``bench.py``'s training numbers, emitted as one ``BENCH_SERVE`` JSON
+line on stdout.
+
+By default the model is a random-init tiny Llama (shape knobs below) so
+the bench runs anywhere, CPU included; ``--checkpoint-dir`` serves a
+real trained checkpoint instead. Examples:
+
+    python scripts/serve_bench.py                      # tiny, defaults
+    python scripts/serve_bench.py --clients 16 --slots 8 --max-new-tokens 64
+    python scripts/serve_bench.py --checkpoint-dir runs/ckpt --slots 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--checkpoint-dir", type=str, default=None,
+                   help="serve this trained checkpoint; default: a "
+                        "random-init tiny model (throughput-shaped, "
+                        "content-free)")
+    p.add_argument("--step", type=int, default=None)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--max-len", type=int, default=256)
+    p.add_argument("--max-queue", type=int, default=256)
+    p.add_argument("--clients", type=int, default=8,
+                   help="concurrent closed-loop client threads")
+    p.add_argument("--requests-per-client", type=int, default=4)
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--prompt-lens", type=str, default="8,24,64",
+                   help="comma-separated prompt lengths, cycled across "
+                        "requests (mixed prefill shapes)")
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--top-k", type=int, default=20)
+    p.add_argument("--seed", type=int, default=0)
+    # tiny-model shape knobs (ignored with --checkpoint-dir)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=2048)
+    return p
+
+
+def _pct(sorted_vals: list[float], p: float) -> float | None:
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1, int(p * len(sorted_vals)))]
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    import jax
+
+    from nanodiloco_tpu.serve import (
+        InferenceEngine,
+        Scheduler,
+        ServeServer,
+        http_post_json,
+    )
+
+    if args.checkpoint_dir:
+        from nanodiloco_tpu.cli import _load_checkpoint_snapshot
+
+        cfg, _sidecar, params = _load_checkpoint_snapshot(
+            args.checkpoint_dir, args.step
+        )
+    else:
+        from nanodiloco_tpu.models import LlamaConfig, init_params
+
+        cfg = LlamaConfig(
+            vocab_size=args.vocab, hidden_size=args.hidden,
+            intermediate_size=2 * args.hidden,
+            num_attention_heads=args.heads, num_hidden_layers=args.layers,
+            max_position_embeddings=args.max_len,
+        )
+        params = init_params(jax.random.key(args.seed), cfg)
+
+    engine = InferenceEngine(
+        params, cfg, num_slots=args.slots,
+        max_len=min(args.max_len, cfg.max_position_embeddings),
+    )
+    server = ServeServer(
+        Scheduler(engine, max_queue=args.max_queue),
+        port=0, host="127.0.0.1", max_new_tokens_cap=args.max_new_tokens,
+    ).start()
+    lens = [int(x) for x in args.prompt_lens.split(",") if x]
+    rng = __import__("random").Random(args.seed)
+
+    def post(doc: dict) -> tuple[int, dict]:
+        return http_post_json(
+            f"http://127.0.0.1:{server.port}/v1/generate", doc
+        )
+
+    # warmup: compile the decode tick + each prefill shape outside the
+    # timed window (one request per distinct prompt length). A failed
+    # warmup would silently move compilation INTO the timed window and
+    # corrupt the TTFT percentiles, so it is a hard error.
+    warm_new = min(2, args.max_new_tokens)
+    for n, p_len in enumerate(sorted(set(lens))):
+        code, out = post({
+            "token_ids": [(i * 7 + 3) % cfg.vocab_size for i in range(p_len)],
+            "max_new_tokens": warm_new, "temperature": args.temperature,
+            "top_k": args.top_k, "seed": 10_000 + n, "stop": False,
+        })
+        if code != 200:
+            server.stop()
+            raise SystemExit(
+                f"warmup request (prompt_len={p_len}) failed with "
+                f"{code}: {out.get('error')} — fix --prompt-lens/"
+                f"--max-new-tokens/--max-len before benchmarking"
+            )
+
+    results: list[dict] = []
+    errors: list[tuple[int, dict]] = []
+    lock = threading.Lock()
+
+    def client(cid: int) -> None:
+        for r in range(args.requests_per_client):
+            p_len = lens[(cid + r) % len(lens)]
+            ids = [rng.randrange(cfg.vocab_size) for _ in range(p_len)]
+            code, out = post({
+                "token_ids": ids, "max_new_tokens": args.max_new_tokens,
+                "temperature": args.temperature, "top_k": args.top_k,
+                "seed": cid * 1000 + r, "stop": False,
+            })
+            with lock:
+                if code == 200:
+                    results.append(out)
+                else:
+                    errors.append((code, out))
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.monotonic() - t0
+
+    stats = server._scheduler.stats()
+    server.stop()
+    ttfts = sorted(r["timing"]["ttft_s"] for r in results)
+    completion = sum(r["completion_tokens"] for r in results)
+    rec = {
+        "metric": "BENCH_SERVE",
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "model": (
+            args.checkpoint_dir
+            or f"random-init llama (hidden {cfg.hidden_size} x "
+               f"{cfg.num_hidden_layers}L, vocab {cfg.vocab_size})"
+        ),
+        "slots": args.slots,
+        "clients": args.clients,
+        "requests": len(results),
+        "rejected_or_failed": len(errors),
+        "prompt_lens": lens,
+        "max_new_tokens": args.max_new_tokens,
+        "wall_s": round(wall_s, 3),
+        "requests_per_sec": round(len(results) / wall_s, 3) if wall_s else None,
+        "ttft_p50_s": round(_pct(ttfts, 0.50), 4) if ttfts else None,
+        "ttft_p95_s": round(_pct(ttfts, 0.95), 4) if ttfts else None,
+        "completion_tokens": completion,
+        "client_tokens_per_sec": (
+            round(completion / wall_s, 1) if wall_s else None
+        ),
+        "decode_tokens_per_sec": (
+            round(stats["decode_tokens_per_sec"], 1)
+            if stats["decode_tokens_per_sec"] else None
+        ),
+    }
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -311,6 +311,83 @@ def test_top_k_and_top_p_compose():
     assert set(draws) == {0, 1}
 
 
+def test_sample_temperature_zero_is_greedy_property():
+    """Property: temperature=0 is the argmax of the RAW logits for any
+    key and any top_k/top_p setting (the filters only exist on the
+    stochastic path) — the greedy edge the serving engine leans on for
+    slots whose request asked for deterministic decoding."""
+    from nanodiloco_tpu.models.generate import _sample
+
+    keys = jax.random.split(jax.random.key(11), 8)
+    for trial in range(6):
+        logits = jax.random.normal(jax.random.key(100 + trial), (3, 64)) * 4.0
+        expect = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        for k in keys[:2]:
+            for top_k, top_p in ((0, 1.0), (5, 1.0), (0, 0.3), (7, 0.5)):
+                got = _sample(logits, k, 0.0, top_k, top_p)
+                np.testing.assert_array_equal(np.asarray(got), expect)
+                assert got.dtype == jnp.int32
+
+
+def test_sample_topk_then_topp_composition_property():
+    """Property pin of the composition ORDER: top_k cuts first, then
+    top_p renormalizes over the k survivors. Distribution chosen so the
+    orders disagree: probs [0.45, 0.14, 0.22, 0.19], k=2, p=0.6. k-first
+    keeps {0, 2} and renormalizes to {0.672, 0.328}; mass before token 2
+    is 0.672 >= 0.6, so the nucleus is {0} alone. p-first would keep
+    token 2 (mass before it over the FULL distribution is 0.45 < 0.6).
+    Every draw must therefore be token 0."""
+    from nanodiloco_tpu.models.generate import _sample
+
+    logits = jnp.log(jnp.asarray([[0.45, 0.14, 0.22, 0.19]]))
+    keys = jax.random.split(jax.random.key(19), 200)
+    draws = {int(_sample(logits, k, 1.0, 2, 0.6)[0]) for k in keys}
+    assert draws == {0}
+    # sanity: with the nucleus off the same top_k=2 cut draws both
+    draws_k = {int(_sample(logits, k, 1.0, 2, 1.0)[0]) for k in keys}
+    assert draws_k == {0, 2}
+
+
+def test_auto_decode_block_boundary_through_generate():
+    """The 1024-context threshold through the REAL generate path: at
+    total context 1023 the auto path is dense; at exactly 1024 it flips
+    to 512-key tiles (whose cache rounds to a block multiple) and the
+    tokens must not change. One micro model, prompt 1019 + 5 new = 1024."""
+    from nanodiloco_tpu.models.generate import _auto_decode_block
+
+    assert _auto_decode_block(1023) == 0
+    assert _auto_decode_block(1024) == 512
+    assert _auto_decode_block(1025) == 512
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_attention_heads=2, num_hidden_layers=1,
+        max_position_embeddings=1024,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 1019), 0, 64)
+    with jax.default_matmul_precision("highest"):
+        auto = generate(params, prompt, cfg, 5)           # ctx 1024: blockwise
+        dense = generate(params, prompt, cfg, 5, decode_block=0)
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(dense))
+
+
+def test_pad_prompts_ragged_and_empty():
+    """Engine-admission edge shapes: ragged lengths left-pad against the
+    longest row; an empty ROW is all-pad with a zero valid mask; an
+    empty LIST is a clear error, not a bare max() crash."""
+    toks, valid = pad_prompts([[3, 14, 15], [7]], pad_id=9)
+    np.testing.assert_array_equal(np.asarray(toks), [[3, 14, 15], [9, 9, 7]])
+    np.testing.assert_array_equal(np.asarray(valid), [[1, 1, 1], [0, 0, 1]])
+
+    toks, valid = pad_prompts([[], [4, 5]])
+    assert toks.shape == (2, 2)
+    np.testing.assert_array_equal(np.asarray(valid), [[0, 0], [1, 1]])
+    np.testing.assert_array_equal(np.asarray(toks[1]), [4, 5])
+
+    with pytest.raises(ValueError, match="at least one prompt"):
+        pad_prompts([])
+
+
 def test_ragged_moe_decode_has_no_capacity_divergence():
     """Token-choice MoE decode's documented divergence (capacity sized
     from the current call's tokens, not the full training batch) is a

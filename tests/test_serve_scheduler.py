@@ -1,0 +1,285 @@
+"""Scheduler unit tests (nanodiloco_tpu/serve/scheduler): admission,
+slot refill mid-decode, EOS retirement, queue-full backpressure, and
+deadline expiry — all against a scripted fake backend and an injected
+clock. Deterministic, model-free, tier-1."""
+
+import pytest
+
+from nanodiloco_tpu.serve.scheduler import GenRequest, QueueFull, Scheduler
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeBackend:
+    """Scripted slot backend: each request's token stream comes from its
+    seed (``scripts[seed]``); prefill returns the first token, every
+    step returns each live slot's next. Records the call sequence so
+    tests can assert scheduling decisions, not just outcomes."""
+
+    def __init__(self, num_slots: int, scripts: dict[int, list[int]]) -> None:
+        self.num_slots = num_slots
+        self.scripts = scripts
+        self.cursor: list[int] = [0] * num_slots
+        self.seed_at: list[int | None] = [None] * num_slots
+        self.log: list[tuple] = []
+
+    def prefill(self, slot: int, request: GenRequest) -> int:
+        self.log.append(("prefill", slot, request.seed))
+        self.seed_at[slot] = request.seed
+        self.cursor[slot] = 1
+        return self.scripts[request.seed][0]
+
+    def step(self) -> list[int]:
+        self.log.append(("step", tuple(self.seed_at)))
+        out = []
+        for s in range(self.num_slots):
+            seed = self.seed_at[s]
+            if seed is None:
+                out.append(-1)
+                continue
+            out.append(self.scripts[seed][self.cursor[s]])
+            self.cursor[s] += 1
+        return out
+
+    def release(self, slot: int) -> None:
+        self.log.append(("release", slot))
+        self.seed_at[slot] = None
+
+
+def _sched(num_slots=2, scripts=None, max_queue=4, clock=None):
+    scripts = scripts or {}
+    clock = clock or FakeClock()
+    backend = FakeBackend(num_slots, scripts)
+    return Scheduler(backend, max_queue=max_queue, clock=clock), backend, clock
+
+
+def test_fifo_admission_fills_free_slots_lowest_first():
+    sched, backend, _ = _sched(
+        scripts={1: [10, 11, 12], 2: [20, 21, 22], 3: [30, 31, 32]}
+    )
+    t1 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=3, seed=1))
+    t2 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=3, seed=2))
+    t3 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=3, seed=3))
+    live = sched.tick()
+    assert live == 2  # two slots, third request still queued
+    assert backend.log[:2] == [("prefill", 0, 1), ("prefill", 1, 2)]
+    assert sched.stats()["queue_depth"] == 1
+    for _ in range(5):
+        sched.tick()
+    assert t1.result["tokens"] == [10, 11, 12]
+    assert t2.result["tokens"] == [20, 21, 22]
+    assert t3.result["tokens"] == [30, 31, 32]
+    assert all(t.result["finish_reason"] == "length" for t in (t1, t2, t3))
+
+
+def test_slot_refill_mid_decode_no_stop_the_world():
+    """Request C is admitted into A's freed slot while B is still
+    decoding — B's stream never pauses and C's prefill lands between
+    decode steps (continuous batching, not batch barriers)."""
+    sched, backend, _ = _sched(
+        scripts={1: [10, 11], 2: [20, 21, 22, 23, 24], 3: [30, 31, 32]}
+    )
+    ta = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=1))
+    tb = sched.submit(GenRequest(prompt=(5,), max_new_tokens=5, seed=2))
+    sched.tick()  # admit A(slot0)+B(slot1), one step: A done, slot 0 free
+    assert ta.done() and ta.result["tokens"] == [10, 11]
+    assert not tb.done()
+    tc = sched.submit(GenRequest(prompt=(5,), max_new_tokens=3, seed=3))
+    live = sched.tick()  # C admitted into slot 0 while B decodes
+    assert live == 2
+    assert ("prefill", 0, 3) in backend.log
+    # B stepped in EVERY tick, including the one that admitted C
+    steps = [e for e in backend.log if e[0] == "step"]
+    assert all(2 in e[1] for e in steps)
+    for _ in range(4):
+        sched.tick()
+    assert tc.result["tokens"] == [30, 31, 32]
+    assert tb.result["tokens"] == [20, 21, 22, 23, 24]
+
+
+def test_eos_retirement_frees_slot_and_truncates():
+    sched, backend, _ = _sched(
+        scripts={1: [10, 99, 12, 13], 2: [20, 21, 22]}, num_slots=1
+    )
+    t1 = sched.submit(
+        GenRequest(prompt=(5,), max_new_tokens=4, seed=1, stop_token=99)
+    )
+    t2 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=3, seed=2))
+    sched.tick()  # admit 1, step emits 99 -> retired
+    assert t1.done()
+    assert t1.result["tokens"] == [10, 99]
+    assert t1.result["finish_reason"] == "stop"
+    assert ("release", 0) in backend.log
+    for _ in range(3):
+        sched.tick()
+    assert t2.result["tokens"] == [20, 21, 22]
+
+
+def test_instant_stop_at_prefill_never_occupies_a_slot():
+    """First sampled token == stop_token: the request finishes at
+    admission, its backend slot is RELEASED (an unreleased instant
+    finish would keep decoding as a zombie and, under MoE, spend shared
+    expert capacity), and the SAME slot admits the next queued request
+    within the same tick."""
+    sched, backend, _ = _sched(
+        scripts={1: [99], 2: [20, 21]}, num_slots=1
+    )
+    t1 = sched.submit(
+        GenRequest(prompt=(5,), max_new_tokens=4, seed=1, stop_token=99)
+    )
+    t2 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=2))
+    sched.tick()
+    assert t1.done() and t1.result["finish_reason"] == "stop"
+    assert backend.log[:3] == [
+        ("prefill", 0, 1), ("release", 0), ("prefill", 0, 2)
+    ]
+    sched.tick()
+    assert t2.done() and t2.result["tokens"] == [20, 21]
+
+
+def test_queue_full_raises_and_counts_rejection():
+    sched, _, _ = _sched(max_queue=2, scripts={1: [10]})
+    sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=1))
+    sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=1))
+    with pytest.raises(QueueFull):
+        sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=1))
+    assert sched.stats()["rejected"] == 1
+    assert sched.stats()["queue_depth"] == 2
+
+
+def test_queued_deadline_expires_before_a_slot_is_held():
+    clock = FakeClock()
+    sched, backend, clock = _sched(
+        num_slots=1, scripts={1: [10, 11, 12, 13, 14], 2: [20, 21]},
+        clock=clock,
+    )
+    t1 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=5, seed=1))
+    t2 = sched.submit(
+        GenRequest(prompt=(5,), max_new_tokens=2, seed=2, deadline_s=1.0)
+    )
+    sched.tick()  # request 1 takes the only slot; 2 waits
+    clock.advance(2.0)  # past request 2's deadline while still queued
+    sched.tick()
+    assert t2.done()
+    assert t2.result["finish_reason"] == "deadline"
+    assert t2.result["tokens"] == []
+    assert not any(e == ("prefill", 0, 2) for e in backend.log)
+    assert sched.stats()["expired"] == 1
+    for _ in range(5):
+        sched.tick()
+    assert t1.result["tokens"] == [10, 11, 12, 13, 14]
+
+
+def test_running_deadline_retires_with_partial_output():
+    clock = FakeClock()
+    sched, _, clock = _sched(
+        num_slots=1, scripts={1: [10, 11, 12, 13, 14, 15]}, clock=clock
+    )
+    t1 = sched.submit(
+        GenRequest(prompt=(5,), max_new_tokens=6, seed=1, deadline_s=1.5)
+    )
+    sched.tick()   # prefill + 1 step: [10, 11]
+    clock.advance(2.0)
+    sched.tick()   # one more step lands, then the deadline retires it
+    assert t1.done()
+    assert t1.result["finish_reason"] == "deadline"
+    assert t1.result["tokens"] == [10, 11, 12]
+    assert sched.stats()["slots_busy"] == 0
+
+
+def test_cancel_queued_request_never_takes_a_slot():
+    sched, backend, _ = _sched(
+        num_slots=1, scripts={1: [10, 11, 12], 2: [20, 21]}
+    )
+    t1 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=3, seed=1))
+    t2 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=2))
+    sched.tick()  # 1 holds the slot, 2 queued
+    t2.cancel()
+    for _ in range(4):
+        sched.tick()
+    assert t2.result["finish_reason"] == "cancelled"
+    assert t2.result["tokens"] == []
+    assert not any(e == ("prefill", 0, 2) for e in backend.log)
+    assert t1.result["tokens"] == [10, 11, 12]
+    assert sched.stats()["cancelled"] == 1
+
+
+def test_cancel_running_request_retires_with_partial_output():
+    sched, backend, _ = _sched(
+        num_slots=1, scripts={1: [10, 11, 12, 13, 14, 15]}
+    )
+    t1 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=6, seed=1))
+    sched.tick()  # prefill + one step: [10, 11]
+    t1.cancel()
+    sched.tick()  # one more token lands, then the cancel retires it
+    assert t1.done()
+    assert t1.result["finish_reason"] == "cancelled"
+    assert t1.result["tokens"] == [10, 11, 12]
+    assert ("release", 0) in backend.log
+    assert sched.stats()["slots_busy"] == 0
+
+
+def test_queued_s_measures_wait_not_prefill():
+    """queued_s is the time WAITING for a slot (submit -> admission);
+    ttft_s additionally includes the prefill — with a clock that steps
+    on every observation the two must differ."""
+
+    class SteppingClock(FakeClock):
+        def __call__(self) -> float:
+            self.t += 0.5
+            return self.t
+
+    sched, _, _ = _sched(num_slots=1, scripts={1: [10, 11]},
+                         clock=SteppingClock())
+    t1 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=1))
+    sched.tick()
+    assert t1.done()
+    assert t1.result["queued_s"] < t1.result["ttft_s"]
+
+
+def test_prefill_error_fails_one_request_not_the_loop():
+    class Exploding(FakeBackend):
+        def prefill(self, slot, request):
+            if request.seed == 13:
+                raise ValueError("prompt too long for the engine")
+            return super().prefill(slot, request)
+
+    backend = Exploding(1, {1: [10, 11]})
+    sched = Scheduler(backend, max_queue=4, clock=FakeClock())
+    bad = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=13))
+    good = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=1))
+    sched.tick()
+    assert bad.done() and bad.result["finish_reason"] == "error"
+    assert "too long" in bad.result["error"]
+    sched.tick()
+    assert good.done() and good.result["tokens"] == [10, 11]
+    assert sched.stats()["errors"] == 1
+
+
+def test_stats_timing_uses_injected_clock():
+    class SteppingClock(FakeClock):
+        def __call__(self) -> float:
+            self.t += 0.5  # every observation advances half a second
+            return self.t
+
+    sched, _, _ = _sched(num_slots=1, scripts={1: [10, 11]},
+                         clock=SteppingClock())
+    t1 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=1))
+    sched.tick()
+    assert t1.done()
+    s = sched.stats()
+    assert s["served"] == 1
+    assert s["ttft_last_s"] is not None and s["ttft_last_s"] > 0
+    assert s["decode_s"] == pytest.approx(0.5)
+    assert s["decode_tokens_per_sec"] == pytest.approx(2.0)
+    assert t1.result["ttft_s"] == pytest.approx(s["ttft_last_s"])
+    assert t1.result["total_s"] > t1.result["ttft_s"]
