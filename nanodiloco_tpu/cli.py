@@ -206,6 +206,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="watchdog: alarm when no loop heartbeat for this "
                         "many times the rolling round time (0 disables "
                         "the heartbeat thread)")
+    p.add_argument("--watch-drift", type=float, default=0.0,
+                   help="watchdog: alarm when a sync's drift_max (max "
+                        "pairwise worker replica distance / snapshot "
+                        "norm, from the dynamics metrics) exceeds this — "
+                        "fires before quarantine-level blow-ups (0 "
+                        "disables; calibrate from a few rounds' logged "
+                        "drift_max)")
+    p.add_argument("--dynamics-metrics", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="compute DiLoCo dynamics on device at every sync "
+                        "(per-worker pseudo-gradient norms, cross-worker "
+                        "drift, outer-momentum norm, pseudo-gradient/"
+                        "update cosine) and log them into the sync JSONL "
+                        "records and telemetry gauges; zero effect on "
+                        "training numerics (classic rounds only)")
     # --- resilience (nanodiloco_tpu/resilience) ---
     p.add_argument("--watch-action", type=str, default="none",
                    choices=["none", "checkpoint-exit"],
@@ -322,6 +337,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         watch_loss_window=args.watch_loss_window,
         watch_tps_collapse=args.watch_tps_collapse,
         watch_stall_factor=args.watch_stall_factor,
+        watch_drift=args.watch_drift,
+        dynamics_metrics=args.dynamics_metrics,
         watch_action=args.watch_action,
         preempt_signals=args.preempt_signals,
         fault_plan=args.fault_plan,
@@ -465,6 +482,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "output (unset = no deadline)")
     p.add_argument("--request-timeout-s", type=float, default=600.0,
                    help="HTTP-level wait bound per request")
+    p.add_argument("--trace-out", type=str, default=None, metavar="JSON",
+                   help="export per-request serve spans (queued/prefill/"
+                        "decode, tagged with request ids) as a Chrome "
+                        "trace-event JSON at shutdown — merges with "
+                        "training shards via `report merge-trace` onto "
+                        "one Perfetto timeline")
+    p.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
+                   help="enable POST /debug/profile?seconds=N: capture a "
+                        "jax.profiler trace from the LIVE serving process "
+                        "into this directory and return its path (unset = "
+                        "endpoint answers 404)")
     p.add_argument("--force-cpu-devices", type=int, default=None, metavar="N",
                    help="serve on N virtual CPU devices instead of the "
                         "accelerator")
@@ -492,7 +520,16 @@ def serve_main(argv: list[str]) -> None:
     engine = InferenceEngine(
         params, model_cfg, num_slots=args.slots, max_len=max_len,
     )
-    scheduler = Scheduler(engine, max_queue=args.max_queue)
+    tracer = None
+    if args.trace_out:
+        from nanodiloco_tpu.obs import SpanTracer
+
+        # SAME clock as the scheduler (time.monotonic, its default) so
+        # the recorded request-phase timestamps land on this tracer's
+        # timebase; a distinct process name keeps the serve lane
+        # labeled when merged with training shards
+        tracer = SpanTracer(clock=time.monotonic, process_name="nanodiloco serve")
+    scheduler = Scheduler(engine, max_queue=args.max_queue, tracer=tracer)
     server = ServeServer(
         scheduler, tokenizer,
         port=args.port, host=args.host,
@@ -500,6 +537,7 @@ def serve_main(argv: list[str]) -> None:
         max_new_tokens_cap=args.max_new_tokens_cap,
         request_timeout_s=args.request_timeout_s,
         default_deadline_s=args.deadline_s,
+        profile_dir=args.profile_dir,
     ).start()
     print(
         f"serving {args.checkpoint_dir} on {args.host}:{server.port} "
@@ -517,6 +555,12 @@ def serve_main(argv: list[str]) -> None:
             time.sleep(0.2)
     finally:
         server.stop()
+        if tracer is not None:
+            try:
+                tracer.export_chrome(args.trace_out)
+                print(f"serve span trace -> {args.trace_out}", flush=True)
+            except OSError:
+                pass  # a full disk must not mask the shutdown
 
 
 def _load_checkpoint_snapshot(checkpoint_dir: str, step: int | None):
@@ -621,9 +665,18 @@ def report_main(argv: list[str]) -> None:
     ``report faults RUN.jsonl``: the run's fault timeline — injected
     faults, watchdog alarms, IO retries, preempt exits, and resumes, in
     step order — reconstructed from the JSONL records the resilience
-    stack writes."""
+    stack writes.
+
+    ``report drift RUN.jsonl``: the run's DiLoCo dynamics timeline —
+    per-sync cross-worker drift, per-worker pseudo-gradient norms,
+    outer-momentum norm, and pseudo-gradient/update cosine (the
+    quantities a quantized outer wire needs to stay tame), from the
+    sync records the dynamics metrics write."""
     if argv[:1] == ["compare"]:
         report_compare_main(argv[1:])
+        return
+    if argv[:1] == ["drift"]:
+        report_drift_main(argv[1:])
         return
     if argv[:1] == ["merge-trace"]:
         report_merge_trace_main(argv[1:])
@@ -842,6 +895,84 @@ def report_faults_main(argv: list[str]) -> None:
         )
         label = e.get("kind") or e.get("op") or e.get("reason") or ""
         print(f"step {e.get('step', '?'):>8}  {e['event']:<8} {label:<18} {detail}")
+
+
+def report_drift_main(argv: list[str]) -> None:
+    """``report drift RUN.jsonl``: one line per outer sync, in step
+    order — the dynamics timeline a drift alarm sends an operator to.
+    Divergence alarms interleave at their step so the timeline shows
+    what the sentinel saw when it fired."""
+    p = argparse.ArgumentParser(prog="nanodiloco_tpu report drift")
+    p.add_argument("jsonl", help="metrics JSONL from a run with "
+                                 "--dynamics-metrics (the default)")
+    p.add_argument("--json", action="store_true",
+                   help="print the timeline as one JSON array")
+    args = p.parse_args(argv)
+
+    from nanodiloco_tpu.training.metrics import read_jsonl_records
+
+    recs, _torn = read_jsonl_records(args.jsonl)
+    events = []
+    for r in recs:
+        if r.get("drift_max") is not None:
+            events.append({
+                "event": "sync",
+                "step": r.get("step"),
+                "drift_max": r["drift_max"],
+                "drift_mean": r.get("drift_mean"),
+                "pg_norm": r.get("pg_norm"),
+                "outer_momentum_norm": r.get("outer_momentum_norm"),
+                "outer_update_cos": r.get("outer_update_cos"),
+                **({"quarantined_workers": r["quarantined_workers"]}
+                   if r.get("quarantined_workers") else {}),
+            })
+        elif r.get("alarm") == "divergence":
+            events.append({"event": "alarm", **r})
+    if args.json:
+        print(json.dumps(events))
+        return
+    if not events:
+        print(
+            "no dynamics records (run predates the dynamics metrics, "
+            "used --no-dynamics-metrics, or streamed)"
+        )
+        return
+    def num(e: dict, key: str, spec: str = ".4g") -> str:
+        # keys may be PRESENT but None (a torn record, an older writer):
+        # a dict.get default never fires then — format defensively
+        v = e.get(key)
+        return format(v, spec) if isinstance(v, (int, float)) else "?"
+
+    def step_of(e: dict):
+        # same present-but-null hazard: ">8" on None raises
+        s = e.get("step")
+        return "?" if s is None else s
+
+    for e in events:
+        if e["event"] == "alarm":
+            print(
+                f"step {step_of(e):>8}  ALARM divergence "
+                f"drift={e.get('drift')} threshold={e.get('threshold')}"
+            )
+            continue
+        # same present-but-null hazard for the list-valued key
+        pg = [x for x in (e.get("pg_norm") or [])
+              if isinstance(x, (int, float))]
+        pg_s = (
+            f" pg[min={min(pg):.4g} max={max(pg):.4g}]" if pg else ""
+        )
+        quar = (
+            f" quarantined={e['quarantined_workers']}"
+            if e.get("quarantined_workers") else ""
+        )
+        print(
+            f"step {step_of(e):>8}  "
+            f"drift_max={num(e, 'drift_max')} "
+            f"drift_mean={num(e, 'drift_mean')}"
+            f"{pg_s} "
+            f"momentum={num(e, 'outer_momentum_norm')} "
+            f"cos={num(e, 'outer_update_cos', '.3f')}{quar}"
+        )
 
 
 def main(argv: list[str] | None = None) -> None:

@@ -28,7 +28,13 @@ from nanodiloco_tpu.obs.tracer import (
     trace_span,
 )
 from nanodiloco_tpu.obs.watchdog import Watchdog, WatchdogConfig
-from nanodiloco_tpu.obs.telemetry import TelemetryServer, parse_metrics_text
+from nanodiloco_tpu.obs.telemetry import (
+    Histogram,
+    TelemetryServer,
+    capture_live_profile,
+    parse_metrics_text,
+    render_exposition,
+)
 
 __all__ = [
     "SpanTracer",
@@ -39,6 +45,9 @@ __all__ = [
     "trace_span",
     "Watchdog",
     "WatchdogConfig",
+    "Histogram",
     "TelemetryServer",
+    "capture_live_profile",
     "parse_metrics_text",
+    "render_exposition",
 ]

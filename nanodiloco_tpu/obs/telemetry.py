@@ -21,6 +21,11 @@ Endpoints:
   NaN poisons every later step — the job is unhealthy even though the
   loop still turns). Loss spikes and throughput dips stay 200: they
   are alerts, not liveness failures.
+- ``POST /debug/profile?seconds=N`` — capture a ``jax.profiler`` trace
+  of the LIVE process into the run's profile directory and return its
+  path (``capture_live_profile``). Guarded: one capture at a time
+  (409 when busy), bounded duration, 404 unless a profile directory
+  was configured.
 
 The server binds ``port`` on all interfaces (a scraper is usually not
 on the host); ``port=0`` picks a free port, exposed as ``.port`` (and
@@ -29,10 +34,15 @@ printed by the train loop) — the form tests and one-off runs use.
 
 from __future__ import annotations
 
+import bisect
 import json
+import math
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
+from urllib.parse import parse_qs, urlparse
 
 OPENMETRICS_CONTENT_TYPE = (
     "application/openmetrics-text; version=1.0.0; charset=utf-8"
@@ -64,7 +74,98 @@ _GAUGE_KEYS = {
     "quarantined_workers": (
         "nanodiloco_quarantined_workers", "workers masked out of the last sync"
     ),
+    # DiLoCo dynamics metrics (parallel/diloco.py::_sync_dynamics):
+    # drift, momentum, and update-alignment — the quantities quantized
+    # outer comm needs to stay tame (arXiv:2501.18512)
+    "drift_max": (
+        "nanodiloco_drift_max",
+        "max pairwise worker replica distance / snapshot norm at the "
+        "last sync",
+    ),
+    "drift_mean": (
+        "nanodiloco_drift_mean",
+        "RMS pairwise worker replica distance / snapshot norm at the "
+        "last sync",
+    ),
+    "outer_momentum_norm": (
+        "nanodiloco_outer_momentum_norm",
+        "outer Nesterov momentum norm after the last sync",
+    ),
+    "outer_update_cos": (
+        "nanodiloco_outer_update_cos",
+        "cosine(mean pseudo-gradient, applied outer update descent "
+        "direction) at the last sync",
+    ),
 }
+
+
+# -- histograms (OpenMetrics cumulative-bucket form) --------------------------
+
+# latency buckets in seconds: sub-ms to a minute, the span a serving
+# TTFT / queue-wait / decode-tick distribution actually occupies
+DEFAULT_TIME_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def nearest_rank_percentile(sorted_vals, p: float):
+    """Standard nearest-rank percentile over an ascending-sorted list:
+    the smallest value with at least ``ceil(p*n)`` observations at or
+    below it; None on empty input. ONE implementation for every
+    window-percentile consumer (the serve scheduler's TTFT gauges,
+    ``scripts/serve_bench.py``'s client-side stats) — the biased
+    ``int(p*n)`` indexing both used to hand-roll read p50 of two
+    samples as the larger one."""
+    if not sorted_vals:
+        return None
+    k = max(0, math.ceil(p * len(sorted_vals)) - 1)
+    return sorted_vals[min(len(sorted_vals) - 1, k)]
+
+
+class Histogram:
+    """Fixed-bucket cumulative histogram (the OpenMetrics shape: every
+    bucket counts observations <= its upper bound, ``+Inf`` counts all).
+    Thread-safe: the serve tick thread observes while HTTP threads
+    snapshot. Gauge-window percentiles (the PR-4 TTFT snapshot) answered
+    "what was p95 over the last 512 requests"; a real histogram lets a
+    scraper compute rates and quantiles over ANY window, aggregated
+    across processes — the difference between a demo metric and one
+    Prometheus can actually alert on."""
+
+    def __init__(self, buckets=DEFAULT_TIME_BUCKETS) -> None:
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("a histogram needs at least one bucket bound")
+        if len(set(bounds)) != len(bounds):
+            raise ValueError(f"duplicate bucket bounds: {buckets}")
+        self.bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # last = overflow (+Inf only)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        """``{"buckets": [(le, cumulative), ..., ("+Inf", count)],
+        "count": n, "sum": s}`` — the exposition-ready cumulative form."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cum = 0
+        buckets: list[tuple[float | str, int]] = []
+        for bound, c in zip(self.bounds, counts):
+            cum += c
+            buckets.append((bound, cum))
+        buckets.append(("+Inf", total))
+        return {"buckets": buckets, "count": total, "sum": s}
 
 
 class TelemetryServer:
@@ -80,10 +181,17 @@ class TelemetryServer:
         port: int = 0,
         host: str = "0.0.0.0",
         health_fn: Callable[[], dict] | None = None,
+        profile_dir: str | None = None,
     ) -> None:
         self._health_fn = health_fn
+        # on-demand live profiling: POST /debug/profile?seconds=N
+        # captures a jax.profiler trace from THIS process into
+        # ``profile_dir`` (None = the endpoint answers 404 — profiling
+        # must be an operator opt-in, the capture is heavyweight)
+        self.profile_dir = profile_dir
         self._lock = threading.Lock()
         self._gauges: dict[str, float] = {}
+        self._worker_pg: dict[int, float] = {}  # worker -> last pg norm
         self._phases: dict[str, float] = {}
         self._alarms: dict[str, int] = {}
         self._faults: dict[str, int] = {}    # injected-fault records by kind
@@ -99,6 +207,13 @@ class TelemetryServer:
             def log_message(self, *args):  # a scrape must not spam stdout
                 pass
 
+            def _reply(self, code, body, ctype):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
@@ -111,11 +226,19 @@ class TelemetryServer:
                     ctype = "application/json"
                 else:
                     code, body, ctype = 404, b"not found\n", "text/plain"
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._reply(code, body, ctype)
+
+            def do_POST(self):
+                if self.path.split("?", 1)[0] != "/debug/profile":
+                    self._reply(404, b"not found\n", "text/plain")
+                    return
+                code, doc = handle_profile_request(
+                    server.profile_dir, self.path
+                )
+                self._reply(
+                    code, (json.dumps(doc) + "\n").encode(),
+                    "application/json",
+                )
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
@@ -163,6 +286,12 @@ class TelemetryServer:
                     self._outer_syncs += int(bool(v))
                 elif k == "wire_bytes_total":
                     self._wire_total = float(v)
+                elif k == "pg_norm" and isinstance(v, (list, tuple)):
+                    # per-worker pseudo-gradient norms from the sync's
+                    # dynamics record -> one labeled gauge per worker
+                    for w, nv in enumerate(v):
+                        if isinstance(nv, (int, float)):
+                            self._worker_pg[w] = float(nv)
                 elif k.startswith("t_") and isinstance(v, (int, float)):
                     self._phases[k[2:]] = float(v)
                 elif k == "cost_analysis" and isinstance(v, dict):
@@ -180,6 +309,7 @@ class TelemetryServer:
         renderer so every /metrics in the project speaks one dialect)."""
         with self._lock:
             gauges = dict(self._gauges)
+            worker_pg = dict(self._worker_pg)
             phases = dict(self._phases)
             alarms = dict(self._alarms)
             faults = dict(self._faults)
@@ -200,11 +330,18 @@ class TelemetryServer:
             (name, "gauge", helps.get(name), [(None, gauges[name])])
             for name in sorted(gauges)
         ]
+        if worker_pg:
+            families.append((
+                "nanodiloco_worker_pg_norm", "gauge",
+                "per-worker pseudo-gradient norm at the last outer sync",
+                [({"worker": str(w)}, worker_pg[w])
+                 for w in sorted(worker_pg)],
+            ))
         if phases:
             families.append((
                 "nanodiloco_phase_seconds", "gauge",
                 "last round's host-side phase budget",
-                [(f'phase="{ph}"', phases[ph]) for ph in sorted(phases)],
+                [({"phase": ph}, phases[ph]) for ph in sorted(phases)],
             ))
         # resilience counters: alarms/injected faults by kind, IO retries
         # by op, checkpoint resumes — the scrapeable fault timeline
@@ -217,12 +354,14 @@ class TelemetryServer:
         ):
             families.append((
                 name, "counter", help_text,
-                [(f'{label}="{k}"', by[k]) for k in sorted(by)]
+                [({label: k}, by[k]) for k in sorted(by)]
                 + [(None, sum(by.values()))],
             ))
-        families.append(("nanodiloco_resumes", "counter", None,
+        families.append(("nanodiloco_resumes", "counter",
+                         "checkpoint resumes observed by this process",
                          [(None, resumes)]))
-        families.append(("nanodiloco_outer_syncs", "counter", None,
+        families.append(("nanodiloco_outer_syncs", "counter",
+                         "outer syncs completed",
                          [(None, syncs)]))
         families.append((
             "nanodiloco_wire_bytes", "counter",
@@ -251,27 +390,162 @@ class TelemetryServer:
         return (503 if unhealthy else 200), doc
 
 
+# -- on-demand live profiling (/debug/profile) --------------------------------
+
+# jax.profiler's trace machinery is process-global: exactly one capture
+# may run at a time (a second start_trace raises), and the startup
+# --profile-dir window uses the same machinery. One lock + a monotonic
+# capture counter keep concurrent POSTs (and repeated captures into the
+# same dir) from trampling each other.
+_PROFILE_LOCK = threading.Lock()
+_PROFILE_SEQ = [0]
+PROFILE_MAX_SECONDS = 60.0
+
+
+def acquire_profiler_window() -> None:
+    """Blocking-acquire the process-global profiler for a planned trace
+    window (the train loop's startup ``--profile-dir`` capture). While
+    held, live ``/debug/profile`` captures answer 409; conversely a live
+    capture in flight makes this WAIT (bounded by
+    ``PROFILE_MAX_SECONDS``) instead of letting the planned
+    ``jax.profiler.start_trace`` crash on 'already started'. Pair every
+    acquire with ``release_profiler_window``."""
+    _PROFILE_LOCK.acquire()
+
+
+def release_profiler_window() -> None:
+    _PROFILE_LOCK.release()
+
+
+def capture_live_profile(out_dir: str, seconds: float) -> dict:
+    """Capture a ``jax.profiler`` trace of THIS live process for
+    ``seconds`` into a fresh subdirectory of ``out_dir`` and return
+    ``{"trace_dir", "seconds"}`` — the missing half of ``--profile-dir``
+    (startup-only): the one time profiling matters is when a RUNNING
+    job misbehaves, and restarting it to profile destroys the evidence.
+
+    Raises RuntimeError when a capture is already in progress (here or
+    the startup window) and ValueError on an out-of-range duration.
+    The sleep happens on the caller's thread (an HTTP handler thread on
+    the serving/telemetry endpoints) — training/serving dispatch is
+    NEVER blocked; the profiler collects from the live threads."""
+    seconds = float(seconds)
+    if not 0.0 < seconds <= PROFILE_MAX_SECONDS:
+        raise ValueError(
+            f"seconds must be in (0, {PROFILE_MAX_SECONDS:g}]; got {seconds}"
+        )
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        raise RuntimeError("a profile capture is already in progress")
+    try:
+        import jax
+
+        _PROFILE_SEQ[0] += 1
+        trace_dir = os.path.join(out_dir, f"capture-{_PROFILE_SEQ[0]:03d}")
+        os.makedirs(trace_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except Exception as e:
+            # the startup --profile-dir window (or an embedder's trace)
+            # holds the global profiler — busy, not broken
+            raise RuntimeError(f"profiler unavailable: {e}") from e
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        return {"trace_dir": trace_dir, "seconds": seconds}
+    finally:
+        _PROFILE_LOCK.release()
+
+
+def handle_profile_request(
+    profile_dir: str | None, raw_path: str
+) -> tuple[int, dict]:
+    """Shared POST /debug/profile handler body for the telemetry and
+    serving endpoints: parse ``?seconds=N`` (default 2), run the
+    capture, map failures to HTTP semantics (404 endpoint disabled,
+    400 bad duration, 409 capture already running)."""
+    if profile_dir is None:
+        return 404, {
+            "error": "live profiling is not configured on this server "
+                     "(no profile directory)"
+        }
+    q = parse_qs(urlparse(raw_path).query)
+    try:
+        seconds = float(q.get("seconds", ["2"])[0])
+    except ValueError:
+        return 400, {"error": f"bad seconds value: {q['seconds'][0]!r}"}
+    try:
+        return 200, capture_live_profile(profile_dir, seconds)
+    except ValueError as e:
+        return 400, {"error": str(e)}
+    except RuntimeError as e:
+        return 409, {"error": str(e)}
+    except Exception as e:  # a broken profiler must not kill the server
+        return 500, {"error": f"{type(e).__name__}: {e}"}
+
+
 def _fmt(v: float) -> str:
     return repr(int(v)) if float(v).is_integer() and abs(v) < 2**53 else repr(v)
 
 
+def escape_label_value(v: Any) -> str:
+    """OpenMetrics label-value escaping: backslash, double-quote, and
+    line feed are the three characters the text format cannot carry
+    raw (ABNF: escaped-char). Everything else passes through."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    """HELP-text escaping (backslash and line feed; quotes are legal in
+    help)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _render_labels(labels: dict[str, Any]) -> str:
+    return ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in labels.items()
+    )
+
+
 def render_exposition(families) -> str:
-    """OpenMetrics text from ``(name, type, help, samples)`` families,
-    where ``samples`` is ``[(labels_or_None, value)]`` (labels as a
-    pre-rendered ``key="value"`` string). Counters follow the spec's
-    family-name / ``_total``-sample split; ``# EOF`` terminates the
-    exposition (a truncated scrape must be detectable as truncated).
-    Shared by the training telemetry endpoint above and the serving
-    endpoint (nanodiloco_tpu/serve/server.py)."""
+    """OpenMetrics text from ``(name, type, help, samples)`` families.
+
+    - gauge/counter: ``samples`` is ``[(labels_or_None, value)]`` with
+      ``labels`` a dict — values are escaped here (``\\``, ``"`` and
+      newline per the spec), so callers never hand-render label strings.
+      Counters follow the spec's family-name / ``_total``-sample split.
+    - histogram: ``samples`` is a ``Histogram.snapshot()`` dict —
+      rendered as the cumulative ``_bucket{le=...}`` series plus
+      ``_count`` and ``_sum``.
+
+    Every family gets ``# HELP`` and ``# TYPE`` metadata (HELP text
+    escaped); ``# EOF`` terminates the exposition (a truncated scrape
+    must be detectable as truncated). Shared by the training telemetry
+    endpoint above and the serving endpoint
+    (nanodiloco_tpu/serve/server.py) — one dialect everywhere."""
     lines: list[str] = []
     for name, mtype, help_text, samples in families:
+        lines.append(f"# HELP {name} {_escape_help(help_text or name)}")
         lines.append(f"# TYPE {name} {mtype}")
-        if help_text:
-            lines.append(f"# HELP {name} {help_text}")
+        if mtype == "histogram":
+            snap = samples
+            for le, cum in snap["buckets"]:
+                le_s = le if isinstance(le, str) else _fmt(float(le))
+                lines.append(f'{name}_bucket{{le="{le_s}"}} {int(cum)}')
+            lines.append(f"{name}_count {int(snap['count'])}")
+            lines.append(f"{name}_sum {_fmt(float(snap['sum']))}")
+            continue
         sample_name = name + "_total" if mtype == "counter" else name
         for labels, value in samples:
             if labels:
-                lines.append(f"{sample_name}{{{labels}}} {_fmt(value)}")
+                lines.append(
+                    f"{sample_name}{{{_render_labels(labels)}}} {_fmt(value)}"
+                )
             else:
                 lines.append(f"{sample_name} {_fmt(value)}")
     lines.append("# EOF")

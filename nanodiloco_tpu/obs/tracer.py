@@ -51,6 +51,7 @@ class SpanTracer:
         clock: Callable[[], float] = time.perf_counter,
         max_events: int = 500_000,
         process_index: int = 0,
+        process_name: str | None = None,
     ) -> None:
         self._clock = clock
         self._max_events = max_events
@@ -59,6 +60,11 @@ class SpanTracer:
         # stable pids (the train loop passes jax.process_index() — this
         # module itself stays jax-free)
         self.process_index = int(process_index)
+        # display name for the Chrome process lane; default keeps the
+        # training "nanodiloco rank{k}" convention. A serve-side tracer
+        # names itself distinctly so a merged train+serve timeline shows
+        # two labeled lanes instead of two anonymous rank0s.
+        self.process_name = process_name or f"nanodiloco rank{self.process_index}"
         self._lock = threading.Lock()
         self._events: list[dict[str, Any]] = []
         self._dropped = 0
@@ -117,6 +123,38 @@ class SpanTracer:
                 if depth == 0:
                     self._totals[name] = self._totals.get(name, 0.0) + (t1 - t0)
 
+    def record_span(self, name: str, t0: float, t1: float, **args: Any) -> None:
+        """Record an ALREADY-TIMED span: ``t0``/``t1`` are values of
+        THIS tracer's own clock, captured by the caller (the serve
+        scheduler times request phases — queued/prefill/decode — with
+        its injectable clock and reports them here after the fact; a
+        context manager cannot wrap a wait that started on another
+        thread). The caller must construct the tracer with the SAME
+        clock it timestamps with, or the lanes won't line up. Recorded
+        at depth 0, so serve phases aggregate into ``phase_totals``
+        like the train loop's spans do."""
+        if self._max_events <= 0:
+            return
+        tid = threading.get_ident()
+        ev = {
+            "name": name,
+            "t0": float(t0),
+            "dur": max(0.0, float(t1) - float(t0)),
+            "depth": 0,
+            "tid": tid,
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            if tid not in self._thread_names:
+                self._thread_names[tid] = threading.current_thread().name
+            self._events.append(ev)
+            if len(self._events) > self._max_events:
+                drop = len(self._events) - self._max_events
+                del self._events[:drop]
+                self._dropped += drop
+            self._totals[name] = self._totals.get(name, 0.0) + ev["dur"]
+
     def phase_totals(self, reset: bool = True) -> dict[str, float]:
         """Seconds per DEPTH-0 span name since the last reset — the
         per-round phase budget. Only top-level spans count, so nested
@@ -149,7 +187,7 @@ class SpanTracer:
                 "name": "process_name",
                 "ph": "M",
                 "pid": pid,
-                "args": {"name": f"nanodiloco rank{self.process_index}"},
+                "args": {"name": self.process_name},
             }
         ]
         for tid, tname in sorted(thread_names.items()):

@@ -47,6 +47,12 @@ class WatchdogConfig:
     stall_factor: float = 5.0    # alarm after factor*mean round time; <=0 off
     min_stall_s: float = 30.0    # never call a stall before this many seconds
     poll_s: float = 2.0          # heartbeat thread cadence
+    # divergence sentinel: alarm when the cross-worker drift (max
+    # pairwise replica distance / snapshot norm, the per-sync
+    # `drift_max` dynamics metric) exceeds this, or goes non-finite —
+    # the early warning that fires BEFORE a replica reaches
+    # quarantine-level blow-up. <=0 disables.
+    drift_threshold: float = 0.0
 
 
 class Watchdog:
@@ -87,7 +93,8 @@ class Watchdog:
         self._final_state: str | None = None  # set by stop()
         # per-kind armed flags: one alarm per episode
         self._armed = {"nan_loss": True, "loss_spike": True,
-                       "throughput_collapse": True, "stall": True}
+                       "throughput_collapse": True, "stall": True,
+                       "divergence": True}
         self._status_extra: dict[str, Any] = {}
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -168,6 +175,28 @@ class Watchdog:
                 )
                 return
         self._rearm("loss_spike")
+
+    def observe_drift(self, step: int, drift: float, **detail: Any) -> None:
+        """Divergence sentinel (per-episode, like the other sentinels):
+        called once per outer sync with the normalized cross-worker
+        drift (`drift_max` from the dynamics metrics). Alarms when the
+        drift exceeds ``drift_threshold`` — or is non-finite, which
+        means a replica already blew up (quarantine territory; the
+        sentinel exists to fire BEFORE that, but a NaN drift must never
+        read as healthy)."""
+        if self.cfg.drift_threshold <= 0:
+            return
+        drift = float(drift)
+        if not math.isfinite(drift) or drift > self.cfg.drift_threshold:
+            self._fire(
+                "divergence", step,
+                drift=(str(drift) if not math.isfinite(drift)
+                       else round(drift, 6)),
+                threshold=self.cfg.drift_threshold,
+                **detail,
+            )
+            return
+        self._rearm("divergence")
 
     def observe_throughput(self, step: int, tokens_per_sec: float) -> None:
         tps = float(tokens_per_sec)

@@ -109,6 +109,18 @@ class DilocoConfig:
     # round-trip). The reference has no analog: its NaN would all-reduce
     # into every rank.
     quarantine_nonfinite: bool = False
+    # DiLoCo dynamics telemetry, computed ON DEVICE inside the same
+    # program as the outer step (fused round or stepwise sync — never an
+    # extra dispatch, never an extra snapshot fetch): per-worker
+    # pseudo-gradient norms, cross-worker replica drift (max/mean
+    # pairwise distance normalized by the snapshot norm), the outer
+    # Nesterov momentum norm, and the cosine between the averaged
+    # pseudo-gradient and the applied outer update. Pure readouts of
+    # values the sync already computes — training numerics are
+    # bit-identical on or off (asserted by the smoke gate). When on,
+    # ``round_step`` returns a 4th element and ``outer_step`` a 2nd:
+    # the dynamics dict (see ``_sync_dynamics``).
+    dynamics_metrics: bool = False
 
 
 def _wire_accumulator_dtype(num_workers: int, q_max: float):
@@ -1066,15 +1078,140 @@ class Diloco:
 
         return jax.tree.map(heal, inner_opt_state, unstacked)
 
+    def _replicated_scalar_constraint(self, x: jax.Array) -> jax.Array:
+        """Replicate a small dynamics output across the mesh so the host
+        can fetch it on a pod (a [W] vector reduced from diloco-sharded
+        params stays diloco-sharded; np.asarray of a non-addressable
+        shard raises on multi-process runs — the same hazard the loss
+        path handles by reducing on device first)."""
+        if self.mesh.size == 1:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P())
+        )
+
+    def _sync_dynamics(
+        self,
+        old_snapshot: Any,
+        params_w: Any,
+        delta: Any,
+        updates: Any,
+        outer_opt_state: Any,
+    ) -> dict[str, jax.Array]:
+        """The DiLoCo dynamics readout, fused into the sync program
+        (``dynamics_metrics``). Everything here is a pure function of
+        values the outer step already holds — pre-reset worker params,
+        the old snapshot, the averaged pseudo-gradient, the applied
+        update, the new momentum — so it adds zero dispatches and
+        cannot perturb training numerics. All accumulation is float32.
+
+        Returns (host-fetchable: replicated on multi-device meshes):
+
+        - ``pg_norm`` [W]: each worker's pseudo-gradient norm
+          ``||snapshot - params_w||`` — the per-worker magnitude whose
+          spread is the first sign of one replica running away.
+        - ``drift_max`` / ``drift_mean``: max / RMS pairwise distance
+          between worker replicas, normalized by ``||snapshot||`` — the
+          drift H inner steps actually opened up, the quantity
+          quantized outer comm (arXiv:2501.18512) needs to stay tame.
+          Pairwise distances are computed from the deviation gram
+          ``G_ij = <p_i - mean, p_j - mean>`` (all entries O(drift²),
+          so the ``G_ii + G_jj - 2 G_ij`` combination is
+          well-conditioned — a raw-params gram would cancel
+          catastrophically when replicas are close). The exact worker
+          mean is recomputed here (under a quantized wire ``delta`` is
+          coarsened; drift must measure the real replicas).
+        - ``outer_momentum_norm``: norm of the outer optimizer's float
+          state (the Nesterov trace) AFTER the update.
+        - ``outer_update_cos``: cosine between the averaged
+          pseudo-gradient and the DESCENT direction of the applied
+          update (``-updates``): +1 when momentum and the fresh
+          pseudo-gradient agree, falling toward 0/negative as they
+          fight — drift in this cosine precedes loss-visible
+          divergence. Under quarantine a dead replica's NaN flows
+          through (honest: the watchdog's divergence sentinel treats
+          non-finite drift as alarming)."""
+        W = self.cfg.num_workers
+        f32 = jnp.float32
+
+        def leaf_sq(t):
+            return sum(
+                jnp.sum(jnp.square(x.astype(f32))) for x in jax.tree.leaves(t)
+            )
+
+        # per-worker pseudo-gradient norms: [W]
+        pg_sq = sum(
+            jnp.sum(
+                jnp.square((s[None] - p).astype(f32)),
+                axis=tuple(range(1, p.ndim)),
+            )
+            for s, p in zip(jax.tree.leaves(old_snapshot), jax.tree.leaves(params_w))
+        )
+        pg_norm = jnp.sqrt(pg_sq)
+
+        snap_norm = jnp.sqrt(leaf_sq(old_snapshot))
+        tiny = jnp.finfo(f32).tiny
+
+        if W > 1:
+            # deviation gram accumulated leaf-by-leaf (one f32 deviation
+            # copy of one leaf at a time — no full-tree f32 replica-set
+            # held live, same discipline as the integer wire)
+            gram = jnp.zeros((W, W), f32)
+            for p in jax.tree.leaves(params_w):
+                e = p.astype(f32)
+                e = e - jnp.mean(e, axis=0, keepdims=True)
+                e2 = e.reshape((W, -1))
+                gram = gram + e2 @ e2.T
+            diag = jnp.diagonal(gram)
+            sq_dist = diag[:, None] + diag[None, :] - 2.0 * gram
+            iu, ju = jnp.triu_indices(W, k=1)
+            pair = jnp.sqrt(jnp.maximum(sq_dist[iu, ju], 0.0))
+            drift_max = jnp.max(pair) / jnp.maximum(snap_norm, tiny)
+            drift_mean = jnp.sqrt(jnp.mean(jnp.square(pair))) / jnp.maximum(
+                snap_norm, tiny
+            )
+        else:
+            drift_max = jnp.zeros((), f32)
+            drift_mean = jnp.zeros((), f32)
+
+        mom_sq = sum(
+            jnp.sum(jnp.square(x.astype(f32)))
+            for x in jax.tree.leaves(outer_opt_state)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
+        )
+        mom_norm = jnp.sqrt(jnp.asarray(mom_sq, f32))
+
+        dot = sum(
+            jnp.sum(d.astype(f32) * u.astype(f32))
+            for d, u in zip(jax.tree.leaves(delta), jax.tree.leaves(updates))
+        )
+        d_norm = jnp.sqrt(leaf_sq(delta))
+        u_norm = jnp.sqrt(leaf_sq(updates))
+        # -dot: `updates` is what apply_updates ADDS (−lr · direction);
+        # the reported cosine is against the descent direction, so a
+        # healthy momentum-aligned round reads near +1
+        cos = -dot / jnp.maximum(d_norm * u_norm, tiny)
+
+        rep = self._replicated_scalar_constraint
+        return {
+            "pg_norm": rep(pg_norm),
+            "drift_max": rep(drift_max),
+            "drift_mean": rep(drift_mean),
+            "outer_momentum_norm": rep(mom_norm),
+            "outer_update_cos": rep(cos),
+        }
+
     def _outer_step(
         self, state: DilocoState, worker_mask: jax.Array | None = None
     ) -> tuple[DilocoState, jax.Array]:
-        """Returns ``(state, effective_mask)``: the [W] bool mask of
-        workers that actually contributed to the outer mean — the EXACT
-        quarantine criterion (caller's loss mask AND replica-params
-        finiteness), so logging can report the true quarantine count
-        instead of re-deriving a loss-only approximation (round-4
-        advisor finding). All-ones when quarantine is off."""
+        """Returns ``(state, effective_mask, dynamics)``: the [W] bool
+        mask of workers that actually contributed to the outer mean —
+        the EXACT quarantine criterion (caller's loss mask AND
+        replica-params finiteness), so logging can report the true
+        quarantine count instead of re-deriving a loss-only
+        approximation (round-4 advisor finding); all-ones when
+        quarantine is off. ``dynamics`` is the ``_sync_dynamics``
+        readout dict when ``dynamics_metrics`` is on, else None."""
         W = self.cfg.num_workers
         inner_opt_state = state.inner_opt_state
         old_snapshot = state.snapshot
@@ -1096,6 +1233,15 @@ class Diloco:
         updates, outer_opt_state = self.outer_tx.update(
             delta, state.outer_opt_state, old_snapshot
         )
+        # dynamics readout BEFORE the reset overwrites the replicas —
+        # pure arithmetic over values this step already computed
+        dyn = (
+            self._sync_dynamics(
+                old_snapshot, state.params, delta, updates, outer_opt_state
+            )
+            if self.cfg.dynamics_metrics
+            else None
+        )
         snapshot = optax.apply_updates(old_snapshot, updates)
         snapshot = self._constrain(snapshot, worker_axis=False)
         # every worker resets to the new sync point (ref diloco.py:50)
@@ -1111,23 +1257,28 @@ class Diloco:
             params=params, snapshot=snapshot,
             inner_opt_state=inner_opt_state,
             outer_opt_state=outer_opt_state,
-        ), eff
+        ), eff, dyn
 
     def _outer_step_state(
         self, state: DilocoState, worker_mask: jax.Array | None = None
-    ) -> DilocoState:
-        """Public stepwise entry: just the new state (the stepwise train
-        loop derives the exact quarantine count itself — pre-reset params
-        are still host-reachable there, unlike in the fused round)."""
-        new, _ = self._outer_step(state, worker_mask)
-        return new
+    ):
+        """Public stepwise entry: the new state (the stepwise train loop
+        derives the exact quarantine count itself — pre-reset params are
+        still host-reachable there, unlike in the fused round), plus the
+        dynamics dict as a second element when ``dynamics_metrics`` is
+        on (the return arity is a per-config constant, so every compiled
+        program has a fixed output structure)."""
+        new, _, dyn = self._outer_step(state, worker_mask)
+        return (new, dyn) if self.cfg.dynamics_metrics else new
 
     def _round_step(self, state: DilocoState, tokens: jax.Array, loss_mask: jax.Array):
         """One FULL DiLoCo round — ``inner_steps`` inner updates
         (``lax.scan``) plus the outer sync — as a single XLA executable.
         tokens/loss_mask: [H, W, accum, B, S]. Returns (state, [H, W]
         losses, [W] effective sync mask — the workers whose replicas
-        entered the outer mean; all ones when quarantine is off).
+        entered the outer mean; all ones when quarantine is off), plus
+        a 4th element — the ``_sync_dynamics`` dict — when
+        ``dynamics_metrics`` is on.
 
         One program per round is the TPU-native shape of the training
         loop: no host round-trips between steps, no executable switching
@@ -1152,14 +1303,18 @@ class Diloco:
             # finiteness, which also catches a blow-up on the round's
             # final update) is applied inside _outer_step
             wmask = jnp.all(jnp.isfinite(losses), axis=0)
-        state, eff = self._outer_step(state, wmask)
+        state, eff, dyn = self._outer_step(state, wmask)
+        if self.cfg.dynamics_metrics:
+            return state, losses, eff, dyn
         return state, losses, eff
 
     def _inner_round_step(self, state: DilocoState, tokens, loss_mask):
         """``_round_step`` minus the outer sync — the differencing baseline
-        for measuring the fused outer step's marginal cost. Same return
-        structure as ``_round_step`` (the all-ones mask stands in) so the
-        two dispatch identically."""
+        for measuring the fused outer step's marginal cost. Same first
+        three outputs as ``_round_step`` (the all-ones mask stands in) so
+        the two dispatch identically; under ``dynamics_metrics`` the full
+        round additionally carries the on-device dynamics readout, whose
+        (tiny) cost is honestly billed to the sync by the differencing."""
 
         def one(s, batch):
             s, loss = self._inner_step(s, batch[0], batch[1])
@@ -1311,5 +1466,6 @@ class Diloco:
         the reference accepted ``inner_steps`` and ignored it
         (ref diloco.py:8-25, SURVEY §2 quirks)."""
         toks, masks = self.stack_round_batches(batches)
-        state, losses, _ = self.round_step(state, toks, masks)
+        out = self.round_step(state, toks, masks)
+        state, losses = out[0], out[1]
         return self._offload(state), losses

@@ -146,6 +146,13 @@ class StreamingDiloco(Diloco):
                 "verdict exists yet; run classic rounds (or restart via "
                 "--supervise) for fault quarantine"
             )
+        if cfg.dynamics_metrics:
+            raise ValueError(
+                "dynamics_metrics is classic-DiLoCo-only: streaming has no "
+                "single sync point at which the whole-model pseudo-gradient "
+                "and drift exist (each fragment launches on its own "
+                "stagger); run classic rounds for the dynamics telemetry"
+            )
         if cfg.offload_snapshot:
             raise ValueError(
                 "offload_snapshot is classic-DiLoCo-only: streaming's "

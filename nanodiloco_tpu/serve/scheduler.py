@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Callable
 
+from nanodiloco_tpu.obs.telemetry import Histogram, nearest_rank_percentile
+
 
 class QueueFull(RuntimeError):
     """Raised by ``submit`` when the admission queue is at capacity —
@@ -46,7 +48,11 @@ class QueueFull(RuntimeError):
 class GenRequest:
     """One generation request. ``deadline_s`` is a RELATIVE budget from
     submission; a request past it is expired (queued) or retired with
-    its partial output (running)."""
+    its partial output (running). ``request_id`` is an optional
+    client-supplied correlation id echoed in the result (and stamped on
+    the request's trace spans); absent, the scheduler derives one from
+    its rid so client logs, serve spans, and histograms always have a
+    join key."""
 
     prompt: tuple[int, ...]
     max_new_tokens: int
@@ -56,6 +62,7 @@ class GenRequest:
     seed: int = 0
     stop_token: int | None = None
     deadline_s: float | None = None
+    request_id: str | None = None
 
 
 class Ticket:
@@ -117,11 +124,20 @@ class Scheduler:
         *,
         max_queue: int = 64,
         clock: Callable[[], float] = time.monotonic,
+        tracer=None,
     ) -> None:
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1; got {max_queue}")
         self.backend = backend
         self._clock = clock
+        # per-request span sink (obs/tracer.SpanTracer or None): the
+        # scheduler reports each request's queued/prefill/decode phases
+        # via record_span with ITS OWN clock's timestamps — construct
+        # the tracer with the same clock callable, or the serve trace's
+        # lanes won't align. Export through trace_shard_path / `report
+        # merge-trace` puts serve spans on the same Perfetto timeline
+        # as the training shards.
+        self.tracer = tracer
         self.max_queue = int(max_queue)
         self._slots: list[_Running | None] = [None] * backend.num_slots
         self._queue: collections.deque[_Queued] = collections.deque()
@@ -138,6 +154,13 @@ class Scheduler:
         self._decode_tokens = 0
         self._decode_s = 0.0
         self._ttft: collections.deque[float] = collections.deque(maxlen=512)
+        # real distributions for the scrape (cumulative-bucket
+        # histograms; the deque above remains for last/p50/p95 gauges):
+        # TTFT submit->first-token, slot wait submit->admit, and the
+        # per-tick decode latency (one compiled step for all live slots)
+        self.hist_ttft = Histogram()
+        self.hist_queue_wait = Histogram()
+        self.hist_decode_tick = Histogram()
 
     # -- submission (any thread) --------------------------------------------
 
@@ -183,6 +206,8 @@ class Scheduler:
                 self._expired += 1
             else:
                 self._cancelled += 1
+            self._span("queued", q.submitted_at, now,
+                       self._req_id(q.ticket, q.request), outcome=reason)
             self._finish(q.ticket, q.request, [], reason,
                          q.submitted_at, None, None, now)
 
@@ -199,9 +224,14 @@ class Scheduler:
                 break
             if q.ticket.cancelled:  # cancelled between sweep and pop
                 self._cancelled += 1
+                now2 = self._clock()
+                self._span("queued", q.submitted_at, now2,
+                           self._req_id(q.ticket, q.request),
+                           outcome="cancelled")
                 self._finish(q.ticket, q.request, [], "cancelled",
-                             q.submitted_at, None, None, self._clock())
+                             q.submitted_at, None, None, now2)
                 continue
+            rid_str = self._req_id(q.ticket, q.request)
             t_admit = self._clock()
             try:
                 tok0 = self.backend.prefill(slot, q.request)
@@ -211,11 +241,18 @@ class Scheduler:
                 # kills the tick loop — a broken engine must flip
                 # /healthz to 503, not limp along half-alive
                 self._errors += 1
+                self._span("queued", q.submitted_at, t_admit, rid_str,
+                           outcome="error")
                 self._finish(q.ticket, q.request, [], "error",
                              q.submitted_at, None, None, self._clock(),
                              error=str(e))
                 continue
             t_first = self._clock()
+            self.hist_queue_wait.observe(t_admit - q.submitted_at)
+            self.hist_ttft.observe(t_first - q.submitted_at)
+            self._span("queued", q.submitted_at, t_admit, rid_str, slot=slot)
+            self._span("prefill", t_admit, t_first, rid_str, slot=slot,
+                       prompt_tokens=len(q.request.prompt))
             with self._lock:  # stats() sorts this deque from HTTP threads
                 self._ttft.append(t_first - q.submitted_at)
             self._tokens_out += 1
@@ -238,6 +275,7 @@ class Scheduler:
             toks = self.backend.step()
             t1 = self._clock()
             self._decode_s += t1 - t0
+            self.hist_decode_tick.observe(t1 - t0)
             self._tokens_out += len(live)
             self._decode_tokens += len(live)
             for s in live:
@@ -247,8 +285,24 @@ class Scheduler:
                 if reason is not None:
                     self._backend_release(s)
                     self._slots[s] = None
+                    self._span("decode", run.first_token_at, t1,
+                               self._req_id(run.ticket, run.request),
+                               tokens=len(run.tokens), outcome=reason)
                     self._retire(run, reason, t1)
         return sum(1 for s in self._slots if s is not None)
+
+    def _req_id(self, ticket: Ticket, request: GenRequest) -> str:
+        """The request's correlation id: client-supplied when present,
+        else derived from the scheduler's rid — the SAME string lands in
+        the result dict, the HTTP response, and the trace spans."""
+        return request.request_id or f"req-{ticket.rid}"
+
+    def _span(self, name: str, t0: float, t1: float, request_id: str,
+              **args) -> None:
+        if self.tracer is not None:
+            self.tracer.record_span(
+                name, t0, t1, request_id=request_id, **args
+            )
 
     def _backend_release(self, slot: int) -> None:
         release = getattr(self.backend, "release", None)
@@ -286,6 +340,7 @@ class Scheduler:
                 error: str | None = None) -> None:
         result = {
             "rid": ticket.rid,
+            "request_id": self._req_id(ticket, request),
             "tokens": list(tokens),
             "finish_reason": reason,
             # time spent WAITING for a slot (a never-admitted request
@@ -317,16 +372,17 @@ class Scheduler:
 
     def stats(self) -> dict:
         """Snapshot for the serve gauges. TTFT percentiles come from a
-        rolling window of the last 512 admissions."""
+        rolling window of the last 512 admissions, by the standard
+        nearest-rank definition (``nearest_rank_percentile`` — the
+        previous ``int(p*len)`` index was biased at small n: p50 of
+        [1,2] read 2, p95 of 20 samples read the max, not the 19th)."""
         with self._lock:
             depth = len(self._queue)
             ttft_snapshot = list(self._ttft)  # tick appends under the lock
         ttft = sorted(ttft_snapshot)
 
         def pct(p: float) -> float | None:
-            if not ttft:
-                return None
-            return ttft[min(len(ttft) - 1, int(p * len(ttft)))]
+            return nearest_rank_percentile(ttft, p)
 
         return {
             "queue_depth": depth,
@@ -346,4 +402,9 @@ class Scheduler:
             "ttft_last_s": ttft_snapshot[-1] if ttft_snapshot else None,
             "ttft_p50_s": pct(0.50),
             "ttft_p95_s": pct(0.95),
+            # full distributions (cumulative-bucket form) for the
+            # histogram families on /metrics
+            "hist_ttft": self.hist_ttft.snapshot(),
+            "hist_queue_wait": self.hist_queue_wait.snapshot(),
+            "hist_decode_tick": self.hist_decode_tick.snapshot(),
         }

@@ -21,9 +21,15 @@ Endpoints:
 - ``GET /healthz`` — 200 while the tick loop is alive, 503 after it
   died; body carries queue depth and slot occupancy.
 - ``GET /metrics`` — OpenMetrics serve gauges (queue depth, slot
-  occupancy, TTFT last/p50/p95, decode tokens/s) and counters
-  (requests by outcome, tokens), rendered by the same
-  ``render_exposition`` the training telemetry endpoint uses.
+  occupancy, TTFT last/p50/p95, decode tokens/s), counters (requests
+  by outcome, tokens), and real histograms (cumulative buckets +
+  ``_count``/``_sum`` for TTFT, queue wait, per-tick decode latency),
+  rendered by the same ``render_exposition`` the training telemetry
+  endpoint uses.
+- ``POST /debug/profile?seconds=N`` — capture a ``jax.profiler`` trace
+  of the live serving process (``profile_dir`` opt-in; 404 without it,
+  409 while a capture runs) — the on-demand twin of the training
+  telemetry endpoint's.
 """
 
 from __future__ import annotations
@@ -35,6 +41,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from nanodiloco_tpu.obs.telemetry import (
     OPENMETRICS_CONTENT_TYPE,
+    handle_profile_request,
     render_exposition,
 )
 from nanodiloco_tpu.serve.scheduler import GenRequest, QueueFull, Scheduler
@@ -58,9 +65,13 @@ class ServeServer:
         request_timeout_s: float = 600.0,
         default_deadline_s: float | None = None,
         idle_sleep_s: float = 0.002,
+        profile_dir: str | None = None,
     ) -> None:
         self._scheduler = scheduler
         self._tokenizer = tokenizer
+        # POST /debug/profile?seconds=N target directory (None = the
+        # endpoint answers 404; live profiling is an operator opt-in)
+        self.profile_dir = profile_dir
         self._default_new = int(default_max_new_tokens)
         self._cap_new = int(max_new_tokens_cap)
         self._timeout_s = float(request_timeout_s)
@@ -100,7 +111,14 @@ class ServeServer:
                     self._reply(404, b"not found\n", "text/plain")
 
             def do_POST(self):
-                if self.path.split("?", 1)[0] != "/v1/generate":
+                path = self.path.split("?", 1)[0]
+                if path == "/debug/profile":
+                    code, out = handle_profile_request(
+                        server.profile_dir, self.path
+                    )
+                    self._reply_json(code, out)
+                    return
+                if path != "/v1/generate":
                     self._reply(404, b"not found\n", "text/plain")
                     return
                 try:
@@ -197,6 +215,10 @@ class ServeServer:
             tokens = tokens[: tokens.index(request.stop_token)]
         out = {
             "id": result["rid"],
+            # the join key across client logs, serve trace spans, and
+            # the latency histograms: client-supplied or scheduler-
+            # assigned, always echoed
+            "request_id": result["request_id"],
             "finish_reason": result["finish_reason"],
             "token_ids": tokens,
             "prompt_tokens": len(request.prompt),
@@ -247,6 +269,15 @@ class ServeServer:
         stop_token = doc.get("stop_token")
         if stop_token is None and doc.get("stop", True):
             stop_token = getattr(self._tokenizer, "eos_id", None)
+        request_id = doc.get("request_id")
+        if request_id is not None:
+            if not isinstance(request_id, str) or not request_id:
+                raise ValueError("request_id must be a non-empty string")
+            if len(request_id) > 128:
+                raise ValueError(
+                    f"request_id is too long ({len(request_id)} chars; "
+                    "max 128)"
+                )
         deadline = doc.get("deadline_s", self._default_deadline_s)
         # reject impossible shapes at submit time (400), not in the loop
         backend = self._scheduler.backend
@@ -261,6 +292,7 @@ class ServeServer:
             seed=int(doc.get("seed", 0)),
             stop_token=None if stop_token is None else int(stop_token),
             deadline_s=None if deadline is None else float(deadline),
+            request_id=request_id,
         )
 
     # -- observability -------------------------------------------------------
@@ -309,11 +341,24 @@ class ServeServer:
         families.append((
             "nanodiloco_serve_requests", "counter",
             "requests by terminal outcome",
-            [(f'outcome="{k}"', v) for k, v in outcomes]
+            [({"outcome": k}, v) for k, v in outcomes]
             + [(None, sum(v for _, v in outcomes))],
         ))
         families.append((
             "nanodiloco_serve_tokens", "counter",
             "tokens sampled (prefill + decode)", [(None, s["tokens_out"])],
         ))
+        # real distributions (cumulative buckets + _count/_sum): what a
+        # scraper can alert and aggregate on, unlike the window gauges
+        for name, help_text, key in (
+            ("nanodiloco_serve_ttft_histogram_seconds",
+             "time to first token, submit to first sampled token",
+             "hist_ttft"),
+            ("nanodiloco_serve_queue_wait_seconds",
+             "slot wait, submit to admission", "hist_queue_wait"),
+            ("nanodiloco_serve_decode_tick_seconds",
+             "one compiled decode step advancing all live slots",
+             "hist_decode_tick"),
+        ):
+            families.append((name, "histogram", help_text, s[key]))
         return render_exposition(families)

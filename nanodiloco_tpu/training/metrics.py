@@ -213,6 +213,15 @@ def summarize_run(path: str) -> dict[str, Any]:
     hbm = series("hbm_peak_bytes")
     if hbm:
         out["hbm_peak_gib"] = round(max(hbm) / 2**30, 3)
+    # DiLoCo dynamics (per-sync drift records; `report drift` prints the
+    # full timeline) — summary keys appear only when the run logged them
+    drift = series("drift_max")
+    if drift:
+        out["drift_max_last"] = round(drift[-1], 6)
+        out["drift_max_peak"] = round(max(drift), 6)
+    cos = series("outer_update_cos")
+    if cos:
+        out["outer_update_cos_last"] = round(cos[-1], 4)
     drop = series("moe_dropped_frac")
     if drop:
         out["moe_dropped_frac_last"] = round(drop[-1], 5)

@@ -144,6 +144,14 @@ class TrainConfig:
     # (parallel/diloco.py::DilocoConfig.quarantine_nonfinite); the reset
     # self-heals the diverged replica at the same sync
     quarantine_nonfinite: bool = False
+    # DiLoCo dynamics telemetry (DilocoConfig.dynamics_metrics): per-
+    # worker pseudo-gradient norms, cross-worker drift, outer-momentum
+    # norm, pseudo-gradient/update cosine — computed on device inside
+    # the sync program and logged into every sync's JSONL record (and
+    # the telemetry gauges). Pure readout: losses are bit-identical on
+    # or off (smoke-gate-asserted). Classic rounds only; ignored (with
+    # a notice) under streaming.
+    dynamics_metrics: bool = True
     model: LlamaConfig = dataclasses.field(default_factory=LlamaConfig)
     # initialize weights from an HF Llama checkpoint directory (sharded
     # or single-file safetensors) — continued pretraining. Streams
@@ -188,6 +196,13 @@ class TrainConfig:
     watch_loss_window: int = 32
     watch_tps_collapse: float = 0.4
     watch_stall_factor: float = 5.0
+    # divergence sentinel: alarm when the per-sync drift_max dynamics
+    # metric (max pairwise replica distance / snapshot norm) exceeds
+    # this — the early warning that fires BEFORE quarantine-level
+    # blow-ups. 0 disables (the default: healthy drift magnitude is
+    # run-specific; calibrate from a few rounds' logged drift_max).
+    # Requires dynamics_metrics.
+    watch_drift: float = 0.0
     # --- resilience (resilience/) ---
     # what a FATAL watchdog alarm (stall / nan_loss) does:
     # "checkpoint-exit" checkpoints at the next round boundary and exits
@@ -221,6 +236,51 @@ class TrainConfig:
         if self.batch_size % self.per_device_batch_size:
             raise ValueError("batch_size must divide evenly by per_device_batch_size")
         return self.batch_size // self.per_device_batch_size
+
+
+def _profiler_start(profile_dir: str) -> None:
+    """Start the startup ``--profile-dir`` capture under the process-
+    global profiler lock (obs/telemetry): a live ``/debug/profile``
+    capture in flight would make ``start_trace`` raise and kill the run,
+    and while this window is held live captures answer 409. The lock is
+    released on a failed start — a leaked lock turns every later
+    capture into a 409 and a later profiled train() into a silent hang."""
+    from nanodiloco_tpu.obs.telemetry import (
+        acquire_profiler_window,
+        release_profiler_window,
+    )
+
+    acquire_profiler_window()
+    try:
+        jax.profiler.start_trace(profile_dir)
+    except BaseException:
+        release_profiler_window()
+        raise
+
+
+def _profiler_stop() -> None:
+    """Stop the startup capture and release the window, unconditionally
+    paired (a failing stop must still free the lock)."""
+    from nanodiloco_tpu.obs.telemetry import release_profiler_window
+
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        release_profiler_window()
+
+
+def _host_dynamics(dyn: dict) -> dict:
+    """Device dynamics dict (parallel/diloco.py::_sync_dynamics) ->
+    JSONL-ready host floats: ``pg_norm`` as a per-worker list, the rest
+    scalars. Fetched once per sync, AFTER the round's timing fences —
+    readout cost never lands in the measured round/sync seconds."""
+    return {
+        "pg_norm": [float(x) for x in np.asarray(dyn["pg_norm"])],
+        "drift_max": float(dyn["drift_max"]),
+        "drift_mean": float(dyn["drift_mean"]),
+        "outer_momentum_norm": float(dyn["outer_momentum_norm"]),
+        "outer_update_cos": float(dyn["outer_update_cos"]),
+    }
 
 
 def _finite_worker_mean(losses: jax.Array) -> jax.Array:
@@ -374,6 +434,20 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         mesh = build_hybrid_mesh(mesh_cfg, cfg.dcn_slices)
     else:
         mesh = build_mesh(mesh_cfg)
+    # dynamics are a classic-rounds readout (streaming has no single
+    # whole-model sync point — StreamingDiloco rejects the flag)
+    dynamics_on = cfg.dynamics_metrics and cfg.streaming_fragments == 0
+    if cfg.dynamics_metrics and not dynamics_on and not quiet:
+        print(
+            "[nanodiloco] dynamics metrics disabled: streaming DiLoCo "
+            "has no single sync point to read whole-model drift at"
+        )
+    if cfg.watch_drift > 0 and not dynamics_on:
+        raise ValueError(
+            "--watch-drift needs the dynamics metrics (classic rounds "
+            "with --dynamics-metrics) — there is no drift signal to "
+            "watch without them"
+        )
     dcfg = DilocoConfig(
         num_workers=cfg.num_workers,
         inner_steps=cfg.inner_steps,
@@ -387,6 +461,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         outer_comm_dtype=cfg.outer_comm_dtype,
         outer_wire_collective=cfg.outer_wire_collective,
         quarantine_nonfinite=cfg.quarantine_nonfinite,
+        dynamics_metrics=dynamics_on,
     )
 
     tokenizer = get_tokenizer(cfg.tokenizer)
@@ -701,6 +776,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             loss_window=cfg.watch_loss_window,
             tps_collapse_frac=cfg.watch_tps_collapse,
             stall_factor=cfg.watch_stall_factor,
+            drift_threshold=cfg.watch_drift,
         ),
         emit=lambda rec: logger.log(rec),
         status_path=cfg.status_file if logger.is_writer else None,
@@ -752,15 +828,23 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     if cfg.metrics_port is not None and logger.is_writer:
         from nanodiloco_tpu.obs.telemetry import TelemetryServer
 
+        # on-demand live profiling target: next to the run's JSONL when
+        # a log dir exists (the run dir IS where an operator looks for
+        # artifacts); without one the endpoint answers 404
+        live_profile_dir = (
+            os.path.join(cfg.log_dir, f"{run_name}-live-profile")
+            if cfg.log_dir else None
+        )
         try:
             telemetry = TelemetryServer(
-                port=cfg.metrics_port, health_fn=watchdog.status_doc
+                port=cfg.metrics_port, health_fn=watchdog.status_doc,
+                profile_dir=live_profile_dir,
             ).start()
             logger.telemetry = telemetry
             if not quiet:
                 print(
                     f"[nanodiloco] telemetry: port {telemetry.port} "
-                    "(/metrics, /healthz)"
+                    "(/metrics, /healthz, POST /debug/profile)"
                 )
         except OSError as e:
             telemetry = None
@@ -869,6 +953,10 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
 
     completed = False
     emergency: _EmergencyExit | None = None
+    # whether the stepwise startup-profile window is currently open
+    # (holds the process-global profiler lock) — defined OUTSIDE the try
+    # so the teardown can release a window an exception left open
+    profiling = False
     try:
         evaluator = None
         if cfg.eval_every:
@@ -961,7 +1049,6 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         # total_steps still produces a trace.
         profile_start = min(start_step + 3, cfg.total_steps)
         profile_stop = min(profile_start + 3, cfg.total_steps)
-        profiling = False
         last_eval_step = None
 
         fused = (
@@ -1034,7 +1121,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         pending = prefetcher.submit(dl.stack_round_batches, batches)
                     tracing = rnd == profile_round
                     if tracing:
-                        jax.profiler.start_trace(cfg.profile_dir)
+                        _profiler_start(cfg.profile_dir)
                     try:
                         # the fused round program contains the outer sync —
                         # this span is inner compute + sync as ONE phase;
@@ -1042,7 +1129,9 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         # differenced measure_comm estimate below
                         with trace_span("inner", round=rnd):
                             t0 = time.perf_counter()
-                            state, losses, eff_mask = dl.round_step(state, toks, masks)
+                            out = dl.round_step(state, toks, masks)
+                            state, losses, eff_mask = out[0], out[1], out[2]
+                            round_dyn = out[3] if dynamics_on else None
                             jax.block_until_ready(losses)
                             round_s = time.perf_counter() - t0
                     finally:
@@ -1050,7 +1139,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         # global profiler or every later train() hits
                         # "profiling is already in progress"
                         if tracing:
-                            jax.profiler.stop_trace()
+                            _profiler_stop()
                     compute_time += round_s
                     state = dl._offload(state)
                     if cfg.measure_comm:
@@ -1070,7 +1159,8 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                                 if rnd == last_round:  # no warm round 2 will come
                                     probe = jax.tree.map(jnp.copy, state)
                                     t0 = time.perf_counter()
-                                    probe, probe_loss, _ = dl.round_step(probe, toks, masks)
+                                    pout = dl.round_step(probe, toks, masks)
+                                    probe, probe_loss = pout[0], pout[1]
                                     jax.block_until_ready(probe_loss)
                                     best_full_s = time.perf_counter() - t0
                                     del probe
@@ -1167,6 +1257,16 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                             max(0.0, round_budget["t_inner"] - sync_est), 6
                         )
                     wire_bytes_total += wire_rec["wire_bytes_per_sync"]
+                    # dynamics readout (host fetch AFTER the timing
+                    # fences): per-worker pg norms, drift, momentum,
+                    # update cosine — into the sync record, the
+                    # telemetry gauges, and the divergence sentinel
+                    dyn_metrics = {}
+                    if round_dyn is not None:
+                        dyn_metrics = _host_dynamics(round_dyn)
+                        watchdog.observe_drift(
+                            real_step, dyn_metrics["drift_max"]
+                        )
                     tps = (real_step - start_step) * tokens_per_step / compute_time
                     with trace_span("log"):
                         for i in range(cfg.inner_steps):
@@ -1191,7 +1291,8 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                                     **round_budget,
                                     **(
                                         {**wire_metrics,
-                                         "wire_bytes_total": wire_bytes_total}
+                                         "wire_bytes_total": wire_bytes_total,
+                                         **dyn_metrics}
                                         if i == cfg.inner_steps - 1 else {}
                                     ),
                                 },
@@ -1237,7 +1338,9 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             # scheduled fault fires at exactly its step
             state = _pump_faults(real_step, state)
             if cfg.profile_dir and real_step == profile_start:
-                jax.profiler.start_trace(cfg.profile_dir)
+                # same exclusive-profiler contract as the fused path: a
+                # live /debug/profile capture must not crash this
+                _profiler_start(cfg.profile_dir)
                 profiling = True
             with trace_span("data"):
                 tokens, mask = next(batches)
@@ -1305,7 +1408,10 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                             cfg.num_workers - eff.sum()
                         )
                     with trace_span("sync"), sync_timer:
-                        state = dl.outer_step(state, round_ok)
+                        if dynamics_on:
+                            state, step_dyn = dl.outer_step(state, round_ok)
+                        else:
+                            state, step_dyn = dl.outer_step(state, round_ok), None
                         round_ok = None
                         jax.block_until_ready(state.params)
                     state = dl._offload(state)
@@ -1313,8 +1419,10 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         _guarded_save(real_step, state)
 
             if profiling and real_step >= profile_stop:
-                jax.profiler.stop_trace()
-                profiling = False
+                try:
+                    _profiler_stop()
+                finally:
+                    profiling = False
 
             eval_metrics = {}
             eval_due = (
@@ -1375,6 +1483,14 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 sync_extras = {
                     **wire_metrics, "wire_bytes_total": wire_bytes_total,
                 }
+                if not streaming and dynamics_on and step_dyn is not None:
+                    # host conversion OUTSIDE the sync timer (readout
+                    # cost is logging work, not comm)
+                    dyn_metrics = _host_dynamics(step_dyn)
+                    sync_extras.update(dyn_metrics)
+                    watchdog.observe_drift(
+                        real_step, dyn_metrics["drift_max"]
+                    )
                 # per-round throughput for the collapse sentinel (the
                 # cumulative tps would dilute a mid-run collapse away)
                 now = time.perf_counter()
@@ -1413,7 +1529,10 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 _maybe_graceful_exit(real_step, state)
 
         if profiling:
-            jax.profiler.stop_trace()
+            try:
+                _profiler_stop()
+            finally:
+                profiling = False
         if fault_plan is not None:
             # a fault fired during the FINAL dispatch unit (e.g. a stall
             # in the last round's feed) has no later _pump_faults to
@@ -1457,6 +1576,15 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         # logger (a post-close alarm would write to a closed file),
         # restore the previous tracer, and export the Chrome trace —
         # after a crash it shows exactly which phase the run died in.
+        # an exception inside the stepwise profiled window would leave
+        # the process-global profiler lock held — every later capture
+        # 409s and a later profiled train() hangs; release it here
+        if profiling:
+            try:
+                _profiler_stop()
+            except Exception:
+                pass
+            profiling = False
         watchdog.stop(
             "finished" if completed else (
                 "preempted"

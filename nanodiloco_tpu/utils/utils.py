@@ -89,6 +89,21 @@ def force_virtual_cpu_devices(n: int, strict: bool = True) -> bool:
         # rejects) an already-initialized backend.
         jax.config.update("jax_num_cpu_devices", n)
         jax.config.update("jax_platforms", "cpu")
+    except AttributeError:
+        # pre-0.5 jax has no jax_num_cpu_devices: the XLA_FLAGS fallback
+        # (the same one conftest uses for the 8-device CPU mesh). Same
+        # before-backend-init contract; this path cannot DETECT a live
+        # backend, so the flag silently not taking effect surfaces as
+        # the mesh-size error downstream instead.
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = " ".join(
+            f for f in flags.split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        )
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}".strip()
+        )
+        jax.config.update("jax_platforms", "cpu")
     except RuntimeError:
         if strict:
             raise RuntimeError(

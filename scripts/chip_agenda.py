@@ -18,6 +18,9 @@ ceremony:
   4. a telemetry scrape: a short real run served over --metrics-port,
      /healthz + /metrics pulled over the wire and the gauges recorded —
      the production scrape path proven on the chip.
+  4b. a live-profile drill: POST /debug/profile to a RUNNING training
+     process's telemetry endpoint and assert the jax.profiler artifact
+     lands on disk — on-demand capture proven against a live job.
   5. a resilience drill: launch a live run, SIGTERM it mid-round, assert
      a clean preemption checkpoint + the preempt exit code (75), then
      let `supervise` resume it to completion from that checkpoint — the
@@ -334,8 +337,104 @@ def phase_telemetry() -> None:
                 "nanodiloco_tokens_per_sec", "nanodiloco_alarms_total",
                 "nanodiloco_outer_syncs_total", "nanodiloco_wire_bytes_total",
                 "nanodiloco_flops_per_token",
+                "nanodiloco_drift_max", "nanodiloco_outer_update_cos",
+                'nanodiloco_worker_pg_norm{worker="0"}',
             ) if k in scraped
         },
+    })
+
+
+def phase_live_profile() -> None:
+    """On-demand profiling against a LIVE training run on this backend:
+    launch the CLI with --metrics-port, POST /debug/profile?seconds=N
+    to it mid-run, and assert the returned jax.profiler artifact
+    actually exists on disk — the capture path an operator reaches for
+    when a job misbehaves, proven end to end (startup --profile-dir
+    cannot do this: it profiles a healthy launch, not the live process
+    you need to inspect)."""
+    import socket
+    import tempfile
+
+    from nanodiloco_tpu.serve.client import http_get, http_post_json
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    tmp = tempfile.mkdtemp(prefix="nanodiloco-live-profile-")
+    model_cfg = os.path.join(tmp, "model.json")
+    with open(model_cfg, "w") as f:
+        json.dump({
+            "vocab_size": 2048, "hidden_size": 128, "intermediate_size": 256,
+            "num_attention_heads": 4, "num_hidden_layers": 2,
+            "max_position_embeddings": 256,
+        }, f)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nanodiloco_tpu",
+         # long-lived on purpose: the capture must land on a RUNNING
+         # process (the finally SIGTERMs it once the evidence is in;
+         # a short run racing the POST drops the connection mid-capture)
+         "--total-steps", "4000", "--inner-steps", "2",
+         "--batch-size", "8", "--per-device-batch-size", "4",
+         "--seq-length", "256", "--warmup-steps", "2",
+         "--llama-config-file", model_cfg, "--no-measure-comm",
+         "--no-cost-analysis", "--quiet",
+         "--metrics-port", str(port), "--log-dir", tmp,
+         "--run-name", "live-profile-probe"],
+        cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    budget = float(
+        os.environ.get("NANODILOCO_AGENDA_TIMEOUT_LIVE_PROFILE", "900")
+    )
+    captured = None
+    try:
+        deadline = time.time() + budget - 120
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                if http_get(f"http://127.0.0.1:{port}/healthz",
+                            timeout=5)[0] != 200:
+                    time.sleep(0.3)
+                    continue
+                code, out = http_post_json(
+                    f"http://127.0.0.1:{port}/debug/profile?seconds=2",
+                    {}, timeout=120,
+                )
+            except OSError:  # server not up / racing teardown: retry
+                time.sleep(0.3)
+                continue
+            if code == 200:
+                captured = out
+                break
+            time.sleep(0.5)  # 409: startup --profile-dir window, retry
+    finally:
+        if proc.poll() is None:
+            import signal as _signal
+
+            proc.send_signal(_signal.SIGTERM)
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    if captured is None:
+        record({"phase": "live_profile",
+                "error": "run ended before a capture succeeded"})
+        raise SystemExit(1)
+    trace_dir = captured["trace_dir"]
+    artifacts = [
+        os.path.join(dp, fn)
+        for dp, _dn, fns in os.walk(trace_dir) for fn in fns
+    ]
+    if not artifacts:
+        record({"phase": "live_profile",
+                "error": f"capture returned {trace_dir} but no artifact "
+                         "files exist under it"})
+        raise SystemExit(1)
+    record({
+        "phase": "live_profile",
+        "trace_dir": trace_dir,
+        "seconds": captured["seconds"],
+        "artifact_files": len(artifacts),
+        "artifact_bytes": sum(os.path.getsize(a) for a in artifacts),
     })
 
 
@@ -572,6 +671,7 @@ PHASES = {
     "pallas": phase_pallas,
     "profile": phase_profile,
     "telemetry": phase_telemetry,
+    "live_profile": phase_live_profile,
     "resilience": phase_resilience,
     "serve": phase_serve,
 }
@@ -611,6 +711,7 @@ PHASE_TIMEOUT_S = {
     "pallas": 2700,
     "profile": 1200,
     "telemetry": 900,
+    "live_profile": 900,
     "resilience": 1200,
     "serve": 900,
 }

@@ -57,9 +57,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _pct(sorted_vals: list[float], p: float) -> float | None:
-    if not sorted_vals:
-        return None
-    return sorted_vals[min(len(sorted_vals) - 1, int(p * len(sorted_vals)))]
+    """Nearest-rank percentile — the ONE shared implementation the
+    serve scheduler's gauges also use."""
+    from nanodiloco_tpu.obs.telemetry import nearest_rank_percentile
+
+    return nearest_rank_percentile(sorted_vals, p)
 
 
 def main() -> None:

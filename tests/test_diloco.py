@@ -595,7 +595,7 @@ def test_outer_step_effective_mask_counts_param_blowup():
     )
     # caller's loss-based mask is all-healthy; the replica check must
     # still quarantine worker 2
-    new, eff = dl._outer_step(state, jnp.ones(4, bool))
+    new, eff, _dyn = dl._outer_step(state, jnp.ones(4, bool))
     np.testing.assert_array_equal(np.asarray(eff), [True, True, False, True])
     assert np.isfinite(np.asarray(new.snapshot["w"])).all()
 

@@ -311,6 +311,79 @@ def test_summarize_run_surfaces_obs_keys(tmp_path):
     assert s["t_sync_mean_s"] == pytest.approx(0.2)
 
 
+def test_summarize_tolerates_unknown_keys_and_surfaces_drift(tmp_path):
+    """The JSONL schema grows (the dynamics records added list- and
+    string-valued keys); summarize_run and the compare gate must digest
+    records carrying ARBITRARY unknown keys — lists, dicts, strings —
+    and surface the drift summary keys when present."""
+    from nanodiloco_tpu.cli import report_main
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    path = str(tmp_path / "r.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "loss": 3.0, "step": 1, "tokens_per_sec": 10.0,
+            "future_list_key": [1, 2, 3],
+            "future_dict_key": {"nested": True},
+            "future_str_key": "prose",
+        }) + "\n")
+        f.write(json.dumps({
+            "loss": 2.5, "step": 2, "tokens_per_sec": 11.0,
+            "outer_synced": 1,
+            "pg_norm": [0.5, 0.6], "drift_max": 0.02, "drift_mean": 0.015,
+            "outer_momentum_norm": 1.1, "outer_update_cos": 0.97,
+        }) + "\n")
+    s = summarize_run(path)
+    assert s["final_loss"] == 2.5
+    assert s["drift_max_last"] == 0.02
+    assert s["drift_max_peak"] == 0.02
+    assert s["outer_update_cos_last"] == 0.97
+    # the gate digests the same file (unknown keys never break compare)
+    report_main(["compare", path, path])
+
+
+def test_report_drift_timeline(tmp_path, capsys):
+    from nanodiloco_tpu.cli import report_main
+
+    path = str(tmp_path / "r.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"loss": 3.0, "step": 1}) + "\n")
+        f.write(json.dumps({
+            "loss": 2.5, "step": 2, "outer_synced": 1,
+            "pg_norm": [0.5, 0.6], "drift_max": 0.02, "drift_mean": 0.015,
+            "outer_momentum_norm": 1.1, "outer_update_cos": 0.97,
+        }) + "\n")
+        f.write(json.dumps({"alarm": "divergence", "step": 4,
+                            "drift": 0.9, "threshold": 0.5}) + "\n")
+        f.write(json.dumps({
+            "loss": 2.4, "step": 4, "outer_synced": 1,
+            "pg_norm": [0.7, 0.8], "drift_max": 0.9, "drift_mean": 0.4,
+            "outer_momentum_norm": 1.2, "outer_update_cos": -0.2,
+            "quarantined_workers": 1,
+        }) + "\n")
+        # keys PRESENT but null (older writer, torn record) — step
+        # included: the human renderer must print "?", not TypeError
+        f.write(json.dumps({
+            "step": None, "outer_synced": 1, "drift_max": 0.03,
+            "drift_mean": None, "pg_norm": [0.5, None],
+        }) + "\n")
+    report_main(["drift", path, "--json"])
+    events = json.loads(capsys.readouterr().out)
+    assert [e["event"] for e in events] == ["sync", "alarm", "sync", "sync"]
+    assert events[0]["drift_max"] == 0.02
+    assert events[2]["quarantined_workers"] == 1
+    report_main(["drift", path])  # human form renders without tracebacks
+    out = capsys.readouterr().out
+    assert "drift_max=0.02" in out and "ALARM divergence" in out
+    assert "drift_mean=?" in out  # null sibling key renders, not crashes
+    # a dynamics-free run reports that, not an empty screen
+    bare = str(tmp_path / "bare.jsonl")
+    with open(bare, "w") as f:
+        f.write(json.dumps({"loss": 3.0, "step": 1}) + "\n")
+    report_main(["drift", bare])
+    assert "no dynamics records" in capsys.readouterr().out
+
+
 # -- allreduce wire audit (exact-shape classification) -----------------------
 
 
@@ -541,6 +614,102 @@ def test_merge_chrome_traces_aligns_and_separates_pids():
     dup = merge_chrome_traces([s0, _shard(0, wall0=101.0,
                                           spans=[("sync", 0.0, 0.1)])])
     assert len({e["pid"] for e in dup["traceEvents"]}) == 2
+
+
+def test_merge_mixed_train_and_serve_shards():
+    """A serve-side trace (process_index 0, distinct process name,
+    retroactive record_span events) merged with a 2-host training trace:
+    every shard gets its own pid lane, the serve shard's process-name
+    metadata survives verbatim, span args (request ids) are preserved,
+    and no two shards overlay (the serve shard's pid-0 claim collides
+    with train rank 0 and must fall back to an ordinal pid)."""
+    from nanodiloco_tpu.obs.tracer import merge_chrome_traces
+
+    t0 = _shard(0, wall0=100.0, spans=[("inner", 0.0, 1.0), ("sync", 1.0, 0.2)])
+    t1 = _shard(1, wall0=100.5, spans=[("inner", 0.0, 1.0), ("sync", 1.1, 0.2)])
+    clk = FakeClock()
+    serve = SpanTracer(clock=clk, process_index=0,
+                       process_name="nanodiloco serve")
+    serve.record_span("queued", 0.0, 0.3, request_id="req-0")
+    serve.record_span("prefill", 0.3, 0.5, request_id="req-0", slot=1)
+    serve.record_span("decode", 0.5, 2.0, request_id="req-0", tokens=8)
+    sdoc = serve.to_chrome()
+    sdoc["otherData"]["wall_start_unix"] = 101.0
+
+    merged = merge_chrome_traces([t0, t1, sdoc])
+    xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 7  # 2+2 train spans, 3 serve spans — none dropped
+    pids = {e["pid"] for e in xs}
+    assert len(pids) == 3  # serve's rank-0 collision fell back, no overlay
+    names = {
+        e["pid"]: e["args"]["name"]
+        for e in merged["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert set(names) == pids
+    assert "nanodiloco serve" in names.values()  # metadata preserved
+    decode = next(e for e in xs if e["name"] == "decode")
+    assert decode["args"] == {"request_id": "req-0", "tokens": 8}
+    assert decode["dur"] == pytest.approx(1.5e6)
+    # the serve shard re-anchored onto the earliest wall clock: its
+    # queued span (local t=0 at wall 101.0) sits 1 s after train t0's
+    # local t=0 (wall 100.0)
+    queued = next(e for e in xs if e["name"] == "queued")
+    assert queued["ts"] == pytest.approx(1.0e6)
+
+
+def test_record_span_feeds_phase_totals():
+    clk = FakeClock()
+    tr = SpanTracer(clock=clk)
+    tr.record_span("decode", 1.0, 3.5, request_id="r1")
+    tr.record_span("decode", 4.0, 4.5, request_id="r2")
+    totals = tr.phase_totals()
+    assert totals["decode"] == pytest.approx(3.0)
+    # negative intervals clamp to zero rather than corrupting the trace
+    tr.record_span("weird", 5.0, 4.0)
+    assert tr.phase_totals()["weird"] == 0.0
+
+
+def test_profiler_window_released_when_start_trace_fails(monkeypatch, tmp_path):
+    """The startup-profile helper must not leak the process-global
+    profiler lock when jax's start_trace raises — a leaked lock turns
+    every later /debug/profile into a 409 and a later profiled train()
+    into a silent hang on acquire."""
+    from nanodiloco_tpu.obs import telemetry as tmod
+    from nanodiloco_tpu.training import train_loop as tl
+
+    def boom(_dir):
+        raise RuntimeError("profiler broken")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    with pytest.raises(RuntimeError, match="profiler broken"):
+        tl._profiler_start(str(tmp_path))
+    assert not tmod._PROFILE_LOCK.locked()
+
+
+def test_watchdog_divergence_sentinel():
+    """The drift alarm: fires past the threshold (or on non-finite
+    drift), once per episode, re-arming on a healthy observation —
+    and stays silent when disabled."""
+    from nanodiloco_tpu.obs.watchdog import Watchdog, WatchdogConfig
+
+    recs = []
+    wd = Watchdog(WatchdogConfig(drift_threshold=0.5), emit=recs.append)
+    wd.observe_drift(2, 0.1)
+    assert recs == []
+    wd.observe_drift(4, 0.6)
+    assert len(recs) == 1
+    assert recs[0]["alarm"] == "divergence" and recs[0]["step"] == 4
+    assert recs[0]["drift"] == 0.6 and recs[0]["threshold"] == 0.5
+    wd.observe_drift(6, 0.7)  # same episode: no second alarm
+    assert len(recs) == 1
+    wd.observe_drift(8, 0.2)   # healthy: re-arms
+    wd.observe_drift(10, float("nan"))  # a blown-up replica is alarming
+    assert len(recs) == 2 and recs[1]["drift"] == "nan"
+
+    off = Watchdog(WatchdogConfig(drift_threshold=0.0), emit=recs.append)
+    off.observe_drift(2, 1e9)
+    assert len(recs) == 2
 
 
 def test_report_merge_trace_cli(tmp_path, capsys):

@@ -166,7 +166,10 @@ def test_generate_endpoint_over_real_socket(params):
         results: dict[int, tuple] = {}
 
         def client(i, seed):
-            results[i] = _post(srv.port, {**doc, "seed": seed})
+            # client 0 supplies its own correlation id; the others get
+            # scheduler-assigned ones
+            extra = {"request_id": "client-0-xyz"} if i == 0 else {}
+            results[i] = _post(srv.port, {**doc, **extra, "seed": seed})
 
         threads = [threading.Thread(target=client, args=(i, s))
                    for i, s in enumerate((7, 7, 21))]
@@ -183,6 +186,13 @@ def test_generate_endpoint_over_real_socket(params):
         # same seed -> same stream, different seed -> (here) different
         assert results[0][1]["token_ids"] == results[1][1]["token_ids"]
         assert results[0][1]["token_ids"] != results[2][1]["token_ids"]
+        # request ids: the client-supplied one is echoed verbatim; the
+        # others carry distinct scheduler-assigned ids — the join key
+        # across the response, serve spans, and histograms
+        assert results[0][1]["request_id"] == "client-0-xyz"
+        auto_ids = {results[i][1]["request_id"] for i in (1, 2)}
+        assert len(auto_ids) == 2
+        assert all(rid.startswith("req-") for rid in auto_ids)
 
         code, body = _get(srv.port, "/metrics")
         assert code == 200
@@ -194,6 +204,24 @@ def test_generate_endpoint_over_real_socket(params):
         assert m["nanodiloco_serve_decode_tokens_per_sec"] > 0
         assert m["nanodiloco_serve_tokens_total"] >= 18
         assert body.rstrip().endswith("# EOF")
+        # the TTFT histogram: 3 served requests, cumulative buckets
+        # monotone and capped by the +Inf bucket == _count
+        assert m["nanodiloco_serve_ttft_histogram_seconds_count"] == 3
+        assert m["nanodiloco_serve_ttft_histogram_seconds_sum"] > 0
+        bucket_lines = [
+            (k, v) for k, v in m.items()
+            if k.startswith("nanodiloco_serve_ttft_histogram_seconds_bucket")
+        ]
+        assert bucket_lines, body
+        cums = [v for _, v in sorted(
+            bucket_lines,
+            key=lambda kv: float("inf") if '+Inf' in kv[0]
+            else float(kv[0].split('le="')[1].rstrip('"}')),
+        )]
+        assert cums == sorted(cums) and cums[-1] == 3
+        assert m['nanodiloco_serve_ttft_histogram_seconds_bucket{le="+Inf"}'] == 3
+        assert m["nanodiloco_serve_queue_wait_seconds_count"] == 3
+        assert m["nanodiloco_serve_decode_tick_seconds_count"] > 0
 
         code, body = _get(srv.port, "/healthz")
         assert code == 200
@@ -221,6 +249,9 @@ def test_server_rejects_bad_requests_with_400(params):
             {"token_ids": [1], "top_p": 0.0},
             {"token_ids": [1] * 15, "max_new_tokens": 10},  # > max_len
             {"token_ids": [CFG.vocab_size + 1]},           # out of vocab
+            {"token_ids": [1], "request_id": ""},          # empty id
+            {"token_ids": [1], "request_id": 7},           # non-string id
+            {"token_ids": [1], "request_id": "x" * 200},   # oversized id
         ):
             code, out = _post(srv.port, bad)
             assert code == 400, (bad, out)

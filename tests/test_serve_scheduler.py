@@ -265,6 +265,70 @@ def test_prefill_error_fails_one_request_not_the_loop():
     assert sched.stats()["errors"] == 1
 
 
+def test_ttft_percentiles_use_nearest_rank():
+    """Pin the nearest-rank percentile (smallest value with at least
+    ceil(p*n) observations at or below it): the old ``int(p*len)``
+    index read p50 of two samples as the LARGER one and p95 of twenty
+    as the max."""
+    sched, _, _ = _sched(scripts={})
+    sched._ttft.extend([1.0, 2.0])
+    s = sched.stats()
+    assert s["ttft_p50_s"] == 1.0          # was 2.0 under int(p*n)
+    sched._ttft.clear()
+    sched._ttft.extend([float(i) for i in range(1, 21)])  # 1..20
+    s = sched.stats()
+    assert s["ttft_p50_s"] == 10.0         # ceil(.5*20)=10 -> 10th value
+    assert s["ttft_p95_s"] == 19.0         # ceil(.95*20)=19 -> 19th, not max
+    sched._ttft.clear()
+    sched._ttft.extend([3.0])
+    s = sched.stats()
+    assert s["ttft_p50_s"] == 3.0 and s["ttft_p95_s"] == 3.0
+
+
+def test_request_spans_and_histograms():
+    """Per-request observability: queued/prefill/decode spans land on
+    the injected tracer with the request's correlation id, and the
+    TTFT / queue-wait / per-tick-decode histograms fill with correct
+    cumulative buckets."""
+    from nanodiloco_tpu.obs import SpanTracer
+
+    clock = FakeClock()
+    tracer = SpanTracer(clock=clock)  # SAME clock as the scheduler
+    backend = FakeBackend(1, {1: [10, 11, 12], 2: [20, 21]})
+    sched = Scheduler(backend, max_queue=4, clock=clock, tracer=tracer)
+    t1 = sched.submit(GenRequest(prompt=(5, 6), max_new_tokens=3, seed=1,
+                                 request_id="client-abc"))
+    t2 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=2))
+    for _ in range(6):
+        clock.advance(0.25)
+        sched.tick()
+    assert t1.done() and t2.done()
+    # the client-supplied id is echoed; the scheduler derives one
+    # (from its rid) when the client sent none
+    assert t1.result["request_id"] == "client-abc"
+    assert t2.result["request_id"] == f"req-{t2.rid}"
+    by_name: dict[str, list] = {}
+    for e in tracer.events:
+        by_name.setdefault(e["name"], []).append(e)
+    assert set(by_name) == {"queued", "prefill", "decode"}
+    assert len(by_name["queued"]) == 2 and len(by_name["prefill"]) == 2
+    span_ids = {e["args"]["request_id"] for e in by_name["decode"]}
+    assert span_ids == {"client-abc", f"req-{t2.rid}"}
+    assert by_name["prefill"][0]["args"]["prompt_tokens"] == 2
+    # histograms: 2 admissions, every decode tick observed
+    s = sched.stats()
+    assert s["hist_ttft"]["count"] == 2
+    assert s["hist_queue_wait"]["count"] == 2
+    ticks = len([e for e in backend.log if e[0] == "step"])
+    assert s["hist_decode_tick"]["count"] == ticks
+    # cumulative-bucket invariants: monotone, +Inf bucket == count
+    buckets = s["hist_ttft"]["buckets"]
+    cums = [c for _, c in buckets]
+    assert cums == sorted(cums)
+    assert buckets[-1] == ("+Inf", 2)
+    assert s["hist_ttft"]["sum"] > 0
+
+
 def test_stats_timing_uses_injected_clock():
     class SteppingClock(FakeClock):
         def __call__(self) -> float:

@@ -95,6 +95,33 @@ def test_smoke_gate_under_noop_fault_plan(tmp_path):
     report_main(["compare", BASELINE, hooked, "--max-tps-drop", "0.95"])
 
 
+def test_smoke_gate_dynamics_metrics_side_effect_free(tmp_path):
+    """THE dynamics-metrics no-side-effects proof: the same smoke with
+    the on-device dynamics readout disabled is STEP-FOR-STEP IDENTICAL
+    in losses to the default (dynamics on) run, and the on-run's sync
+    records carry non-zero drift / per-worker pseudo-gradient norms —
+    free observability, asserted, not assumed. The off-run also rides
+    the committed-baseline gate (whose baseline was recorded with
+    dynamics ON), pinning that the flag cannot move the trajectory."""
+    from nanodiloco_tpu.cli import report_main
+
+    on = _run_smoke(str(tmp_path / "on"))  # dynamics_metrics defaults True
+    off = _run_smoke(str(tmp_path / "off"), dynamics_metrics=False)
+    on_recs = [json.loads(l) for l in open(on)]
+    off_losses = [json.loads(l).get("loss") for l in open(off)]
+    assert [r.get("loss") for r in on_recs] == off_losses
+    syncs = [r for r in on_recs if r.get("drift_max") is not None]
+    assert len(syncs) == 2  # one dynamics record per outer sync
+    for r in syncs:
+        assert r["drift_max"] > 0 and r["drift_mean"] > 0
+        assert len(r["pg_norm"]) == 2 and all(n > 0 for n in r["pg_norm"])
+        assert r["outer_momentum_norm"] > 0
+        assert -1.0 <= r["outer_update_cos"] <= 1.0
+    off_recs = [json.loads(l) for l in open(off)]
+    assert not any(r.get("drift_max") is not None for r in off_recs)
+    report_main(["compare", BASELINE, off, "--max-tps-drop", "0.95"])
+
+
 def test_smoke_gate_actually_fires(tmp_path):
     """The gate must be able to fail: the same fresh smoke against a
     baseline whose loss is unreachably low exits non-zero (a gate that

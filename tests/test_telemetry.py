@@ -134,6 +134,174 @@ def test_healthz_503_on_stall_and_200_without_health_fn():
         bare.stop()
 
 
+# -- OpenMetrics compliance: metadata, escaping, histograms ------------------
+
+
+def test_render_exposition_metadata_and_escaping():
+    """Every family carries # HELP and # TYPE; label values escape the
+    three characters the text format cannot carry raw (backslash,
+    double-quote, line feed) — one test case per escape."""
+    from nanodiloco_tpu.obs.telemetry import render_exposition
+
+    text = render_exposition([
+        ("m_gauge", "gauge", "a gauge", [(None, 1.5)]),
+        ("m_counter", "counter", "a counter",
+         [({"kind": "x"}, 2), (None, 2)]),
+        ("m_backslash", "gauge", "h",
+         [({"v": "a\\b"}, 1)]),
+        ("m_quote", "gauge", "h", [({"v": 'say "hi"'}, 1)]),
+        ("m_newline", "gauge", "h", [({"v": "two\nlines"}, 1)]),
+        ("m_help_escape", "gauge", "help with \\ and\nnewline",
+         [(None, 0)]),
+    ])
+    lines = text.splitlines()
+    for fam in ("m_gauge", "m_counter", "m_backslash", "m_quote",
+                "m_newline", "m_help_escape"):
+        assert any(l.startswith(f"# HELP {fam} ") for l in lines), fam
+        assert any(l.startswith(f"# TYPE {fam} ") for l in lines), fam
+    assert 'm_counter_total{kind="x"} 2' in lines
+    assert "m_counter_total 2" in lines
+    assert 'm_backslash{v="a\\\\b"} 1' in lines
+    assert 'm_quote{v="say \\"hi\\""} 1' in lines
+    assert 'm_newline{v="two\\nlines"} 1' in lines
+    assert "# HELP m_help_escape help with \\\\ and\\nnewline" in lines
+    assert lines[-1] == "# EOF"
+
+
+def test_histogram_cumulative_buckets_and_render():
+    from nanodiloco_tpu.obs.telemetry import Histogram, render_exposition
+
+    h = Histogram(buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["buckets"] == [(0.1, 1), (1.0, 3), (10.0, 4), ("+Inf", 5)]
+    assert snap["count"] == 5
+    assert snap["sum"] == pytest.approx(56.05)
+    # boundary: an observation exactly ON a bound counts in that bucket
+    # (le semantics: <=)
+    hb = Histogram(buckets=(1.0,))
+    hb.observe(1.0)
+    assert hb.snapshot()["buckets"] == [(1.0, 1), ("+Inf", 1)]
+    text = render_exposition([("lat_seconds", "histogram", "latency", snap)])
+    lines = text.splitlines()
+    assert 'lat_seconds_bucket{le="0.1"} 1' in lines
+    assert 'lat_seconds_bucket{le="1"} 3' in lines
+    assert 'lat_seconds_bucket{le="10"} 4' in lines
+    assert 'lat_seconds_bucket{le="+Inf"} 5' in lines
+    assert "lat_seconds_count 5" in lines
+    assert any(l.startswith("lat_seconds_sum 56.") for l in lines)
+    assert "# HELP lat_seconds latency" in lines
+    assert "# TYPE lat_seconds histogram" in lines
+
+
+def test_histogram_rejects_bad_buckets():
+    from nanodiloco_tpu.obs.telemetry import Histogram
+
+    with pytest.raises(ValueError):
+        Histogram(buckets=())
+    with pytest.raises(ValueError):
+        Histogram(buckets=(1.0, 1.0))
+
+
+def test_dynamics_records_become_drift_gauges():
+    """The sync record's dynamics keys flow through observe() into the
+    nanodiloco_drift_* gauges and per-worker pg-norm gauges the
+    acceptance scrape asserts."""
+    srv = TelemetryServer(port=0).start()
+    try:
+        srv.observe({
+            "pg_norm": [0.25, 0.75], "drift_max": 0.01, "drift_mean": 0.008,
+            "outer_momentum_norm": 1.5, "outer_update_cos": 0.93, "step": 4,
+        })
+        m = parse_metrics_text(_get(srv.port, "/metrics")[1])
+        assert m["nanodiloco_drift_max"] == 0.01
+        assert m["nanodiloco_drift_mean"] == 0.008
+        assert m["nanodiloco_outer_momentum_norm"] == 1.5
+        assert m["nanodiloco_outer_update_cos"] == 0.93
+        assert m['nanodiloco_worker_pg_norm{worker="0"}'] == 0.25
+        assert m['nanodiloco_worker_pg_norm{worker="1"}'] == 0.75
+    finally:
+        srv.stop()
+
+
+# -- on-demand live profiling (/debug/profile) --------------------------------
+
+
+def _post(port: int, path: str, timeout: float = 60.0):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=b"", method="POST"
+    )
+
+    def parse(body):
+        try:
+            return json.loads(body)
+        except ValueError:
+            return {"raw": body}
+
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, parse(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, parse(e.read().decode())
+
+
+def test_debug_profile_captures_live_trace(tmp_path):
+    """POST /debug/profile on the telemetry server captures a real
+    jax.profiler artifact from THIS process into the configured dir;
+    bad durations 400, unconfigured server 404."""
+    import jax
+    import jax.numpy as jnp
+
+    srv = TelemetryServer(port=0, profile_dir=str(tmp_path / "prof")).start()
+    try:
+        # give the profiler something to see
+        jnp.dot(jnp.ones((8, 8)), jnp.ones((8, 8))).block_until_ready()
+        code, out = _post(srv.port, "/debug/profile?seconds=0.2")
+        assert code == 200, out
+        assert out["seconds"] == 0.2
+        trace_dir = out["trace_dir"]
+        assert os.path.isdir(trace_dir)
+        artifacts = [
+            os.path.join(dp, fn)
+            for dp, _dn, fns in os.walk(trace_dir) for fn in fns
+        ]
+        assert artifacts, f"no profiler artifacts under {trace_dir}"
+        # a second capture lands in a FRESH subdirectory
+        code2, out2 = _post(srv.port, "/debug/profile?seconds=0.1")
+        assert code2 == 200 and out2["trace_dir"] != trace_dir
+
+        assert _post(srv.port, "/debug/profile?seconds=0")[0] == 400
+        assert _post(srv.port, "/debug/profile?seconds=9999")[0] == 400
+        assert _post(srv.port, "/debug/profile?seconds=nope")[0] == 400
+        assert _post(srv.port, "/nope")[0] == 404
+    finally:
+        srv.stop()
+
+
+def test_debug_profile_404_without_dir_and_409_when_busy(tmp_path):
+    from nanodiloco_tpu.obs import telemetry as tmod
+
+    bare = TelemetryServer(port=0).start()
+    try:
+        assert _post(bare.port, "/debug/profile?seconds=0.1")[0] == 404
+    finally:
+        bare.stop()
+    srv = TelemetryServer(port=0, profile_dir=str(tmp_path)).start()
+    try:
+        assert tmod._PROFILE_LOCK.acquire(blocking=False)
+        try:
+            code, out = _post(srv.port, "/debug/profile?seconds=0.1")
+            assert code == 409
+            assert "in progress" in out["error"]
+        finally:
+            tmod._PROFILE_LOCK.release()
+    finally:
+        srv.stop()
+
+
 # -- integration: scrape a LIVE training run ---------------------------------
 
 TINY_MODEL_JSON = {
@@ -172,6 +340,9 @@ def test_live_run_scrape_matches_jsonl(tmp_path):
          "--seq-length", "32", "--warmup-steps", "2",
          "--llama-config-file", model_cfg,
          "--no-measure-comm", "--quiet",
+         # 2 workers on 2 virtual CPU devices: the dynamics gauges the
+         # acceptance scrape asserts (drift needs W > 1)
+         "--num-workers", "2", "--force-cpu-devices", "2",
          "--metrics-port", str(port),
          "--log-dir", str(tmp_path / "runs"),
          "--run-name", "telem"],
@@ -192,7 +363,10 @@ def test_live_run_scrape_matches_jsonl(tmp_path):
                 continue
             assert code == 200
             m = parse_metrics_text(body)
-            if "nanodiloco_loss" in m:
+            # wait for a sync record's burst to complete: the loss
+            # gauge appears with the round's first step record, the
+            # dynamics gauges with its sync record
+            if "nanodiloco_loss" in m and "nanodiloco_drift_max" in m:
                 scraped = m
                 break
             time.sleep(0.01)
@@ -217,3 +391,17 @@ def test_live_run_scrape_matches_jsonl(tmp_path):
     assert 1 <= scraped["nanodiloco_outer_syncs_total"] <= 30
     # the cost record reached the gauges too (capture happens pre-round-1)
     assert scraped["nanodiloco_flops_per_token"] > 0
+    # THE acceptance scrape: the DiLoCo dynamics gauges are live and
+    # non-zero over HTTP — drift between the 2 workers, per-worker
+    # pseudo-gradient norms, momentum, update cosine — and every value
+    # appears in the JSONL the same logger wrote
+    assert scraped["nanodiloco_drift_max"] > 0
+    assert scraped["nanodiloco_drift_mean"] > 0
+    assert scraped['nanodiloco_worker_pg_norm{worker="0"}'] > 0
+    assert scraped['nanodiloco_worker_pg_norm{worker="1"}'] > 0
+    assert scraped["nanodiloco_outer_momentum_norm"] > 0
+    drift_logged = {r["drift_max"] for r in recs
+                    if r.get("drift_max") is not None}
+    assert scraped["nanodiloco_drift_max"] in drift_logged
+    pg0_logged = {r["pg_norm"][0] for r in recs if r.get("pg_norm")}
+    assert scraped['nanodiloco_worker_pg_norm{worker="0"}'] in pg0_logged
