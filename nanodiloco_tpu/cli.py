@@ -471,6 +471,25 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-queue", type=int, default=64,
                    help="admission queue depth; a full queue answers 429 "
                         "(backpressure)")
+    p.add_argument("--chunk-size", type=int, default=64,
+                   help="prefill chunk length: long prompts prefill in "
+                        "chunks of at most this many tokens, one chunk "
+                        "interleaved per decode tick, so a long prompt "
+                        "never stalls live streams; chunk lengths are "
+                        "bucketed to powers of two, bounding the compile "
+                        "count")
+    p.add_argument("--prefix-cache-tokens", type=int, default=4096,
+                   help="shared-prefix KV cache capacity in tokens (a "
+                        "common system prompt prefills once and is "
+                        "reused); 0 disables")
+    p.add_argument("--starvation-s", type=float, default=30.0,
+                   help="starvation bound for priority admission: a "
+                        "queued request older than this is admitted next "
+                        "regardless of class; 0 = pure priority/EDF")
+    p.add_argument("--stats-jsonl", type=str, default=None, metavar="JSONL",
+                   help="append one final scheduler-stats record (TTFT, "
+                        "queue, prefix-cache counters) to this JSONL at "
+                        "shutdown — readable by `report` / summarize_run")
     p.add_argument("--max-new-tokens", type=int, default=64,
                    help="default completion length for requests that omit "
                         "max_new_tokens")
@@ -519,6 +538,8 @@ def serve_main(argv: list[str]) -> None:
     max_len = min(args.max_len, model_cfg.max_position_embeddings)
     engine = InferenceEngine(
         params, model_cfg, num_slots=args.slots, max_len=max_len,
+        chunk_size=args.chunk_size,
+        prefix_cache_tokens=args.prefix_cache_tokens,
     )
     tracer = None
     if args.trace_out:
@@ -529,7 +550,10 @@ def serve_main(argv: list[str]) -> None:
         # timebase; a distinct process name keeps the serve lane
         # labeled when merged with training shards
         tracer = SpanTracer(clock=time.monotonic, process_name="nanodiloco serve")
-    scheduler = Scheduler(engine, max_queue=args.max_queue, tracer=tracer)
+    scheduler = Scheduler(
+        engine, max_queue=args.max_queue, tracer=tracer,
+        starvation_s=args.starvation_s if args.starvation_s > 0 else None,
+    )
     server = ServeServer(
         scheduler, tokenizer,
         port=args.port, host=args.host,
@@ -555,12 +579,37 @@ def serve_main(argv: list[str]) -> None:
             time.sleep(0.2)
     finally:
         server.stop()
+        if args.stats_jsonl:
+            try:
+                _append_serve_stats(args.stats_jsonl, scheduler)
+                print(f"serve stats -> {args.stats_jsonl}", flush=True)
+            except OSError:
+                pass  # a full disk must not mask the shutdown
         if tracer is not None:
             try:
                 tracer.export_chrome(args.trace_out)
                 print(f"serve span trace -> {args.trace_out}", flush=True)
             except OSError:
                 pass  # a full disk must not mask the shutdown
+
+
+def _append_serve_stats(path: str, scheduler) -> None:
+    """One flat ``serve_stats`` JSONL record from the scheduler's final
+    snapshot — the keys ``summarize_run`` surfaces (prefix-cache
+    hit/miss, TTFT percentiles, chunk counters), so a serve session
+    reads with the same `report` tooling as a training run. Histogram
+    snapshots are dropped: the JSONL carries scalars, /metrics carries
+    distributions."""
+    import os as _os
+
+    s = scheduler.stats()
+    rec = {
+        "serve_stats": True,
+        **{k: v for k, v in s.items() if not k.startswith("hist_")},
+    }
+    _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
 
 
 def _load_checkpoint_snapshot(checkpoint_dir: str, step: int | None):
@@ -719,6 +768,10 @@ def report_compare_main(argv: list[str]) -> None:
     p.add_argument("--max-comm-share-increase", type=float, default=0.05,
                    help="ABSOLUTE comm-share increase that counts as a "
                         "regression (default +0.05)")
+    p.add_argument("--max-latency-increase", type=float, default=0.5,
+                   help="relative serve-latency (TTFT percentile) increase "
+                        "that counts as a regression (default 50%% — "
+                        "closed-loop CPU latency is noisy)")
     p.add_argument("--json", action="store_true",
                    help="print the full diff as one JSON object")
     args = p.parse_args(argv)
@@ -731,6 +784,7 @@ def report_compare_main(argv: list[str]) -> None:
         max_loss_increase=args.max_loss_increase,
         max_tps_drop=args.max_tps_drop,
         max_comm_share_increase=args.max_comm_share_increase,
+        max_latency_increase=args.max_latency_increase,
     )
     if args.json:
         print(json.dumps(diff))

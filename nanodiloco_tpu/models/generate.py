@@ -72,6 +72,10 @@ def _cached_block(
     block: int = 0,           # 0 = dense scores over the full cache;
                               # >0 = online-softmax over cache blocks
                               # (S_alloc must be a multiple of block)
+    last_index=None,          # traced scalar: position within [0, T) whose
+                              # logits to return (None = the static last row;
+                              # chunked prefill's final chunk may carry
+                              # right-padding after its last real token)
 ):
     """Run the decoder over ``tokens``, reading/writing the KV cache at
     ``pos``. Returns (last-position logits [B, V] float32, updated
@@ -163,7 +167,14 @@ def _cached_block(
     x, (ck, cv) = jax.lax.scan(
         layer_body, x, (params["layers"], cache["k"], cache["v"])
     )
-    x = rms_norm(x[:, -1], params["final_norm"], cfg.rms_norm_eps)  # [B, d]
+    if last_index is None:
+        xl = x[:, -1]  # [B, d]
+    else:
+        # same gather the static slice performs, at a traced index —
+        # op-for-op identical math, so a chunked prefill whose last real
+        # token is not the chunk's last row stays on the generate() path
+        xl = jax.lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)[:, 0]
+    x = rms_norm(xl, params["final_norm"], cfg.rms_norm_eps)  # [B, d]
     head = params.get("lm_head", None)
     if head is None:
         head = params["embed"].T
@@ -393,18 +404,26 @@ def pad_prompts(prompts: list[list[int]], pad_id: int = 0):
 #
 # The continuous-batching engine owns ONE cache [L, B, S_max, Hkv, hd]
 # whose B rows are independent request slots at independent positions.
-# Two programs cover its whole life:
-#   - prefill_slot_fn: write one request's prompt K/V into its slot
-#     (the same ``_cached_block`` the one-shot ``generate`` prefill
-#     uses, so the two paths can never drift) and sample the first
-#     token; compiled once per (config, prompt_len, B, S_max).
+# The programs covering its whole life:
+#   - prefill_chunk_fn: write one CHUNK of a request's prompt K/V into
+#     its slot at a traced offset (the same ``_cached_block`` the
+#     one-shot ``generate`` prefill uses, so the two paths can never
+#     drift) and return the chunk's last-real-position logits. Chunk
+#     lengths are BUCKETED to powers of two up to the engine's chunk
+#     size, so the compile count is bounded by log2(chunk_size)+1 —
+#     NOT one executable per prompt length, the PR-4 recompile trap.
+#   - sample_token_fn: sample one token from [1, V] logits with the
+#     request's key/temperature/top_k/top_p (``_sample_slots`` — the
+#     per-row mirror of ``_sample``, op for op).
 #   - decode_slots_fn: advance ALL slots one token with PER-SLOT
 #     positions, PRNG keys, and sampling params; compiled once per
 #     (config, B, S_max) — admitting or retiring a request never
 #     recompiles anything.
-# Sampling params ride as traced arrays (``_sample_slots`` mirrors
-# ``_sample`` op for op) so a new request with new temperature/top_k/
-# top_p reuses the same executable.
+#   - extract_chunk_fn / insert_chunk_fn: copy one whole chunk of K/V
+#     rows out of / into a slot — the shared-prefix cache's device-side
+#     halves (one compile each; chunk shape is static).
+# Sampling params ride as traced arrays so a new request with new
+# temperature/top_k/top_p reuses the same executable.
 # ---------------------------------------------------------------------------
 
 
@@ -476,7 +495,12 @@ def _decode_slots_block(params, cfg: LlamaConfig, tokens, cache, pos,
     ki = jnp.arange(s_max)
     ok = (ki[None, None, :] <= pos[:, None, None]) & (key_valid[:, None, :] > 0)
     mask = jnp.where(ok, 0.0, MASK_VALUE)[:, None]        # [B, 1, T=1, S]
-    write = (ki[None, :] == pos[:, None])[:, :, None, None]  # [B, S, 1, 1]
+    # dead slots must not write: a slot mid-chunked-prefill shares the
+    # tick with decoding neighbours, and an unmasked write would stamp
+    # garbage K/V at its position 0 between two of its prefill chunks
+    write = (
+        (ki[None, :] == pos[:, None]) & (active[:, None] > 0)
+    )[:, :, None, None]                                    # [B, S, 1, 1]
     token_valid = active[:, None]                          # [B, 1]
 
     def layer_body(x, scanned):
@@ -518,31 +542,36 @@ def _serve_donate():
 
 
 @functools.lru_cache(maxsize=4)
-def prefill_slot_fn(cfg: LlamaConfig):
-    """Jitted ``(params, cache, prompt [1,P], prompt_valid [1,P], slot,
-    key, temperature, top_k, top_p) -> (first_token scalar, cache)``.
-    Writes the prompt's K/V into cache slot ``slot`` (traced — one
-    executable serves every slot) via the SAME ``_cached_block`` program
-    the one-shot ``generate`` prefill runs, then samples the first token
-    with ``_sample_slots``. Retraces only per prompt length."""
+def prefill_chunk_fn(cfg: LlamaConfig):
+    """Jitted ``(params, cache, chunk [1,C], chunk_valid [1,C], slot,
+    pos, last_idx) -> (logits [1,V] float32, cache)``: run ONE chunk of
+    a prompt through the decoder, writing its K/V into cache slot
+    ``slot`` (traced) at positions ``[pos, pos+C)`` (traced), attending
+    causally over everything already written. The SAME ``_cached_block``
+    program the one-shot ``generate`` prefill runs — the two paths can
+    never drift — with the write offset and the last-real-token index
+    traced so one executable per CHUNK LENGTH covers every slot, every
+    offset, and every amount of right-padding. ``chunk_valid`` zeroes
+    pad tokens out of MoE routing; pad K/V writes land beyond the
+    prompt and are causally unreachable until decode overwrites them.
+    Retraces only per chunk length — the engine buckets those to powers
+    of two, so mixed-length traffic compiles a bounded program set."""
 
-    def run(params, cache, prompt, prompt_valid, slot, key,
-            temperature, top_k, top_p):
+    def run(params, cache, chunk, chunk_valid, slot, pos, last_idx):
         l, _b, s_max, nkv, hd = cache["k"].shape
-        p = prompt.shape[1]
         ck = jax.lax.dynamic_slice(
             cache["k"], (0, slot, 0, 0, 0), (l, 1, s_max, nkv, hd)
         )
         cv = jax.lax.dynamic_slice(
             cache["v"], (0, slot, 0, 0, 0), (l, 1, s_max, nkv, hd)
         )
-        # positions >= P are future decode writes: valid, causally pruned
-        key_valid = jnp.concatenate(
-            [prompt_valid, jnp.ones((1, s_max - p), jnp.int32)], axis=1
-        )
+        # every cache position reads as valid: the serve path never
+        # left-pads (each request prefills its own slot from 0), and
+        # positions at/after the live prefix are causally pruned
+        key_valid = jnp.ones((1, s_max), jnp.int32)
         logits, sub = _cached_block(
-            params, cfg, prompt, {"k": ck, "v": cv}, jnp.int32(0),
-            key_valid, prompt_valid, block=0,
+            params, cfg, chunk, {"k": ck, "v": cv}, pos,
+            key_valid, chunk_valid, block=0, last_index=last_idx,
         )
         cache = {
             "k": jax.lax.dynamic_update_slice(
@@ -552,12 +581,66 @@ def prefill_slot_fn(cfg: LlamaConfig):
                 cache["v"], sub["v"], (0, slot, 0, 0, 0)
             ),
         }
-        tok0 = _sample_slots(
-            logits, key[None], temperature[None], top_k[None], top_p[None]
-        )[0]
-        return tok0, cache
+        return logits, cache
 
     return jax.jit(run, donate_argnums=_serve_donate())
+
+
+@functools.lru_cache(maxsize=4)
+def sample_token_fn(cfg: LlamaConfig):
+    """Jitted ``(logits [1,V], key, temperature, top_k, top_p) ->
+    first_token scalar``: the prefill-side sample, split out of the
+    chunk program so intermediate chunks never pay for it. Uses
+    ``_sample_slots`` — the same op sequence the decode tick (and,
+    mirrored, the one-shot ``generate``) samples with."""
+
+    def run(logits, key, temperature, top_k, top_p):
+        return _sample_slots(
+            logits, key[None], temperature[None], top_k[None], top_p[None]
+        )[0]
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=4)
+def extract_chunk_fn(cfg: LlamaConfig):
+    """Jitted ``(cache, slot, pos; size static) -> (k, v)`` with k/v
+    ``[L, size, Hkv, hd]``: copy one chunk of a slot's K/V rows out of
+    the pool — the prefix cache's insert path. One compile per chunk
+    size (the engine only extracts whole chunks)."""
+
+    def run(cache, slot, pos, size):
+        l, _b, _s, nkv, hd = cache["k"].shape
+        k = jax.lax.dynamic_slice(
+            cache["k"], (0, slot, pos, 0, 0), (l, 1, size, nkv, hd)
+        )[:, 0]
+        v = jax.lax.dynamic_slice(
+            cache["v"], (0, slot, pos, 0, 0), (l, 1, size, nkv, hd)
+        )[:, 0]
+        return k, v
+
+    return jax.jit(run, static_argnums=(3,))
+
+
+@functools.lru_cache(maxsize=4)
+def insert_chunk_fn(cfg: LlamaConfig):
+    """Jitted ``(cache, k [L,n,Hkv,hd], v, slot, pos) -> cache``: write
+    a cached prefix chunk's K/V rows into a slot — the prefix cache's
+    hit path. The rows were produced by the same chunk program over the
+    same tokens at the same positions, so a hit is bit-identical to
+    re-prefilling them."""
+
+    def run(cache, k, v, slot, pos):
+        return {
+            "k": jax.lax.dynamic_update_slice(
+                cache["k"], k[:, None], (0, slot, pos, 0, 0)
+            ),
+            "v": jax.lax.dynamic_update_slice(
+                cache["v"], v[:, None], (0, slot, pos, 0, 0)
+            ),
+        }
+
+    return jax.jit(run, donate_argnums=(0,) if jax.default_backend() != "cpu" else ())
 
 
 @functools.lru_cache(maxsize=4)

@@ -521,7 +521,11 @@ def render_exposition(families) -> str:
       Counters follow the spec's family-name / ``_total``-sample split.
     - histogram: ``samples`` is a ``Histogram.snapshot()`` dict —
       rendered as the cumulative ``_bucket{le=...}`` series plus
-      ``_count`` and ``_sum``.
+      ``_count`` and ``_sum`` — or a list of
+      ``(labels_or_None, snapshot)`` pairs for a labeled histogram
+      family (e.g. the serve queue-wait split by ``priority``); each
+      pair's labels ride on every ``_bucket``/``_count``/``_sum``
+      sample of its series, with ``le`` appended last.
 
     Every family gets ``# HELP`` and ``# TYPE`` metadata (HELP text
     escaped); ``# EOF`` terminates the exposition (a truncated scrape
@@ -533,12 +537,17 @@ def render_exposition(families) -> str:
         lines.append(f"# HELP {name} {_escape_help(help_text or name)}")
         lines.append(f"# TYPE {name} {mtype}")
         if mtype == "histogram":
-            snap = samples
-            for le, cum in snap["buckets"]:
-                le_s = le if isinstance(le, str) else _fmt(float(le))
-                lines.append(f'{name}_bucket{{le="{le_s}"}} {int(cum)}')
-            lines.append(f"{name}_count {int(snap['count'])}")
-            lines.append(f"{name}_sum {_fmt(float(snap['sum']))}")
+            series = [(None, samples)] if isinstance(samples, dict) else samples
+            for labels, snap in series:
+                base = _render_labels(labels) + "," if labels else ""
+                suffix = f"{{{_render_labels(labels)}}}" if labels else ""
+                for le, cum in snap["buckets"]:
+                    le_s = le if isinstance(le, str) else _fmt(float(le))
+                    lines.append(
+                        f'{name}_bucket{{{base}le="{le_s}"}} {int(cum)}'
+                    )
+                lines.append(f"{name}_count{suffix} {int(snap['count'])}")
+                lines.append(f"{name}_sum{suffix} {_fmt(float(snap['sum']))}")
             continue
         sample_name = name + "_total" if mtype == "counter" else name
         for labels, value in samples:

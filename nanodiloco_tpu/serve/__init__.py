@@ -2,17 +2,23 @@
 
 Layers (each usable alone):
 - ``engine.InferenceEngine`` — slot-based decode engine: B cache slots,
-  per-request prefill into a free slot, one compiled step advancing all
-  live slots per tick.
-- ``scheduler.Scheduler`` — FIFO admission queue with backpressure,
-  slot allocation, deadlines; deterministic and model-free (any object
-  with the engine's prefill/step/release surface works).
+  chunked per-request prefill into a free slot (bucketed chunk
+  programs, bounded compile count), shared-prefix KV reuse, one
+  compiled step advancing all live slots per tick.
+- ``prefix_cache.PrefixCache`` — chunk-granular content-keyed LRU over
+  prompt-prefix K/V (the system-prompt case prefills once).
+- ``scheduler.Scheduler`` — SLO-aware admission (priority classes, EDF
+  within a class, starvation bound) with backpressure, slot
+  allocation, deadlines, and one-prefill-chunk-per-tick interleaving;
+  deterministic and model-free (any object with the engine's
+  start_prefill/prefill_step/step/release surface works).
 - ``server.ServeServer`` — stdlib HTTP daemon: ``POST /v1/generate``,
   ``GET /healthz``, ``GET /metrics`` (OpenMetrics serve gauges).
 """
 
 from nanodiloco_tpu.serve.client import http_get, http_post_json
 from nanodiloco_tpu.serve.engine import InferenceEngine
+from nanodiloco_tpu.serve.prefix_cache import PrefixCache
 from nanodiloco_tpu.serve.scheduler import (
     GenRequest,
     QueueFull,
@@ -26,6 +32,7 @@ __all__ = [
     "http_get",
     "http_post_json",
     "GenRequest",
+    "PrefixCache",
     "QueueFull",
     "Scheduler",
     "Ticket",

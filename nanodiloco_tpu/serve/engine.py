@@ -4,34 +4,59 @@ Orca-style (Yu et al., OSDI'22) iteration-level scheduling on TPU terms:
 the engine owns ONE preallocated cache ``[L, B, S_max, Hkv, hd]`` whose
 B rows are independent request slots. A request's life:
 
-- ``prefill(slot, request)`` runs the prompt through the SAME cached
-  prefill program the one-shot ``generate`` uses, writing K/V into the
-  slot's cache row at positions ``[0, P)``, and samples the first token.
-- every ``step()`` advances ALL slots one token with a single compiled
-  program (per-slot positions, PRNG keys, and sampling params are traced
-  arrays) — admitting a new request or retiring a finished one never
-  recompiles and never stops the other slots' streams.
-- ``release(slot)`` frees the row. Nothing is zeroed: a retired slot's
-  stale K/V is causally unreachable to the next occupant (its prefill
-  overwrites ``[0, P)`` and decode never attends past its own position).
+- ``start_prefill(slot, request)`` stages the request into a free slot
+  and, when the prefix cache holds the prompt's leading chunks, copies
+  their K/V rows in so only the suffix needs compute.
+- ``prefill_step(slot)`` runs ONE prefill chunk (Sarathi-Serve,
+  arXiv:2403.02310: chunked prefill is what keeps a 4k-token prompt
+  from freezing every live decode stream between two ticks). The final
+  chunk samples and returns the first token; earlier chunks return
+  None. Chunk lengths are bucketed to powers of two, so mixed-length
+  traffic compiles a BOUNDED program set — not one prefill executable
+  per prompt length.
+- every ``step()`` advances ALL decoding slots one token with a single
+  compiled program (per-slot positions, PRNG keys, and sampling params
+  ride as traced arrays) — admitting a new request or retiring a
+  finished one never recompiles and never stops the other streams.
+- ``release(slot)`` frees the row (mid-prefill or mid-decode). Nothing
+  is zeroed: a retired slot's stale K/V is causally unreachable to the
+  next occupant (its prefill overwrites ``[0, P)`` and decode never
+  attends past its own position).
+
+Chunking math (why it is exact): K/V at position i depend only on
+``tokens[:i+1]``, so writing them chunk-by-chunk produces the same cache
+bits as one whole-prompt call; each chunk's queries attend causally over
+everything already written, which is the same reduction the one-shot
+prefill performs row by row. The final chunk is bucketed by RE-FEEDING
+the prompt's last ``bucket`` tokens (recomputing K/V to identical bits)
+so its last row is the true last prompt token — except a single-chunk
+prompt shorter than its bucket, which right-pads instead and passes the
+last REAL index into the program (pad K/V land past the prompt,
+causally unreachable, then overwritten by decode).
 
 Determinism contract (tested): a request's token stream is exactly the
 stream ``generate()`` produces alone with the same seed and sampling
-params. The per-request PRNG schedule is replicated on the host at
-admission — ``key, k0 = split(key(seed))`` for the first token, then
-``split(key, max_new_tokens - 1)`` for the decode steps (the full array
-is materialized up front because ``split(key, n)[i]`` depends on ``n``
-on this jax) — and each tick feeds every slot its own next key.
+params — through chunked admission AND through a prefix-cache hit (the
+cached rows were computed from the same tokens at the same positions
+under the same params). The per-request PRNG schedule is replicated on
+the host at admission — ``key, k0 = split(key(seed))`` for the first
+token, then ``split(key, max_new_tokens - 1)`` for the decode steps
+(the full array is materialized up front because ``split(key, n)[i]``
+depends on ``n`` on this jax) — and each tick feeds every slot its own
+next key.
 
 Known divergence, inherited from ``generate`` and narrowed here: dense-
 dispatch token-choice MoE sizes expert capacity from the tokens in the
 call, so a decode tick routes over B slots where ``generate`` routes
-over 1. With ample capacity (or ``moe_dispatch="ragged"``) routing is
-per-token independent and identical; dead slots are masked out of
-routing entirely (``active``).
+over 1, and a prefill chunk routes over its chunk where ``generate``
+routes over the whole prompt. With ample capacity (or
+``moe_dispatch="ragged"``) routing is per-token independent and
+identical; dead slots are masked out of routing entirely (``active``).
 """
 
 from __future__ import annotations
+
+import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -40,9 +65,34 @@ import numpy as np
 from nanodiloco_tpu.models.config import LlamaConfig
 from nanodiloco_tpu.models.generate import (
     decode_slots_fn,
+    extract_chunk_fn,
     init_kv_cache,
-    prefill_slot_fn,
+    insert_chunk_fn,
+    prefill_chunk_fn,
+    sample_token_fn,
 )
+from nanodiloco_tpu.serve.prefix_cache import PrefixCache
+
+
+def _floor_pow2(n: int) -> int:
+    return 1 << (int(n).bit_length() - 1)
+
+
+def _ceil_pow2(n: int) -> int:
+    return 1 << (int(n) - 1).bit_length() if n > 1 else 1
+
+
+@dataclasses.dataclass
+class _Prefill:
+    """One slot's in-flight prefill: the staged request plus the cursor
+    into its prompt. ``done`` tokens are already in the slot's cache
+    (prefix-cache hit + completed chunks); the chunks-remaining count
+    lives in the scheduler's ``_Prefilling``, fed by ``start_prefill``'s
+    return value."""
+
+    request: object
+    ids: list[int]
+    done: int            # prompt tokens whose K/V are written
 
 
 class InferenceEngine:
@@ -56,11 +106,15 @@ class InferenceEngine:
         *,
         num_slots: int = 4,
         max_len: int = 1024,
+        chunk_size: int = 64,
+        prefix_cache_tokens: int = 0,
     ) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1; got {num_slots}")
         if max_len < 2:
             raise ValueError(f"max_len must be >= 2; got {max_len}")
+        if chunk_size < 1:
+            raise ValueError(f"chunk_size must be >= 1; got {chunk_size}")
         if cfg.num_experts and cfg.router_type == "experts_choose":
             raise ValueError(
                 "expert-choice routing is training-only (see generate()); "
@@ -70,10 +124,23 @@ class InferenceEngine:
         self.cfg = cfg
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
+        # chunk lengths are bucketed to powers of two; capping the top
+        # bucket at the largest power of two <= max_len keeps every
+        # bucketed write inside the slot row (a bucket can right-pad a
+        # single-chunk prompt, and dynamic_update_slice would CLAMP an
+        # out-of-range write backwards over real positions)
+        self.chunk_size = _floor_pow2(min(int(chunk_size), self.max_len))
         self.vocab_size = cfg.vocab_size
         self.cache = init_kv_cache(cfg, self.num_slots, self.max_len)
-        self._prefill = prefill_slot_fn(cfg)
+        self._chunk = prefill_chunk_fn(cfg)
+        self._sample = sample_token_fn(cfg)
         self._decode = decode_slots_fn(cfg)
+        self._extract = extract_chunk_fn(cfg)
+        self._insert = insert_chunk_fn(cfg)
+        self.prefix_cache = (
+            PrefixCache(int(prefix_cache_tokens), self.chunk_size)
+            if prefix_cache_tokens else None
+        )
 
         b, s = self.num_slots, self.max_len
         self._tokens = np.zeros(b, np.int32)       # next input token per slot
@@ -86,6 +153,7 @@ class InferenceEngine:
         # per-slot precomputed decode key data [max_new-1, 2] uint32
         self._keys: list[np.ndarray | None] = [None] * b
         self._step_idx = [0] * b
+        self._prefills: list[_Prefill | None] = [None] * b
         self._dummy_key = np.asarray(
             jax.random.key_data(jax.random.key(0)), np.uint32
         )
@@ -120,26 +188,86 @@ class InferenceEngine:
 
     # -- slot lifecycle ------------------------------------------------------
 
-    def prefill(self, slot: int, request) -> int:
-        """Admit ``request`` into ``slot``: write its prompt K/V, stage
-        its sampling state, and return the first sampled token."""
-        ids = list(request.prompt)
+    def start_prefill(self, slot: int, request) -> int:
+        """Stage ``request`` into free slot ``slot``: validate, reuse
+        any cached shared-prefix K/V, and return the number of prefill
+        chunks still to run (>= 1 — the last prompt token always
+        prefills for real, its logits seed the first sample)."""
+        ids = [int(t) for t in request.prompt]
         self.validate(ids, request.max_new_tokens)
-        p = len(ids)
-        temp = float(request.temperature)
-        top_k = min(int(request.top_k), self.vocab_size)
-        top_p = float(request.top_p)
-
-        # the one-shot generate()'s exact key schedule, replayed per slot
-        key = jax.random.key(int(request.seed))
-        karr = jax.random.split(key)  # karr[0] = rest, karr[1] = k0
-        tok0, self.cache = self._prefill(
-            self.params, self.cache,
-            jnp.asarray([ids], jnp.int32), jnp.ones((1, p), jnp.int32),
-            jnp.int32(slot), karr[1],
-            jnp.float32(temp), jnp.int32(top_k), jnp.float32(top_p),
+        done = 0
+        use_cache = self.prefix_cache is not None and getattr(
+            request, "prefix_cache", True
         )
-        n = int(request.max_new_tokens)
+        if use_cache:
+            blocks = self.prefix_cache.match(ids)
+            for i, (k, v) in enumerate(blocks):
+                self.cache = self._insert(
+                    self.cache, k, v, jnp.int32(slot),
+                    jnp.int32(i * self.chunk_size),
+                )
+            done = len(blocks) * self.chunk_size
+        self._prefills[slot] = _Prefill(request, ids, done)
+        return -(-(len(ids) - done) // self.chunk_size)
+
+    def prefill_step(self, slot: int) -> int | None:
+        """Run ONE prefill chunk for the staged request in ``slot``.
+        Returns None while chunks remain; the final chunk samples and
+        returns the first token, leaving the slot live for ``step()``."""
+        pf = self._prefills[slot]
+        if pf is None:
+            raise ValueError(f"slot {slot} has no prefill in flight")
+        ids, p = pf.ids, len(pf.ids)
+        remaining = p - pf.done
+        if remaining > self.chunk_size:
+            # full interior chunk: exactly chunk_size real tokens
+            lo = pf.done
+            chunk = ids[lo:lo + self.chunk_size]
+            _logits, self.cache = self._chunk(
+                self.params, self.cache,
+                jnp.asarray([chunk], jnp.int32),
+                jnp.ones((1, self.chunk_size), jnp.int32),
+                jnp.int32(slot), jnp.int32(lo),
+                jnp.int32(self.chunk_size - 1),
+            )
+            pf.done += self.chunk_size
+            return None
+
+        # final chunk, bucketed to a power of two. Prefer re-feeding the
+        # prompt's last `bucket` real tokens (recomputed K/V bits are
+        # identical, and the last row IS the last prompt token); a
+        # single-chunk prompt shorter than its bucket right-pads instead
+        # and passes the true last index.
+        bucket = _ceil_pow2(remaining)
+        if p >= bucket:
+            lo = p - bucket
+            chunk = ids[lo:]
+            valid = np.ones((1, bucket), np.int32)
+            last = bucket - 1
+        else:  # pf.done == 0 and the whole prompt is shorter than bucket
+            lo = 0
+            chunk = ids + [0] * (bucket - p)
+            valid = np.zeros((1, bucket), np.int32)
+            valid[0, :p] = 1
+            last = p - 1
+        logits, self.cache = self._chunk(
+            self.params, self.cache,
+            jnp.asarray([chunk], jnp.int32), jnp.asarray(valid),
+            jnp.int32(slot), jnp.int32(lo), jnp.int32(last),
+        )
+        pf.done = p
+        req = pf.request
+        temp = float(req.temperature)
+        top_k = min(int(req.top_k), self.vocab_size)
+        top_p = float(req.top_p)
+        # the one-shot generate()'s exact key schedule, replayed per slot
+        key = jax.random.key(int(req.seed))
+        karr = jax.random.split(key)  # karr[0] = rest, karr[1] = k0
+        tok0 = int(self._sample(
+            logits, karr[1],
+            jnp.float32(temp), jnp.int32(top_k), jnp.float32(top_p),
+        ))
+        n = int(req.max_new_tokens)
         self._keys[slot] = (
             np.asarray(jax.random.key_data(jax.random.split(karr[0], n - 1)),
                        np.uint32)
@@ -148,17 +276,46 @@ class InferenceEngine:
         self._step_idx[slot] = 0
         self._pos[slot] = p
         self._key_valid[slot] = 1
-        self._tokens[slot] = int(tok0)
+        self._tokens[slot] = tok0
         self._temp[slot] = temp
         self._topk[slot] = top_k
         self._topp[slot] = top_p
         self._active[slot] = 1
         self._dev = None  # slot state changed: re-stage on the next step
-        return int(tok0)
+
+        self._prefills[slot] = None
+        if (
+            self.prefix_cache is not None
+            and getattr(req, "prefix_cache", True)
+        ):
+            # explicit admission: every completed (non-opted-out)
+            # prefill offers its whole-chunk prefix; only chunks not
+            # already cached are copied off the slot's rows
+            cs = self.chunk_size
+
+            def extract(i: int):
+                k, v = self._extract(
+                    self.cache, jnp.int32(slot), jnp.int32(i * cs), cs
+                )
+                return k, v
+
+            self.prefix_cache.insert(ids, (p - 1) // cs, extract)
+        return tok0
+
+    def prefill(self, slot: int, request) -> int:
+        """Whole-prompt convenience: stage and run every chunk in one
+        call (the parity tests' sequential driver; the scheduler
+        interleaves ``prefill_step`` with decode ticks instead)."""
+        self.start_prefill(slot, request)
+        while True:
+            tok = self.prefill_step(slot)
+            if tok is not None:
+                return tok
 
     def step(self) -> np.ndarray:
-        """Advance every slot one token (one compiled tick). Returns the
-        [B] sampled tokens; entries for inactive slots are meaningless."""
+        """Advance every live slot one token (one compiled tick).
+        Returns the [B] sampled tokens; entries for inactive slots are
+        meaningless."""
         b = self.num_slots
         keys_now = np.empty((b, 2), np.uint32)
         for s in range(b):
@@ -196,4 +353,30 @@ class InferenceEngine:
         self._keys[slot] = None
         self._pos[slot] = 0
         self._tokens[slot] = 0
+        self._prefills[slot] = None
         self._dev = None
+
+    # -- observability -------------------------------------------------------
+
+    def prefix_stats(self) -> dict | None:
+        """Prefix-cache counters for the serve gauges (None when the
+        cache is disabled)."""
+        return None if self.prefix_cache is None else self.prefix_cache.stats()
+
+    def compile_counts(self) -> dict:
+        """Compiled-executable counts per program — the bounded-compile
+        contract is testable, not folklore: chunk programs are capped by
+        the power-of-two bucket set, decode/sample/copy by 1 each."""
+        def size(fn):
+            try:
+                return fn._cache_size()
+            except Exception:  # pragma: no cover - older/newer jit internals
+                return None
+
+        return {
+            "prefill_chunk": size(self._chunk),
+            "decode": size(self._decode),
+            "sample": size(self._sample),
+            "extract": size(self._extract),
+            "insert": size(self._insert),
+        }

@@ -1,0 +1,129 @@
+"""Shared-prefix KV cache: prefill a common prompt prefix once, reuse it.
+
+The system-prompt pattern (every request opens with the same instruction
+block) makes whole-prompt prefill O(requests x prefix) for work that is
+O(prefix): K/V at position i depend only on ``tokens[:i+1]`` and the
+frozen params, so two prompts with the same token prefix have
+bit-identical K/V rows over it (vLLM's PagedAttention observation,
+arXiv:2309.06180, on this repo's dense-slot terms).
+
+Granularity is the engine's prefill CHUNK: an entry is one whole chunk
+of K/V rows ``[L, chunk_tokens, Hkv, hd]`` keyed by the token tuple of
+the ENTIRE prefix through that chunk (a Python dict over token tuples IS
+a content-hashed map, with collision resolution for free — no rolling
+hash to get wrong). Corollary: a shared prefix shorter than one chunk
+never caches, and sharing stops at the last whole-chunk boundary inside
+the common prefix — size the chunk at or below the system prompt. Chunk entries chain: a request's lookup walks its
+prompt chunk by chunk and stops at the first miss, so a prompt matching
+2 of 3 cached chunks still reuses 2. A hit is capped at
+``floor((P-1)/chunk)`` chunks — at least the prompt's last token must
+prefill for real, because its logits seed the first sampled token.
+
+Admission is explicit and observable: ``insert`` is called by the engine
+once a request's prefill COMPLETES (never for requests that opted out),
+capacity is bounded in cached tokens with LRU eviction, and every
+hit/miss/insert/eviction increments a counter surfaced on the serve
+``/metrics``. Single-threaded by design: only the engine's tick thread
+calls ``match``/``insert``; ``stats`` reads plain ints and is safe from
+the HTTP threads.
+"""
+
+from __future__ import annotations
+
+import collections
+
+
+class PrefixCache:
+    """Chunk-granular LRU over token-prefix keys. ``blocks`` values are
+    opaque to this class (the engine stores ``(k, v)`` device arrays),
+    so every policy decision is testable without a model."""
+
+    def __init__(self, capacity_tokens: int, chunk_tokens: int) -> None:
+        if chunk_tokens < 1:
+            raise ValueError(f"chunk_tokens must be >= 1; got {chunk_tokens}")
+        if capacity_tokens < chunk_tokens:
+            raise ValueError(
+                f"capacity_tokens ({capacity_tokens}) must hold at least "
+                f"one chunk ({chunk_tokens} tokens)"
+            )
+        self.chunk_tokens = int(chunk_tokens)
+        self.capacity_tokens = int(capacity_tokens)
+        # prefix token tuple (whole chunks) -> block; move_to_end = LRU
+        self._blocks: collections.OrderedDict[tuple, object] = (
+            collections.OrderedDict()
+        )
+        self.hits = 0            # lookups that reused >= 1 chunk
+        self.misses = 0          # lookups that reused none
+        self.hit_tokens = 0      # prompt tokens NOT re-prefilled
+        self.insertions = 0      # chunks inserted
+        self.evictions = 0       # chunks LRU-evicted
+
+    @property
+    def cached_tokens(self) -> int:
+        return len(self._blocks) * self.chunk_tokens
+
+    def match(self, prompt) -> list:
+        """Longest chain of cached whole-chunk prefixes of ``prompt``
+        (capped so at least one prompt token is left to prefill).
+        Returns the blocks in chunk order ([] = miss); bumps LRU on
+        every chunk of the hit path."""
+        cs = self.chunk_tokens
+        prompt = tuple(prompt)
+        max_chunks = (len(prompt) - 1) // cs
+        blocks: list = []
+        for i in range(max_chunks):
+            key = prompt[: (i + 1) * cs]
+            block = self._blocks.get(key)
+            if block is None:
+                break
+            self._blocks.move_to_end(key)
+            blocks.append(block)
+        if blocks:
+            self.hits += 1
+            self.hit_tokens += len(blocks) * cs
+        else:
+            self.misses += 1
+        return blocks
+
+    def insert(self, prompt, n_chunks: int, extract) -> int:
+        """Cache the first ``n_chunks`` whole chunks of ``prompt``.
+        ``extract(chunk_index)`` materializes the block for a chunk not
+        yet cached (the engine copies it off the slot's K/V rows — paid
+        only for genuinely new chunks). Returns how many chunks were
+        newly inserted; evicts LRU entries past ``capacity_tokens``."""
+        cs = self.chunk_tokens
+        prompt = tuple(prompt)
+        inserted = 0
+        for i in range(n_chunks):
+            if (i + 1) * cs > self.capacity_tokens:
+                # a chain longer than the whole cache can never be
+                # looked up intact; inserting its tail would only evict
+                # useful entries to store unreachable ones
+                break
+            key = prompt[: (i + 1) * cs]
+            if key in self._blocks:
+                self._blocks.move_to_end(key)
+                continue
+            self._blocks[key] = extract(i)
+            self.insertions += 1
+            inserted += 1
+            while self.cached_tokens > self.capacity_tokens:
+                # LRU. A mid-chain eviction strands its longer suffixes
+                # (lookup walks from chunk 0 and stops at the gap) until
+                # LRU drains them too — bounded staleness, zero extra
+                # bookkeeping, and never a wrong hit.
+                self._blocks.popitem(last=False)
+                self.evictions += 1
+        return inserted
+
+    def stats(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_tokens": self.hit_tokens,
+            "insertions": self.insertions,
+            "evictions": self.evictions,
+            "cached_tokens": self.cached_tokens,
+            "capacity_tokens": self.capacity_tokens,
+            "chunk_tokens": self.chunk_tokens,
+        }

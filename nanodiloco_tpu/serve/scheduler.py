@@ -1,22 +1,48 @@
-"""Admission queue + deterministic tick loop for the serving engine.
+"""SLO-aware admission + deterministic tick loop for the serving engine.
 
 The scheduler is the testable half of continuous batching: it owns WHICH
 request runs in WHICH slot WHEN, and nothing else. The model lives
-behind a three-method backend surface (``prefill(slot, request) ->
-first_token``, ``step() -> [B] tokens``, ``release(slot)``), so every
-scheduling decision — admission order, slot refill mid-decode, EOS
-retirement, queue-full backpressure, deadline expiry — is provable with
-a scripted fake backend and an injected clock, no model and no RNG
-ambiguity (the same injectable-clock discipline as ``obs/watchdog.py``
-and ``resilience/retry.py``).
+behind a small backend surface (``start_prefill(slot, request) ->
+chunks_pending``, ``prefill_step(slot) -> first_token | None``,
+``step() -> [B] tokens``, ``release(slot)``), so every scheduling
+decision — admission order, chunk interleaving, slot refill mid-decode,
+EOS retirement, queue-full backpressure, deadline expiry, starvation
+boosts — is provable with a scripted fake backend and an injected clock,
+no model and no RNG ambiguity (the same injectable-clock discipline as
+``obs/watchdog.py`` and ``resilience/retry.py``).
+
+Admission is deadline/priority ordered, not FIFO (the deadline machinery
+existed since PR 4 but only triggered expiry): among queued requests the
+scheduler picks the lowest ``priority`` class first (0 = most urgent)
+and earliest deadline within a class (EDF; deadline-less requests sort
+last, then submit order breaks ties). One bound keeps best-effort
+traffic live: a request queued longer than ``starvation_s`` is admitted
+next regardless of class, so a stream of urgent work can delay
+best-effort requests but never starve them forever.
+
+Prefill is CHUNKED (Sarathi-Serve, arXiv:2403.02310): admission stages a
+request into its slot; each tick then runs AT MOST ONE prefill chunk,
+between decode ticks, so a 4k-token prompt admits incrementally and
+never freezes live decode streams. When several slots are mid-prefill,
+the chunk goes to the fewest-chunks-remaining slot first
+(shortest-remaining-first: a short prompt's single chunk never waits
+behind a long prompt's fifty, which is what bounds short-request TTFT
+under interference), with priority class then submit order as ties —
+bounded by aging: a slot bypassed ``prefill_aging_ticks`` consecutive
+ticks takes the next chunk regardless, so a steady stream of one-chunk
+shorts delays a long prefill but can never starve it.
 
 Tick anatomy (one call, strictly ordered, deterministic):
-1. expire queued requests whose deadline passed (they never held a slot);
-2. admit from the FIFO queue into free slots, lowest slot index first —
-   each admission prefills and may finish immediately (stop token or
-   ``max_new_tokens == 1``), freeing the slot for the NEXT queued
-   request within the same pass;
-3. if any slot is live, ONE decode step advances them all; finished
+1. expire queued requests whose deadline passed (they never held a
+   slot) and drop cancelled ones;
+2. expire/cancel requests mid-prefill — a deadline can pass between
+   chunks; the slot is released with the usual empty-result expiry;
+3. admit from the queue into free slots in SLO order (above) — staging
+   only, no model compute yet;
+4. run ONE prefill chunk for the neediest mid-prefill slot; a final
+   chunk yields the request's first token (it may also finish it
+   outright: stop token or ``max_new_tokens == 1``);
+5. if any slot is decoding, ONE decode step advances them all; finished
    slots (stop token / length / deadline) are retired and their slots
    are free for the next tick's admission pass — requests join and
    leave the batch mid-stream, there is no barrier between requests.
@@ -47,12 +73,16 @@ class QueueFull(RuntimeError):
 @dataclasses.dataclass(frozen=True)
 class GenRequest:
     """One generation request. ``deadline_s`` is a RELATIVE budget from
-    submission; a request past it is expired (queued) or retired with
-    its partial output (running). ``request_id`` is an optional
-    client-supplied correlation id echoed in the result (and stamped on
-    the request's trace spans); absent, the scheduler derives one from
-    its rid so client logs, serve spans, and histograms always have a
-    join key."""
+    submission; a request past it is expired (queued or mid-prefill) or
+    retired with its partial output (decoding). ``priority`` is the SLO
+    class (0 = most urgent; admission is EDF within a class; default 1
+    = normal, best-effort traffic should use a higher number).
+    ``prefix_cache`` opts this request out of shared-prefix KV reuse
+    (both reading and populating) when False. ``request_id`` is an
+    optional client-supplied correlation id echoed in the result (and
+    stamped on the request's trace spans); absent, the scheduler
+    derives one from its rid so client logs, serve spans, and
+    histograms always have a join key."""
 
     prompt: tuple[int, ...]
     max_new_tokens: int
@@ -63,16 +93,18 @@ class GenRequest:
     stop_token: int | None = None
     deadline_s: float | None = None
     request_id: str | None = None
+    priority: int = 1
+    prefix_cache: bool = True
 
 
 class Ticket:
     """Handle returned by ``submit``: ``wait(timeout)`` blocks until the
     scheduler finishes the request and returns the result dict
     (``None`` on timeout). ``cancel()`` asks the scheduler to drop the
-    request at its next opportunity — a queued request never takes a
-    slot, a decoding one is retired with its partial output — so an
-    abandoned client (HTTP timeout, disconnect) stops spending slot
-    capacity on tokens nobody will read."""
+    request at its next opportunity — a queued or mid-prefill request
+    never decodes, a decoding one is retired with its partial output —
+    so an abandoned client (HTTP timeout, disconnect) stops spending
+    slot capacity on tokens nobody will read."""
 
     def __init__(self, rid: int) -> None:
         self.rid = rid
@@ -104,6 +136,22 @@ class _Queued:
 
 
 @dataclasses.dataclass
+class _Prefilling:
+    """A slot whose request is staged but still prefilling in chunks.
+    ``bypassed`` counts consecutive ticks the SRPT pick went elsewhere —
+    the aging input that keeps a long prefill from starving."""
+
+    ticket: Ticket
+    request: GenRequest
+    submitted_at: float
+    deadline_at: float | None
+    admitted_at: float
+    chunks_left: int
+    chunks_run: int = 0
+    bypassed: int = 0
+
+
+@dataclasses.dataclass
 class _Running:
     ticket: Ticket
     request: GenRequest
@@ -115,8 +163,10 @@ class _Running:
 
 
 class Scheduler:
-    """FIFO admission + slot allocation over a backend with ``num_slots``
-    slots. ``clock`` is injectable (monotonic seconds)."""
+    """SLO-ordered admission + slot allocation over a backend with
+    ``num_slots`` slots. ``clock`` is injectable (monotonic seconds);
+    ``starvation_s`` bounds how long priority traffic may delay a
+    best-effort request (None = pure priority/EDF, starvable)."""
 
     def __init__(
         self,
@@ -125,11 +175,25 @@ class Scheduler:
         max_queue: int = 64,
         clock: Callable[[], float] = time.monotonic,
         tracer=None,
+        starvation_s: float | None = 30.0,
+        prefill_aging_ticks: int = 8,
     ) -> None:
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1; got {max_queue}")
+        if starvation_s is not None and starvation_s <= 0:
+            raise ValueError(
+                f"starvation_s must be positive or None; got {starvation_s}"
+            )
+        if prefill_aging_ticks < 1:
+            raise ValueError(
+                f"prefill_aging_ticks must be >= 1; got {prefill_aging_ticks}"
+            )
         self.backend = backend
         self._clock = clock
+        # in-slot aging bound for the per-tick chunk pick (step 4): a
+        # mid-prefill slot bypassed this many consecutive ticks gets
+        # the next chunk regardless of shortest-remaining-first
+        self.prefill_aging_ticks = int(prefill_aging_ticks)
         # per-request span sink (obs/tracer.SpanTracer or None): the
         # scheduler reports each request's queued/prefill/decode phases
         # via record_span with ITS OWN clock's timestamps — construct
@@ -139,7 +203,10 @@ class Scheduler:
         # as the training shards.
         self.tracer = tracer
         self.max_queue = int(max_queue)
-        self._slots: list[_Running | None] = [None] * backend.num_slots
+        self.starvation_s = starvation_s
+        self._slots: list[_Prefilling | _Running | None] = (
+            [None] * backend.num_slots
+        )
         self._queue: collections.deque[_Queued] = collections.deque()
         self._lock = threading.Lock()
         self._next_rid = 0
@@ -153,14 +220,17 @@ class Scheduler:
         self._tokens_out = 0
         self._decode_tokens = 0
         self._decode_s = 0.0
+        self._prefill_chunks = 0   # chunks run (counter)
         self._ttft: collections.deque[float] = collections.deque(maxlen=512)
         # real distributions for the scrape (cumulative-bucket
         # histograms; the deque above remains for last/p50/p95 gauges):
-        # TTFT submit->first-token, slot wait submit->admit, and the
-        # per-tick decode latency (one compiled step for all live slots)
+        # TTFT submit->first-token, slot wait submit->admit (overall AND
+        # split by priority class — the per-class wait is what an SLO
+        # dashboard actually alerts on), and the per-tick decode latency
         self.hist_ttft = Histogram()
         self.hist_queue_wait = Histogram()
         self.hist_decode_tick = Histogram()
+        self.hist_queue_wait_by_priority: dict[int, Histogram] = {}
 
     # -- submission (any thread) --------------------------------------------
 
@@ -185,8 +255,9 @@ class Scheduler:
 
     def tick(self) -> int:
         """One deterministic scheduling round (see module docstring).
-        Returns the number of live slots after the tick, so a serving
-        loop can idle when there is no work."""
+        Returns the number of occupied slots (prefilling or decoding)
+        after the tick, so a serving loop can idle when there is no
+        work."""
         now = self._clock()
         # 1. drop queued requests whose deadline passed or whose client
         # cancelled (they never held a slot)
@@ -211,15 +282,40 @@ class Scheduler:
             self._finish(q.ticket, q.request, [], reason,
                          q.submitted_at, None, None, now)
 
-        # 2. admit into free slots, FIFO, lowest slot first; a request
-        # that finishes at prefill (one token / instant stop) leaves its
-        # slot free for the next queued request within the same pass
+        # 2. expire/cancel requests caught mid-prefill: a deadline can
+        # pass between two chunks of a long prompt; the slot frees with
+        # the same empty-result expiry a queued request gets
+        for s, run in enumerate(self._slots):
+            if not isinstance(run, _Prefilling):
+                continue
+            if run.ticket.cancelled:
+                reason = "cancelled"
+                self._cancelled += 1
+            elif run.deadline_at is not None and now >= run.deadline_at:
+                reason = "deadline"
+                self._expired += 1
+            else:
+                continue
+            self._backend_release(s)
+            self._slots[s] = None
+            self._span("prefill", run.admitted_at, now,
+                       self._req_id(run.ticket, run.request), slot=s,
+                       chunks=run.chunks_run, outcome=reason)
+            self._finish(run.ticket, run.request, [], reason,
+                         run.submitted_at, run.admitted_at, None, now)
+
+        # 3. admit into free slots in SLO order (priority class, EDF
+        # within it, starvation bound on top) — staging only; the model
+        # work happens one chunk per tick in step 4. A cancelled or
+        # invalid pop retries the SAME free slot with the next queued
+        # request: a dud at the queue head must not cost a viable
+        # request its admission tick.
         slot = 0
         while slot < len(self._slots):
             if self._slots[slot] is not None:
                 slot += 1
                 continue
-            q = self._pop_queue()
+            q = self._pick_queued()
             if q is None:
                 break
             if q.ticket.cancelled:  # cancelled between sweep and pop
@@ -234,7 +330,7 @@ class Scheduler:
             rid_str = self._req_id(q.ticket, q.request)
             t_admit = self._clock()
             try:
-                tok0 = self.backend.prefill(slot, q.request)
+                chunks = int(self.backend.start_prefill(slot, q.request))
             except ValueError as e:
                 # a bad REQUEST must not kill the loop; anything else
                 # (OOM, a donated-then-deleted cache) propagates and
@@ -247,29 +343,80 @@ class Scheduler:
                              q.submitted_at, None, None, self._clock(),
                              error=str(e))
                 continue
-            t_first = self._clock()
-            self.hist_queue_wait.observe(t_admit - q.submitted_at)
-            self.hist_ttft.observe(t_first - q.submitted_at)
-            self._span("queued", q.submitted_at, t_admit, rid_str, slot=slot)
-            self._span("prefill", t_admit, t_first, rid_str, slot=slot,
-                       prompt_tokens=len(q.request.prompt))
-            with self._lock:  # stats() sorts this deque from HTTP threads
-                self._ttft.append(t_first - q.submitted_at)
-            self._tokens_out += 1
-            run = _Running(q.ticket, q.request, q.submitted_at,
-                           q.deadline_at, t_admit, t_first, [tok0])
-            reason = self._finish_reason(run, t_first)
-            if reason is None:
-                self._slots[slot] = run
-                slot += 1
-            else:
-                # prefill already activated the slot in the backend; an
-                # unreleased instant-finish would decode as a zombie
-                self._backend_release(slot)
-                self._retire(run, reason, t_first)
+            wait = t_admit - q.submitted_at
+            self.hist_queue_wait.observe(wait)
+            self._priority_hist(q.request.priority).observe(wait)
+            self._span("queued", q.submitted_at, t_admit, rid_str, slot=slot,
+                       priority=q.request.priority)
+            self._slots[slot] = _Prefilling(
+                q.ticket, q.request, q.submitted_at, q.deadline_at,
+                t_admit, chunks,
+            )
+            slot += 1
 
-        # 3. one decode step for everyone live
-        live = [s for s in range(len(self._slots)) if self._slots[s] is not None]
+        # 4. ONE prefill chunk, to the fewest-chunks-remaining slot
+        # (shortest-remaining-first bounds short-request TTFT while a
+        # long prefill is in flight), priority then admission order as
+        # tie-breaks. Aging caps the delay: a slot bypassed
+        # ``prefill_aging_ticks`` consecutive ticks takes the next
+        # chunk regardless of SRPT — without it, a steady stream of
+        # one-chunk shorts would starve a long prefill forever (the
+        # admission-level starvation bound stops at the queue pop; this
+        # is its in-slot counterpart).
+        pf_slots = [
+            s for s, r in enumerate(self._slots)
+            if isinstance(r, _Prefilling)
+        ]
+        if pf_slots:
+            aged = [s for s in pf_slots
+                    if self._slots[s].bypassed >= self.prefill_aging_ticks]
+            if aged:
+                s = max(aged, key=lambda i: (self._slots[i].bypassed,
+                                             -self._slots[i].ticket.rid))
+            else:
+                s = min(pf_slots, key=lambda i: (
+                    self._slots[i].chunks_left,
+                    self._slots[i].request.priority,
+                    self._slots[i].ticket.rid,
+                ))
+            for other in pf_slots:
+                if other != s:
+                    self._slots[other].bypassed += 1
+            run = self._slots[s]
+            run.bypassed = 0
+            tok0 = self.backend.prefill_step(s)
+            self._prefill_chunks += 1
+            run.chunks_run += 1
+            run.chunks_left = max(0, run.chunks_left - 1)
+            if tok0 is not None:
+                t_first = self._clock()
+                rid_str = self._req_id(run.ticket, run.request)
+                self.hist_ttft.observe(t_first - run.submitted_at)
+                self._span("prefill", run.admitted_at, t_first, rid_str,
+                           slot=s, prompt_tokens=len(run.request.prompt),
+                           chunks=run.chunks_run)
+                with self._lock:  # stats() sorts this deque from HTTP threads
+                    self._ttft.append(t_first - run.submitted_at)
+                self._tokens_out += 1
+                live = _Running(run.ticket, run.request, run.submitted_at,
+                                run.deadline_at, run.admitted_at, t_first,
+                                [int(tok0)])
+                reason = self._finish_reason(live, t_first)
+                if reason is None:
+                    self._slots[s] = live
+                else:
+                    # prefill already activated the slot in the backend;
+                    # an unreleased instant-finish would decode as a
+                    # zombie
+                    self._backend_release(s)
+                    self._slots[s] = None
+                    self._retire(live, reason, t_first)
+
+        # 5. one decode step for everyone live
+        live = [
+            s for s in range(len(self._slots))
+            if isinstance(self._slots[s], _Running)
+        ]
         if live:
             t0 = self._clock()
             toks = self.backend.step()
@@ -291,6 +438,42 @@ class Scheduler:
                     self._retire(run, reason, t1)
         return sum(1 for s in self._slots if s is not None)
 
+    def _pick_queued(self) -> _Queued | None:
+        """Pop the next request to admit. Starvation bound first: when
+        the OLDEST queued request (FIFO head) has waited past
+        ``starvation_s``, it goes next no matter its class. Otherwise
+        lowest priority number wins; within a class, earliest deadline
+        (EDF; deadline-less requests last); submit order breaks ties
+        (rids are issued in submit order)."""
+        now = self._clock()
+        with self._lock:
+            if not self._queue:
+                return None
+            if (
+                self.starvation_s is not None
+                and now - self._queue[0].submitted_at >= self.starvation_s
+            ):
+                return self._queue.popleft()
+            best = min(self._queue, key=lambda q: (
+                q.request.priority,
+                q.deadline_at if q.deadline_at is not None else float("inf"),
+                q.ticket.rid,
+            ))
+            self._queue.remove(best)
+            return best
+
+    def _priority_hist(self, priority: int) -> Histogram:
+        h = self.hist_queue_wait_by_priority.get(int(priority))
+        if h is None:
+            # first request of a class: insert under the lock — stats()
+            # snapshots this dict from the HTTP threads, and an
+            # unguarded insert mid-iteration is a RuntimeError there
+            with self._lock:
+                h = self.hist_queue_wait_by_priority.setdefault(
+                    int(priority), Histogram()
+                )
+        return h
+
     def _req_id(self, ticket: Ticket, request: GenRequest) -> str:
         """The request's correlation id: client-supplied when present,
         else derived from the scheduler's rid — the SAME string lands in
@@ -308,10 +491,6 @@ class Scheduler:
         release = getattr(self.backend, "release", None)
         if release is not None:
             release(slot)
-
-    def _pop_queue(self) -> _Queued | None:
-        with self._lock:
-            return self._queue.popleft() if self._queue else None
 
     def _finish_reason(self, run: _Running, now: float) -> str | None:
         req = run.request
@@ -373,21 +552,29 @@ class Scheduler:
     def stats(self) -> dict:
         """Snapshot for the serve gauges. TTFT percentiles come from a
         rolling window of the last 512 admissions, by the standard
-        nearest-rank definition (``nearest_rank_percentile`` — the
-        previous ``int(p*len)`` index was biased at small n: p50 of
-        [1,2] read 2, p95 of 20 samples read the max, not the 19th)."""
+        nearest-rank definition (``nearest_rank_percentile``)."""
         with self._lock:
             depth = len(self._queue)
             ttft_snapshot = list(self._ttft)  # tick appends under the lock
+            prio_hists = dict(self.hist_queue_wait_by_priority)
         ttft = sorted(ttft_snapshot)
 
         def pct(p: float) -> float | None:
             return nearest_rank_percentile(ttft, p)
 
-        return {
+        prefilling = [
+            s for s in self._slots if isinstance(s, _Prefilling)
+        ]
+        out = {
             "queue_depth": depth,
             "slots_busy": sum(1 for s in self._slots if s is not None),
+            "slots_prefilling": len(prefilling),
             "slots_total": len(self._slots),
+            # chunk backlog: how much staged prefill work is waiting for
+            # tick interleave slots — the gauge that shows a long prompt
+            # being fed through without stalling decode
+            "prefill_chunks_pending": sum(p.chunks_left for p in prefilling),
+            "prefill_chunks_total": self._prefill_chunks,
             "served": self._served,
             "rejected": self._rejected,
             "expired": self._expired,
@@ -407,4 +594,13 @@ class Scheduler:
             "hist_ttft": self.hist_ttft.snapshot(),
             "hist_queue_wait": self.hist_queue_wait.snapshot(),
             "hist_decode_tick": self.hist_decode_tick.snapshot(),
+            "hist_queue_wait_by_priority": {
+                p: h.snapshot() for p, h in sorted(prio_hists.items())
+            },
         }
+        prefix_stats = getattr(self.backend, "prefix_stats", None)
+        if prefix_stats is not None:
+            ps = prefix_stats()
+            if ps is not None:
+                out["prefix_cache"] = ps
+        return out

@@ -12,7 +12,10 @@ Endpoints:
   ``{"token_ids": [int]}`` plus optional ``max_new_tokens``,
   ``temperature``, ``top_k``, ``top_p``, ``seed``, ``stop`` (bool:
   finish at the tokenizer's EOS, default true), ``stop_token`` (int
-  override), ``deadline_s``. JSON out: generated ``text`` (when a
+  override), ``deadline_s``, ``priority`` (SLO class 0-9, 0 = most
+  urgent, default 1 — admission is EDF within a class), and
+  ``prefix_cache`` (bool, default true: opt this request out of
+  shared-prefix KV reuse). JSON out: generated ``text`` (when a
   tokenizer is configured) + ``token_ids`` (truncated at the stop
   token, like the ``generate`` CLI) + ``finish_reason`` + ``timing``
   (queued/TTFT/decode seconds). 400 on a malformed request, 429 when
@@ -278,6 +281,18 @@ class ServeServer:
                     f"request_id is too long ({len(request_id)} chars; "
                     "max 128)"
                 )
+        priority = doc.get("priority", 1)
+        if not isinstance(priority, int) or isinstance(priority, bool) \
+                or not 0 <= priority <= 9:
+            raise ValueError(
+                f"priority must be an integer in [0, 9] (0 = most "
+                f"urgent); got {priority!r}"
+            )
+        prefix_cache = doc.get("prefix_cache", True)
+        if not isinstance(prefix_cache, bool):
+            raise ValueError(
+                f"prefix_cache must be a boolean; got {prefix_cache!r}"
+            )
         deadline = doc.get("deadline_s", self._default_deadline_s)
         # reject impossible shapes at submit time (400), not in the loop
         backend = self._scheduler.backend
@@ -293,6 +308,8 @@ class ServeServer:
             stop_token=None if stop_token is None else int(stop_token),
             deadline_s=None if deadline is None else float(deadline),
             request_id=request_id,
+            priority=priority,
+            prefix_cache=prefix_cache,
         )
 
     # -- observability -------------------------------------------------------
@@ -317,9 +334,15 @@ class ServeServer:
             ("nanodiloco_serve_queue_depth",
              "requests waiting for a slot", s["queue_depth"]),
             ("nanodiloco_serve_slots_busy",
-             "decode slots with a live request", s["slots_busy"]),
+             "slots with a live request (prefilling or decoding)",
+             s["slots_busy"]),
+            ("nanodiloco_serve_slots_prefilling",
+             "slots mid-chunked-prefill", s.get("slots_prefilling")),
             ("nanodiloco_serve_slots_total",
              "decode slots in the engine batch", s["slots_total"]),
+            ("nanodiloco_serve_prefill_chunks_pending",
+             "staged prefill chunks waiting for a tick interleave slot",
+             s.get("prefill_chunks_pending")),
             ("nanodiloco_serve_ttft_seconds",
              "last request's time to first token", s["ttft_last_s"]),
             ("nanodiloco_serve_ttft_p50_seconds",
@@ -348,6 +371,40 @@ class ServeServer:
             "nanodiloco_serve_tokens", "counter",
             "tokens sampled (prefill + decode)", [(None, s["tokens_out"])],
         ))
+        families.append((
+            "nanodiloco_serve_prefill_chunks", "counter",
+            "prefill chunks run (one per tick interleave slot)",
+            [(None, s.get("prefill_chunks_total", 0))],
+        ))
+        # shared-prefix KV cache: the counters that tell an operator
+        # whether the system-prompt traffic is actually being reused
+        pc = s.get("prefix_cache")
+        if pc is not None:
+            families.append((
+                "nanodiloco_serve_prefix_cache_lookups", "counter",
+                "prefix-cache lookups by result",
+                [({"result": "hit"}, pc["hits"]),
+                 ({"result": "miss"}, pc["misses"])],
+            ))
+            families.append((
+                "nanodiloco_serve_prefix_cache_hit_tokens", "counter",
+                "prompt tokens served from cached prefix K/V instead of "
+                "prefill compute", [(None, pc["hit_tokens"])],
+            ))
+            families.append((
+                "nanodiloco_serve_prefix_cache_insertions", "counter",
+                "prefix chunks admitted to the cache",
+                [(None, pc["insertions"])],
+            ))
+            families.append((
+                "nanodiloco_serve_prefix_cache_evictions", "counter",
+                "prefix chunks LRU-evicted", [(None, pc["evictions"])],
+            ))
+            families.append((
+                "nanodiloco_serve_prefix_cache_tokens", "gauge",
+                "tokens currently held in cached prefix chunks",
+                [(None, pc["cached_tokens"])],
+            ))
         # real distributions (cumulative buckets + _count/_sum): what a
         # scraper can alert and aggregate on, unlike the window gauges
         for name, help_text, key in (
@@ -361,4 +418,13 @@ class ServeServer:
              "hist_decode_tick"),
         ):
             families.append((name, "histogram", help_text, s[key]))
+        by_prio = s.get("hist_queue_wait_by_priority") or {}
+        if by_prio:
+            families.append((
+                "nanodiloco_serve_queue_wait_by_priority_seconds",
+                "histogram",
+                "slot wait split by SLO priority class (0 = most urgent)",
+                [({"priority": str(p)}, snap)
+                 for p, snap in by_prio.items()],
+            ))
         return render_exposition(families)

@@ -268,6 +268,35 @@ def summarize_run(path: str) -> dict[str, Any]:
         comp = series("wire_compression")
         if comp:
             out["wire_compression"] = comp[-1]
+    # serving stack (nanodiloco_tpu/serve): a `serve --stats-jsonl`
+    # session (or any embedder logging a serve_stats record) summarizes
+    # with the same tooling as a training run — TTFT percentiles, chunk
+    # counters, and the shared-prefix cache's hit economics
+    serve = [r for r in recs if r.get("serve_stats")]
+    if serve:
+        last = serve[-1]
+        for key, out_key in (
+            ("served", "serve_served"),
+            ("rejected", "serve_rejected"),
+            ("expired", "serve_expired"),
+            ("tokens_out", "serve_tokens_out"),
+            ("prefill_chunks_total", "serve_prefill_chunks"),
+            ("ttft_p50_s", "ttft_p50_s"),
+            ("ttft_p95_s", "ttft_p95_s"),
+            ("decode_tokens_per_sec", "decode_tokens_per_sec"),
+        ):
+            if last.get(key) is not None:
+                out[out_key] = last[key]
+        pc = last.get("prefix_cache")
+        if isinstance(pc, dict):
+            out["prefix_cache_hits"] = pc.get("hits")
+            out["prefix_cache_misses"] = pc.get("misses")
+            out["prefix_cache_hit_tokens"] = pc.get("hit_tokens")
+            looked = (pc.get("hits") or 0) + (pc.get("misses") or 0)
+            if looked:
+                out["prefix_cache_hit_rate"] = round(
+                    (pc.get("hits") or 0) / looked, 4
+                )
     phase_keys = sorted(
         {k for r in recs for k in r if k.startswith("t_") and r[k] is not None}
     )
@@ -304,7 +333,21 @@ _COMPARE_METRICS = [
     # rule — so runs without a captured peak never fail on it. Shares
     # the throughput direction/threshold: it IS throughput, normalized.
     ("mfu_analytic", False),
+    # serving metrics (scripts/serve_bench.py BENCH_SERVE records and
+    # serve --stats-jsonl): latency keys gate on max_latency_increase
+    # (CPU-bench latency is noisier than loss — a dedicated threshold,
+    # not the 2% loss one), throughput keys on max_tps_drop. Only gated
+    # when both sides carry them, so training compares are untouched.
+    ("ttft_p50_s", True),
+    ("ttft_p95_s", True),
+    ("short_ttft_p95_s", True),
+    ("decode_tokens_per_sec", False),
+    ("client_tokens_per_sec", False),
 ]
+
+# serve latency keys (seconds, lower better) that use the dedicated
+# latency threshold instead of the loss one
+_LATENCY_KEYS = {"ttft_p50_s", "ttft_p95_s", "short_ttft_p95_s"}
 
 
 def load_comparable(path: str) -> dict[str, Any]:
@@ -334,6 +377,7 @@ def compare_runs(
     max_loss_increase: float = 0.02,
     max_tps_drop: float = 0.2,
     max_comm_share_increase: float = 0.05,
+    max_latency_increase: float = 0.5,
 ) -> dict[str, Any]:
     """Diff two run summaries and flag regressions — the gate that turns
     a bench trajectory into an enforced contract (``report compare``
@@ -343,9 +387,12 @@ def compare_runs(
     ``max_loss_increase`` relative; throughput regresses when it DROPS
     by more than ``max_tps_drop`` relative; comm share regresses when
     it increases by more than ``max_comm_share_increase`` ABSOLUTE
-    (shares are already ratios). Metrics present in only one summary
-    are reported but never gate — a baseline without eval numbers must
-    not fail every candidate that has them."""
+    (shares are already ratios); serve latency percentiles (TTFT keys)
+    regress when they increase by more than ``max_latency_increase``
+    relative — a wide default (+50%), because closed-loop CPU latency
+    is far noisier run to run than a loss trajectory. Metrics present
+    in only one summary are reported but never gate — a baseline
+    without eval numbers must not fail every candidate that has them."""
     metrics: dict[str, Any] = {}
     regressions: list[str] = []
     for key, lower_better in _COMPARE_METRICS:
@@ -358,6 +405,8 @@ def compare_runs(
         delta = c - b
         if key == "comm_share_last":
             regressed = delta > max_comm_share_increase
+        elif key in _LATENCY_KEYS:
+            regressed = delta > max_latency_increase * max(abs(b), 1e-12)
         elif lower_better:
             regressed = delta > max_loss_increase * max(abs(b), 1e-12)
         else:

@@ -29,6 +29,11 @@ ceremony:
      on it, drive 2 OVERLAPPING requests over a real socket, and scrape
      the serve gauges off /metrics — continuous batching proven on the
      chip end to end.
+  7. a serve-interference drill: one LONG prompt plus concurrent short
+     streams against the chunked-prefill engine — short-stream TTFT
+     must stay bounded while the long prefill is in flight, the shared
+     prefix must hit the cache, and the chunk/prefix/priority gauges
+     are scraped — the PR-6 serving tier proven on the chip.
 
 Usage (each phase also runs alone):
     python scripts/chip_agenda.py               # everything
@@ -665,6 +670,192 @@ def phase_serve() -> None:
                 proc.kill()
 
 
+def phase_serve_interference() -> None:
+    """Chunked-prefill interference drill on this backend: launch the
+    `serve` CLI (chunked prefill + prefix cache on), submit ONE long
+    prompt and, while it is mid-prefill, concurrent short streams —
+    short-stream TTFT must stay under an absolute ceiling (a
+    short-vs-long comparison is deliberately NOT asserted: on a fast
+    backend the long prefill can finish before the shorts arrive, and
+    both TTFTs are recorded in the ledger for inspection), the shorts
+    share a primed prefix so the cache takes hits, the long prompt
+    provably went through in chunks, and the new gauges (prefill
+    chunks, prefix-cache counters, per-priority queue wait) are
+    scraped off /metrics into the ledger."""
+    import socket
+    import tempfile
+    import threading
+
+    from nanodiloco_tpu.obs.telemetry import parse_metrics_text
+    from nanodiloco_tpu.serve.client import http_get, http_post_json
+
+    tmp = tempfile.mkdtemp(prefix="nanodiloco-serve-intf-")
+    ckpt = os.path.join(tmp, "ckpt")
+    model_cfg = os.path.join(tmp, "model.json")
+    with open(model_cfg, "w") as f:
+        json.dump({
+            "vocab_size": 2048, "hidden_size": 128, "intermediate_size": 256,
+            "num_attention_heads": 4, "num_hidden_layers": 2,
+            "max_position_embeddings": 256,
+        }, f)
+    budget = float(
+        os.environ.get("NANODILOCO_AGENDA_TIMEOUT_SERVE_INTERFERENCE", "900")
+    )
+    train = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu",
+         "--total-steps", "4", "--inner-steps", "2",
+         "--batch-size", "8", "--per-device-batch-size", "4",
+         "--seq-length", "256", "--warmup-steps", "2",
+         "--llama-config-file", model_cfg, "--no-measure-comm",
+         "--no-cost-analysis", "--quiet",
+         "--checkpoint-dir", ckpt, "--log-dir", tmp,
+         "--run-name", "serve-intf-probe"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=budget * 0.4,
+    )
+    if train.returncode != 0:
+        record({"phase": "serve_interference",
+                "error": (train.stderr or train.stdout)[-400:]})
+        raise SystemExit(1)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nanodiloco_tpu", "serve",
+         "--checkpoint-dir", ckpt, "--port", str(port),
+         "--host", "127.0.0.1", "--slots", "4", "--max-len", "256",
+         "--max-new-tokens-cap", "64", "--chunk-size", "16",
+         "--prefix-cache-tokens", "1024"],
+        cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+    def get(path):
+        return http_get(f"http://127.0.0.1:{port}{path}", timeout=5)
+
+    def post(doc, timeout=300):
+        return http_post_json(
+            f"http://127.0.0.1:{port}/v1/generate", doc, timeout=timeout
+        )
+
+    try:
+        deadline = time.time() + budget * 0.3
+        up = False
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                up = get("/healthz")[0] == 200
+            except OSError:
+                up = False
+            if up:
+                break
+            time.sleep(0.3)
+        if not up:
+            record({"phase": "serve_interference",
+                    "error": "server never answered /healthz"})
+            raise SystemExit(1)
+        # warm the compile set (chunk buckets for BOTH request shapes +
+        # decode) outside the measured window, then fire the pattern
+        for warm in (
+            {"token_ids": list(range(2, 202)), "max_new_tokens": 2,
+             "stop": False, "prefix_cache": False},
+            {"token_ids": list(range(2, 20)), "max_new_tokens": 2,
+             "stop": False, "prefix_cache": False},
+        ):
+            code, out = post(warm)
+            if code != 200:
+                record({"phase": "serve_interference",
+                        "error": f"warmup failed {code}: {out.get('error')}"})
+                raise SystemExit(1)
+        shared = [int(t) for t in range(100, 116)]  # one 16-token chunk
+        # prime the shared prefix: lookups happen at ADMISSION, so the
+        # burst below only hits if an earlier completed prefill cached
+        # the chunk (exactly the system-prompt pattern: first request
+        # pays, the fleet reuses)
+        code, out = post({"token_ids": shared + [3, 4],
+                          "max_new_tokens": 2, "stop": False, "seed": 99})
+        if code != 200:
+            record({"phase": "serve_interference",
+                    "error": f"prefix prime failed {code}: {out.get('error')}"})
+            raise SystemExit(1)
+        results: dict[str, tuple] = {}
+
+        def fire(name, doc):
+            results[name] = post(doc)
+
+        # token ids stay under 256: the trained checkpoint's vocab snaps
+        # to the tokenizer's size, smaller than the config file's
+        long_doc = {"token_ids": [(i * 11 + 5) % 256 for i in range(200)],
+                    "max_new_tokens": 16, "stop": False,
+                    "prefix_cache": False, "seed": 1}
+        shorts = {
+            f"short{i}": {"token_ids": shared + [7 + i, 9 + i],
+                          "max_new_tokens": 8, "stop": False,
+                          "priority": 0, "seed": 10 + i}
+            for i in range(3)
+        }
+        t_long = threading.Thread(target=fire, args=("long", long_doc))
+        t_long.start()
+        time.sleep(0.02)  # the long admission goes first; shorts land
+        t_shorts = [threading.Thread(target=fire, args=(n, d))
+                    for n, d in shorts.items()]
+        for t in t_shorts:
+            t.start()
+        for t in [t_long, *t_shorts]:
+            t.join(timeout=budget * 0.3)
+        bad = {n: r for n, r in results.items() if r[0] != 200}
+        if len(results) < 4 or bad:
+            record({"phase": "serve_interference",
+                    "error": f"requests failed: {bad or 'client hung'}"})
+            raise SystemExit(1)
+        long_ttft = results["long"][1]["timing"]["ttft_s"]
+        short_ttfts = [results[n][1]["timing"]["ttft_s"] for n in shorts]
+        bound = float(
+            os.environ.get("NANODILOCO_AGENDA_SHORT_TTFT_BOUND_S", "10")
+        )
+        m = parse_metrics_text(get("/metrics")[1])
+        chunks = m.get("nanodiloco_serve_prefill_chunks_total", 0)
+        hits = m.get(
+            'nanodiloco_serve_prefix_cache_lookups_total{result="hit"}', 0
+        )
+        # the contract: short first tokens stay bounded while the long
+        # prompt is fed through in chunks (>= 13 for 200 tokens at
+        # chunk 16 — whole-prompt prefill would show far fewer), and
+        # the shared 16-token prefix was reused, not recomputed
+        if max(short_ttfts) > bound or chunks < 13 or hits < 2:
+            record({"phase": "serve_interference",
+                    "error": "short-stream TTFT not bounded (or the "
+                             "engine did not chunk/reuse prefixes)",
+                    "short_ttft_s": short_ttfts,
+                    "long_ttft_s": long_ttft,
+                    "prefill_chunks": chunks, "prefix_hits": hits})
+            raise SystemExit(1)
+        record({
+            "phase": "serve_interference",
+            "long_ttft_s": round(long_ttft, 3),
+            "short_ttft_s": [round(t, 3) for t in short_ttfts],
+            "scraped": {
+                k: m[k] for k in (
+                    "nanodiloco_serve_prefill_chunks_total",
+                    'nanodiloco_serve_prefix_cache_lookups_total{result="hit"}',
+                    'nanodiloco_serve_prefix_cache_lookups_total{result="miss"}',
+                    "nanodiloco_serve_prefix_cache_hit_tokens_total",
+                    "nanodiloco_serve_prefix_cache_tokens",
+                    'nanodiloco_serve_queue_wait_by_priority_seconds_count{priority="0"}',
+                    "nanodiloco_serve_ttft_p95_seconds",
+                ) if k in m
+            },
+        })
+    finally:
+        import signal as _signal
+
+        if proc.poll() is None:
+            proc.send_signal(_signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 PHASES = {
     "bench": phase_bench,
     "sweep": phase_sweep,
@@ -674,6 +865,7 @@ PHASES = {
     "live_profile": phase_live_profile,
     "resilience": phase_resilience,
     "serve": phase_serve,
+    "serve_interference": phase_serve_interference,
 }
 
 
@@ -714,6 +906,7 @@ PHASE_TIMEOUT_S = {
     "live_profile": 900,
     "resilience": 1200,
     "serve": 900,
+    "serve_interference": 900,
 }
 
 

@@ -7,13 +7,40 @@ report TTFT p50/p95 and aggregate decode tokens/s — the serving twin of
 ``bench.py``'s training numbers, emitted as one ``BENCH_SERVE`` JSON
 line on stdout.
 
+Workloads:
+- ``uniform`` (default): every client cycles through ``--prompt-lens``
+  with unique random prompts — the PR-4 throughput shape.
+- ``mixed``: the interference + shared-prefix scenario the chunked-
+  prefill/prefix-cache engine exists for. ``--long-clients`` clients
+  stream ``--long-prompt-len``-token prompts (unique content, prefix
+  cache opted OUT so they cannot evict the shared prefix) while the
+  short clients all open with the same ``--shared-prefix-len``-token
+  system prefix plus a unique tail. Short arrivals are OPEN-LOOP (one
+  every ``--short-interval-s``, regardless of completions): a closed
+  loop self-synchronizes away from the stall — a short's next request
+  is only submitted after its previous answer, and answers cannot
+  arrive while a monolithic prefill holds the tick loop, so closed-loop
+  shorts systematically land right AFTER the stall window and report
+  flattering TTFTs (PERF.md measurement rules). The record splits TTFT
+  by class: ``short_ttft_p95_s`` is the headline — with whole-prompt
+  prefill a long admission stalls every short stream's first token;
+  with chunked prefill it must stay bounded — and the prefix-cache
+  counters show the shared prefix being computed once, not per request.
+
 By default the model is a random-init tiny Llama (shape knobs below) so
 the bench runs anywhere, CPU included; ``--checkpoint-dir`` serves a
 real trained checkpoint instead. Examples:
 
     python scripts/serve_bench.py                      # tiny, defaults
     python scripts/serve_bench.py --clients 16 --slots 8 --max-new-tokens 64
-    python scripts/serve_bench.py --checkpoint-dir runs/ckpt --slots 4
+    python scripts/serve_bench.py --workload mixed     # interference bench
+    python scripts/serve_bench.py --workload mixed --chunk-size 256
+                                   # ~unchunked: one bucket swallows all
+
+The committed CPU record lives in ``bench_serve_baseline.json``;
+``python -m nanodiloco_tpu report compare bench_serve_baseline.json
+out.json`` gates a candidate run against it (TTFT keys regress on
+``--max-latency-increase``, throughput on ``--max-tps-drop``).
 """
 
 from __future__ import annotations
@@ -35,16 +62,45 @@ def build_parser() -> argparse.ArgumentParser:
                         "random-init tiny model (throughput-shaped, "
                         "content-free)")
     p.add_argument("--step", type=int, default=None)
+    p.add_argument("--workload", choices=("uniform", "mixed"),
+                   default="uniform",
+                   help="uniform: every client cycles --prompt-lens; "
+                        "mixed: long-prompt interference + shared-prefix "
+                        "short traffic (see module docstring)")
     p.add_argument("--slots", type=int, default=4)
     p.add_argument("--max-len", type=int, default=256)
     p.add_argument("--max-queue", type=int, default=256)
+    p.add_argument("--chunk-size", type=int, default=64,
+                   help="engine prefill chunk size (bucketed to powers "
+                        "of two; >= --max-len approximates the unchunked "
+                        "whole-prompt engine)")
+    p.add_argument("--prefix-cache-tokens", type=int, default=4096,
+                   help="shared-prefix KV cache capacity in tokens; 0 "
+                        "disables")
     p.add_argument("--clients", type=int, default=8,
-                   help="concurrent closed-loop client threads")
+                   help="concurrent closed-loop (short) client threads")
     p.add_argument("--requests-per-client", type=int, default=4)
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--prompt-lens", type=str, default="8,24,64",
                    help="comma-separated prompt lengths, cycled across "
-                        "requests (mixed prefill shapes)")
+                        "requests (mixed prefill shapes; in --workload "
+                        "mixed these are the short clients' TAIL lengths "
+                        "after the shared prefix)")
+    p.add_argument("--long-clients", type=int, default=1,
+                   help="[mixed] clients streaming long prompts")
+    p.add_argument("--short-interval-s", type=float, default=0.4,
+                   help="[mixed] open-loop short-request arrival spacing "
+                        "in seconds (shorts fire on this schedule no "
+                        "matter what's in flight — the only honest way "
+                        "to observe prefill interference)")
+    p.add_argument("--long-prompt-len", type=int, default=160,
+                   help="[mixed] long-prompt length in tokens")
+    p.add_argument("--shared-prefix-len", type=int, default=64,
+                   help="[mixed] shared system-prefix length prepended "
+                        "to every short request (the prefix cache is "
+                        "chunk-granular: a prefix shorter than one "
+                        "chunk never caches, so keep this >= "
+                        "--chunk-size)")
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--top-k", type=int, default=20)
     p.add_argument("--seed", type=int, default=0)
@@ -95,6 +151,8 @@ def main() -> None:
     engine = InferenceEngine(
         params, cfg, num_slots=args.slots,
         max_len=min(args.max_len, cfg.max_position_embeddings),
+        chunk_size=args.chunk_size,
+        prefix_cache_tokens=args.prefix_cache_tokens,
     )
     server = ServeServer(
         Scheduler(engine, max_queue=args.max_queue),
@@ -102,22 +160,33 @@ def main() -> None:
     ).start()
     lens = [int(x) for x in args.prompt_lens.split(",") if x]
     rng = __import__("random").Random(args.seed)
+    mixed = args.workload == "mixed"
+    shared_prefix = (
+        [rng.randrange(cfg.vocab_size) for _ in range(args.shared_prefix_len)]
+        if mixed else []
+    )
 
     def post(doc: dict) -> tuple[int, dict]:
         return http_post_json(
             f"http://127.0.0.1:{server.port}/v1/generate", doc
         )
 
-    # warmup: compile the decode tick + each prefill shape outside the
-    # timed window (one request per distinct prompt length). A failed
-    # warmup would silently move compilation INTO the timed window and
-    # corrupt the TTFT percentiles, so it is a hard error.
+    # warmup: compile the decode tick + every prefill chunk bucket the
+    # run will touch, outside the timed window. Chunked prefill bounds
+    # the bucket set, but a failed warmup would still silently move
+    # compilation INTO the timed window and corrupt the TTFT
+    # percentiles, so it is a hard error. Warmup prompts are unique
+    # random content: the shared prefix stays COLD until the window.
+    warm_lens = set(len(shared_prefix) + p for p in lens) | set(lens)
+    if mixed:
+        warm_lens.add(args.long_prompt_len)
     warm_new = min(2, args.max_new_tokens)
-    for n, p_len in enumerate(sorted(set(lens))):
+    for n, p_len in enumerate(sorted(warm_lens)):
         code, out = post({
             "token_ids": [(i * 7 + 3) % cfg.vocab_size for i in range(p_len)],
             "max_new_tokens": warm_new, "temperature": args.temperature,
             "top_k": args.top_k, "seed": 10_000 + n, "stop": False,
+            "prefix_cache": False,
         })
         if code != 200:
             server.stop()
@@ -131,24 +200,63 @@ def main() -> None:
     errors: list[tuple[int, dict]] = []
     lock = threading.Lock()
 
-    def client(cid: int) -> None:
+    def run_request(doc: dict, cls: str) -> None:
+        code, out = post(doc)
+        with lock:
+            if code == 200:
+                out["_class"] = cls
+                results.append(out)
+            else:
+                errors.append((code, out))
+
+    t_start = time.monotonic()
+
+    def short_client(cid: int) -> None:
+        workers = []
         for r in range(args.requests_per_client):
-            p_len = lens[(cid + r) % len(lens)]
-            ids = [rng.randrange(cfg.vocab_size) for _ in range(p_len)]
-            code, out = post({
-                "token_ids": ids, "max_new_tokens": args.max_new_tokens,
+            tail_len = lens[(cid + r) % len(lens)]
+            tail = [rng.randrange(cfg.vocab_size) for _ in range(tail_len)]
+            doc = {
+                "token_ids": shared_prefix + tail,
+                "max_new_tokens": args.max_new_tokens,
                 "temperature": args.temperature, "top_k": args.top_k,
                 "seed": cid * 1000 + r, "stop": False,
-            })
-            with lock:
-                if code == 200:
-                    results.append(out)
-                else:
-                    errors.append((code, out))
+            }
+            if mixed:
+                # open-loop: fire on the global arrival schedule (client
+                # arrivals interleaved) whether or not earlier requests
+                # answered — each in-flight request gets its own thread
+                due = t_start + (cid + r * args.clients) * args.short_interval_s
+                delay = due - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                w = threading.Thread(target=run_request, args=(doc, "short"))
+                w.start()
+                workers.append(w)
+            else:
+                run_request(doc, "short")
+        for w in workers:
+            w.join()
 
-    t0 = time.monotonic()
-    threads = [threading.Thread(target=client, args=(c,))
+    def long_client(cid: int) -> None:
+        for r in range(args.requests_per_client):
+            ids = [rng.randrange(cfg.vocab_size)
+                   for _ in range(args.long_prompt_len)]
+            run_request({
+                "token_ids": ids, "max_new_tokens": args.max_new_tokens,
+                "temperature": args.temperature, "top_k": args.top_k,
+                "seed": 500_000 + cid * 1000 + r, "stop": False,
+                # unique content: caching it would only churn the shared
+                # prefix out — the per-request opt-out exists for this
+                "prefix_cache": False,
+            }, "long")
+
+    threads = [threading.Thread(target=short_client, args=(c,))
                for c in range(args.clients)]
+    if mixed:
+        threads += [threading.Thread(target=long_client, args=(c,))
+                    for c in range(args.long_clients)]
+    t0 = time.monotonic()
     for t in threads:
         t.start()
     for t in threads:
@@ -157,7 +265,14 @@ def main() -> None:
 
     stats = server._scheduler.stats()
     server.stop()
-    ttfts = sorted(r["timing"]["ttft_s"] for r in results)
+
+    def ttfts(cls=None):
+        return sorted(
+            r["timing"]["ttft_s"] for r in results
+            if cls is None or r["_class"] == cls
+        )
+
+    all_ttft = ttfts()
     completion = sum(r["completion_tokens"] for r in results)
     rec = {
         "metric": "BENCH_SERVE",
@@ -168,7 +283,10 @@ def main() -> None:
             or f"random-init llama (hidden {cfg.hidden_size} x "
                f"{cfg.num_hidden_layers}L, vocab {cfg.vocab_size})"
         ),
+        "workload": args.workload,
         "slots": args.slots,
+        "chunk_size": engine.chunk_size,
+        "prefix_cache_tokens": args.prefix_cache_tokens,
         "clients": args.clients,
         "requests": len(results),
         "rejected_or_failed": len(errors),
@@ -176,8 +294,8 @@ def main() -> None:
         "max_new_tokens": args.max_new_tokens,
         "wall_s": round(wall_s, 3),
         "requests_per_sec": round(len(results) / wall_s, 3) if wall_s else None,
-        "ttft_p50_s": round(_pct(ttfts, 0.50), 4) if ttfts else None,
-        "ttft_p95_s": round(_pct(ttfts, 0.95), 4) if ttfts else None,
+        "ttft_p50_s": round(_pct(all_ttft, 0.50), 4) if all_ttft else None,
+        "ttft_p95_s": round(_pct(all_ttft, 0.95), 4) if all_ttft else None,
         "completion_tokens": completion,
         "client_tokens_per_sec": (
             round(completion / wall_s, 1) if wall_s else None
@@ -186,7 +304,33 @@ def main() -> None:
             round(stats["decode_tokens_per_sec"], 1)
             if stats["decode_tokens_per_sec"] else None
         ),
+        "prefill_chunks": stats.get("prefill_chunks_total"),
     }
+    if mixed:
+        short, long_ = ttfts("short"), ttfts("long")
+        rec.update({
+            "long_clients": args.long_clients,
+            "long_prompt_len": args.long_prompt_len,
+            "shared_prefix_len": args.shared_prefix_len,
+            "short_interval_s": args.short_interval_s,
+            "short_requests": len(short),
+            "short_ttft_p50_s": (
+                round(_pct(short, 0.50), 4) if short else None
+            ),
+            "short_ttft_p95_s": (
+                round(_pct(short, 0.95), 4) if short else None
+            ),
+            "long_ttft_p50_s": (
+                round(_pct(long_, 0.50), 4) if long_ else None
+            ),
+        })
+    pc = stats.get("prefix_cache")
+    if pc:
+        rec.update({
+            "prefix_hits": pc["hits"],
+            "prefix_misses": pc["misses"],
+            "prefix_hit_tokens": pc["hit_tokens"],
+        })
     print(json.dumps(rec), flush=True)
 
 

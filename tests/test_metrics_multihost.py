@@ -188,6 +188,64 @@ def test_summarize_run(tmp_path):
     assert "moe_dropped_frac_last" not in s2 and "quarantine_events" not in s2
 
 
+def test_summarize_run_serve_stats(tmp_path):
+    """A `serve --stats-jsonl` record summarizes with the same tooling
+    as a training run: TTFT percentiles, chunk counters, and the
+    prefix-cache hit economics (incl. the derived hit rate)."""
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    path = tmp_path / "serve.jsonl"
+    rec = {
+        "serve_stats": True, "served": 9, "rejected": 1, "expired": 2,
+        "tokens_out": 140, "prefill_chunks_total": 33,
+        "ttft_p50_s": 0.1, "ttft_p95_s": 0.4,
+        "decode_tokens_per_sec": 55.0,
+        "prefix_cache": {"hits": 3, "misses": 1, "hit_tokens": 192},
+    }
+    with open(path, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    s = summarize_run(str(path))
+    assert s["serve_served"] == 9 and s["serve_rejected"] == 1
+    assert s["serve_prefill_chunks"] == 33
+    assert s["ttft_p95_s"] == 0.4
+    assert s["decode_tokens_per_sec"] == 55.0
+    assert s["prefix_cache_hits"] == 3
+    assert s["prefix_cache_hit_tokens"] == 192
+    assert s["prefix_cache_hit_rate"] == 0.75
+    # a training run without serve records grows none of these keys
+    path2 = tmp_path / "train.jsonl"
+    with open(path2, "w") as f:
+        f.write(json.dumps({"loss": 2.0, "outer_synced": 1, "step": 1}) + "\n")
+    assert "serve_served" not in summarize_run(str(path2))
+
+
+def test_compare_runs_gates_serve_latency_keys():
+    """Serve latency keys gate on max_latency_increase (relative,
+    lower-better); throughput keys on max_tps_drop; keys on only one
+    side never gate (a training baseline must not fail a serve
+    candidate and vice versa)."""
+    from nanodiloco_tpu.training.metrics import compare_runs
+
+    base = {"short_ttft_p95_s": 0.25, "decode_tokens_per_sec": 15.0}
+    ok = compare_runs(base, {"short_ttft_p95_s": 0.30,
+                             "decode_tokens_per_sec": 14.0})
+    assert not ok["regressions"]
+    bad = compare_runs(base, {"short_ttft_p95_s": 0.60,
+                              "decode_tokens_per_sec": 15.0})
+    assert any("short_ttft_p95_s" in r for r in bad["regressions"])
+    slow = compare_runs(base, {"short_ttft_p95_s": 0.25,
+                               "decode_tokens_per_sec": 5.0})
+    assert any("decode_tokens_per_sec" in r for r in slow["regressions"])
+    # tighter threshold flips the borderline case
+    tight = compare_runs(base, {"short_ttft_p95_s": 0.30,
+                                "decode_tokens_per_sec": 15.0},
+                         max_latency_increase=0.1)
+    assert any("short_ttft_p95_s" in r for r in tight["regressions"])
+    # one-sided keys: reported, never gating
+    onesided = compare_runs(base, {"loss": 3.0})
+    assert not onesided["regressions"]
+
+
 def test_report_cli(tmp_path, capsys):
     from nanodiloco_tpu.cli import main
 

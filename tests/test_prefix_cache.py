@@ -1,0 +1,110 @@
+"""PrefixCache policy units (nanodiloco_tpu/serve/prefix_cache):
+chunk-granular matching, the last-token cap, LRU eviction under the
+token-capacity bound, and the observability counters — all model-free
+(blocks are opaque sentinels), deterministic, tier-1."""
+
+import pytest
+
+from nanodiloco_tpu.serve.prefix_cache import PrefixCache
+
+
+def _fill(cache: PrefixCache, prompt, n_chunks):
+    """Insert ``n_chunks`` chunks of ``prompt`` with sentinel blocks
+    naming their chunk index."""
+    return cache.insert(prompt, n_chunks, lambda i: ("blk", tuple(prompt), i))
+
+
+def test_constructor_validates():
+    with pytest.raises(ValueError, match="chunk_tokens"):
+        PrefixCache(16, 0)
+    with pytest.raises(ValueError, match="capacity_tokens"):
+        PrefixCache(3, 4)  # cannot hold even one chunk
+
+
+def test_prefix_shorter_than_one_chunk_never_caches():
+    c = PrefixCache(capacity_tokens=16, chunk_tokens=4)
+    assert _fill(c, [1, 2, 3], 0) == 0
+    assert c.match([1, 2, 3, 9]) == []
+    assert c.stats()["misses"] == 1 and c.stats()["hits"] == 0
+    assert c.cached_tokens == 0
+
+
+def test_match_walks_chunks_and_stops_at_first_gap():
+    c = PrefixCache(capacity_tokens=64, chunk_tokens=4)
+    prompt = list(range(12))
+    assert _fill(c, prompt, 3) == 3
+    # full-chain hit (cap permitting): 13-token prompt may reuse 3 chunks
+    blocks = c.match(prompt + [99])
+    assert [b[2] for b in blocks] == [0, 1, 2]
+    # diverging at token 5 (inside chunk 2): only chunk 1 matches
+    blocks = c.match([0, 1, 2, 3, 4, 77, 6, 7, 8])
+    assert [b[2] for b in blocks] == [0]
+    # diverging inside chunk 1: nothing matches
+    assert c.match([0, 1, 77, 3, 4, 5]) == []
+    s = c.stats()
+    assert s["hits"] == 2 and s["misses"] == 1
+    assert s["hit_tokens"] == 12 + 4
+
+
+def test_hit_capped_so_last_prompt_token_always_prefills():
+    c = PrefixCache(capacity_tokens=64, chunk_tokens=4)
+    prompt = list(range(8))
+    _fill(c, prompt, 2)
+    # the prompt IS the cached prefix: max_chunks = (8-1)//4 = 1 — the
+    # final token's logits must come from real prefill compute
+    blocks = c.match(prompt)
+    assert [b[2] for b in blocks] == [0]
+    # one token longer: both chunks reusable
+    assert [b[2] for b in c.match(prompt + [42])] == [0, 1]
+
+
+def test_insert_skips_cached_chunks_and_reports_new_ones():
+    c = PrefixCache(capacity_tokens=64, chunk_tokens=4)
+    prompt = list(range(12))
+    assert _fill(c, prompt, 2) == 2
+    calls = []
+
+    def extract(i):
+        calls.append(i)
+        return ("blk", i)
+
+    # chunks 0-1 already cached: only chunk 2 is extracted (the device
+    # copy is paid only for genuinely new chunks)
+    assert c.insert(prompt, 3, extract) == 1
+    assert calls == [2]
+    assert c.stats()["insertions"] == 3
+
+
+def test_lru_eviction_under_token_capacity():
+    c = PrefixCache(capacity_tokens=8, chunk_tokens=4)  # holds 2 chunks
+    a, b, d = [1] * 4, [2] * 4, [3] * 4
+    _fill(c, a, 1)
+    _fill(c, b, 1)
+    assert c.cached_tokens == 8
+    c.match(a + [9])          # bump a: b is now LRU
+    _fill(c, d, 1)            # evicts b
+    assert c.stats()["evictions"] == 1
+    assert c.match(b + [9]) == []          # b is gone
+    assert [x[1] for x in c.match(a + [9])] == [(1, 1, 1, 1)]
+    assert c.match(d + [9]) != []
+    assert c.cached_tokens == 8            # still capacity-bounded
+
+
+def test_chain_longer_than_capacity_not_inserted():
+    c = PrefixCache(capacity_tokens=8, chunk_tokens=4)
+    prompt = list(range(16))  # 4 chunks; only 2 fit
+    assert _fill(c, prompt, 4) == 2
+    # an intact 2-chunk prefix is still reusable; the unreachable tail
+    # never evicted it
+    assert len(c.match(prompt)) == 2
+    assert c.stats()["evictions"] == 0
+
+
+def test_stats_shape():
+    c = PrefixCache(capacity_tokens=16, chunk_tokens=4)
+    s = c.stats()
+    assert s == {
+        "hits": 0, "misses": 0, "hit_tokens": 0, "insertions": 0,
+        "evictions": 0, "cached_tokens": 0, "capacity_tokens": 16,
+        "chunk_tokens": 4,
+    }

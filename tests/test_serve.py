@@ -129,6 +129,119 @@ def test_stop_token_retires_slot_and_matches_generate(params):
     assert ticket.result["tokens"] == ref
 
 
+def test_chunked_prefill_boundary_parity(params):
+    """Chunk-boundary bit-parity: with chunk_size=4, prompts whose
+    lengths straddle every boundary case (< chunk, == chunk, chunk+1,
+    several chunks, several+1) admit OVERLAPPING through the chunked
+    path — interior chunks, a bucketed final chunk, and the right-padded
+    single-chunk case all land — and every stream is bit-identical to
+    its solo generate() run."""
+    eng = InferenceEngine(params, CFG, num_slots=2, max_len=32,
+                          chunk_size=4)
+    sched = Scheduler(eng)
+    lens = [3, 4, 5, 8, 13]
+    reqs = [
+        GenRequest(
+            prompt=tuple((7 * i + 3 * j) % 50 + 1 for j in range(n)),
+            max_new_tokens=4, temperature=0.8, top_k=12, seed=40 + i,
+        )
+        for i, n in enumerate(lens)
+    ]
+    with jax.default_matmul_precision("highest"):
+        tickets = [sched.submit(r) for r in reqs]
+        for _ in range(80):
+            if sched.tick() == 0 and all(t.done() for t in tickets):
+                break
+        refs = [_reference(params, r) for r in reqs]
+    for ticket, ref in zip(tickets, refs):
+        assert ticket.result["finish_reason"] == "length"
+        assert ticket.result["tokens"] == ref
+    # every prompt ran exactly ceil(n/4) chunks (no cache, no retries)
+    assert sched.stats()["prefill_chunks_total"] == sum(
+        -(-n // 4) for n in lens
+    )
+
+
+def test_prefix_cache_hit_parity_and_counters(params):
+    """Cached-prefix admission bit-parity: requests B and D share A's
+    chunk-aligned prefix — their admission copies A's cached K/V rows
+    and prefills only the suffix — and C opts out. All four streams are
+    bit-identical to solo generate(); the counters prove B and D
+    genuinely reused cached chunks (D's whole prompt IS the prefix, so
+    the reuse is capped one chunk short: the last token must prefill
+    for real to seed the first sample)."""
+    eng = InferenceEngine(params, CFG, num_slots=2, max_len=32,
+                          chunk_size=4, prefix_cache_tokens=64)
+    sched = Scheduler(eng)
+    prefix = (5, 9, 2, 11, 3, 8, 1, 7)  # exactly two whole chunks
+    reqs = [
+        GenRequest(prompt=prefix + (4, 6), max_new_tokens=4,
+                   temperature=0.7, top_k=16, seed=3),
+        GenRequest(prompt=prefix + (2, 10, 12), max_new_tokens=5,
+                   temperature=0.9, top_p=0.9, seed=8),
+        GenRequest(prompt=prefix + (1,), max_new_tokens=3, seed=5,
+                   prefix_cache=False),
+        GenRequest(prompt=prefix, max_new_tokens=4, temperature=0.6,
+                   seed=21),
+    ]
+    with jax.default_matmul_precision("highest"):
+        ta = sched.submit(reqs[0])
+        for _ in range(20):  # A completes and populates the cache
+            if sched.tick() == 0 and ta.done():
+                break
+        others = [sched.submit(r) for r in reqs[1:]]
+        for _ in range(40):
+            if sched.tick() == 0 and all(t.done() for t in others):
+                break
+        refs = [_reference(params, r) for r in reqs]
+    for ticket, ref in zip([ta, *others], refs):
+        assert ticket.result["tokens"] == ref
+    ps = eng.prefix_stats()
+    # A missed; B hit 2 chunks (8 tokens); C opted out (no lookup at
+    # all); D hit but capped at 1 chunk (4 tokens)
+    assert ps["hits"] == 2 and ps["misses"] == 1
+    assert ps["hit_tokens"] == 8 + 4
+    assert ps["insertions"] >= 2
+    assert sched.stats()["prefix_cache"]["hits"] == 2
+
+
+def test_compile_count_bounded_across_mixed_lengths():
+    """The recompile-trap pin: mixed-length admissions compile chunk
+    programs only for the power-of-two bucket set (<= log2(chunk)+1),
+    NOT one executable per prompt length, and exactly one decode/sample
+    program each. Uses its own config so the jit caches under count
+    start empty."""
+    cfg2 = LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_attention_heads=2, num_hidden_layers=1,
+        max_position_embeddings=64,
+    )
+    params2 = init_params(jax.random.key(1), cfg2)
+    eng = InferenceEngine(params2, cfg2, num_slots=2, max_len=64,
+                          chunk_size=8, prefix_cache_tokens=64)
+    sched = Scheduler(eng)
+    lens = [1, 2, 3, 5, 7, 8, 9, 12, 15, 17, 23, 31]
+    tickets = [
+        sched.submit(GenRequest(prompt=tuple((i + j) % 60 for j in range(n)),
+                                max_new_tokens=2, seed=i))
+        for i, n in enumerate(lens)
+    ]
+    for _ in range(200):
+        if sched.tick() == 0 and all(t.done() for t in tickets):
+            break
+    assert all(t.done() for t in tickets)
+    counts = eng.compile_counts()
+    if counts["prefill_chunk"] is None:
+        pytest.skip("jit cache introspection unavailable on this jax")
+    # 12 distinct prompt lengths -> at most the 4 bucket lengths
+    # {1, 2, 4, 8} ever compile (the PR-4 path compiled 12)
+    assert 1 <= counts["prefill_chunk"] <= 4
+    assert counts["decode"] == 1
+    assert counts["sample"] == 1
+    assert counts["extract"] in (None, 0, 1)
+    assert counts["insert"] in (None, 0, 1)
+
+
 def test_engine_validates_impossible_requests(params):
     eng = InferenceEngine(params, CFG, num_slots=1, max_len=16)
     with pytest.raises(ValueError, match="max_len"):
@@ -272,8 +385,12 @@ def test_queue_full_returns_429():
             self.gate = threading.Event()
             self.seed = None
 
-        def prefill(self, slot, request):
-            self.seed = request.seed
+        def start_prefill(self, slot, request):
+            self._staged = request.seed
+            return 1
+
+        def prefill_step(self, slot):
+            self.seed = self._staged
             return 1
 
         def step(self):
@@ -331,7 +448,10 @@ def test_healthz_flips_503_when_the_loop_dies():
     class DoomedBackend:
         num_slots = 1
 
-        def prefill(self, slot, request):
+        def start_prefill(self, slot, request):
+            return 1
+
+        def prefill_step(self, slot):
             return 1
 
         def step(self):

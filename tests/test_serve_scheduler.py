@@ -1,7 +1,8 @@
-"""Scheduler unit tests (nanodiloco_tpu/serve/scheduler): admission,
-slot refill mid-decode, EOS retirement, queue-full backpressure, and
-deadline expiry — all against a scripted fake backend and an injected
-clock. Deterministic, model-free, tier-1."""
+"""Scheduler unit tests (nanodiloco_tpu/serve/scheduler): SLO-ordered
+admission (priority classes, EDF, starvation bound), chunked-prefill
+interleaving, slot refill mid-decode, EOS retirement, queue-full
+backpressure, and deadline expiry — all against a scripted fake backend
+and an injected clock. Deterministic, model-free, tier-1."""
 
 import pytest
 
@@ -20,23 +21,40 @@ class FakeClock:
 
 
 class FakeBackend:
-    """Scripted slot backend: each request's token stream comes from its
-    seed (``scripts[seed]``); prefill returns the first token, every
-    step returns each live slot's next. Records the call sequence so
-    tests can assert scheduling decisions, not just outcomes."""
+    """Scripted slot backend speaking the chunked surface: each
+    request's token stream comes from its seed (``scripts[seed]``);
+    ``chunks[seed]`` (default 1) is how many ``prefill_step`` calls its
+    prefill takes — the final one returns the first token. Records the
+    call sequence so tests can assert scheduling decisions, not just
+    outcomes."""
 
-    def __init__(self, num_slots: int, scripts: dict[int, list[int]]) -> None:
+    def __init__(self, num_slots: int, scripts: dict[int, list[int]],
+                 chunks: dict[int, int] | None = None) -> None:
         self.num_slots = num_slots
         self.scripts = scripts
+        self.chunks = chunks or {}
         self.cursor: list[int] = [0] * num_slots
         self.seed_at: list[int | None] = [None] * num_slots
+        self.pending: list[list | None] = [None] * num_slots
         self.log: list[tuple] = []
 
-    def prefill(self, slot: int, request: GenRequest) -> int:
-        self.log.append(("prefill", slot, request.seed))
-        self.seed_at[slot] = request.seed
+    def start_prefill(self, slot: int, request: GenRequest) -> int:
+        n = self.chunks.get(request.seed, 1)
+        self.log.append(("start", slot, request.seed))
+        self.pending[slot] = [request.seed, n]
+        return n
+
+    def prefill_step(self, slot: int) -> int | None:
+        seed, left = self.pending[slot]
+        self.log.append(("chunk", slot, seed))
+        left -= 1
+        if left > 0:
+            self.pending[slot][1] = left
+            return None
+        self.pending[slot] = None
+        self.seed_at[slot] = seed
         self.cursor[slot] = 1
-        return self.scripts[request.seed][0]
+        return self.scripts[seed][0]
 
     def step(self) -> list[int]:
         self.log.append(("step", tuple(self.seed_at)))
@@ -53,16 +71,29 @@ class FakeBackend:
     def release(self, slot: int) -> None:
         self.log.append(("release", slot))
         self.seed_at[slot] = None
+        self.pending[slot] = None
 
 
-def _sched(num_slots=2, scripts=None, max_queue=4, clock=None):
+def _sched(num_slots=2, scripts=None, max_queue=4, clock=None, chunks=None,
+           **kw):
     scripts = scripts or {}
     clock = clock or FakeClock()
-    backend = FakeBackend(num_slots, scripts)
-    return Scheduler(backend, max_queue=max_queue, clock=clock), backend, clock
+    backend = FakeBackend(num_slots, scripts, chunks)
+    return Scheduler(backend, max_queue=max_queue, clock=clock, **kw), \
+        backend, clock
 
 
-def test_fifo_admission_fills_free_slots_lowest_first():
+def _drain(sched, tickets, limit=50):
+    for _ in range(limit):
+        if sched.tick() == 0 and all(t.done() for t in tickets):
+            return
+    raise AssertionError("scheduler did not drain")
+
+
+# -- admission + continuous batching ------------------------------------------
+
+
+def test_fifo_within_class_fills_free_slots_lowest_first():
     sched, backend, _ = _sched(
         scripts={1: [10, 11, 12], 2: [20, 21, 22], 3: [30, 31, 32]}
     )
@@ -71,10 +102,11 @@ def test_fifo_admission_fills_free_slots_lowest_first():
     t3 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=3, seed=3))
     live = sched.tick()
     assert live == 2  # two slots, third request still queued
-    assert backend.log[:2] == [("prefill", 0, 1), ("prefill", 1, 2)]
+    assert [e for e in backend.log if e[0] == "start"][:2] == [
+        ("start", 0, 1), ("start", 1, 2)
+    ]
     assert sched.stats()["queue_depth"] == 1
-    for _ in range(5):
-        sched.tick()
+    _drain(sched, (t1, t2, t3))
     assert t1.result["tokens"] == [10, 11, 12]
     assert t2.result["tokens"] == [20, 21, 22]
     assert t3.result["tokens"] == [30, 31, 32]
@@ -83,25 +115,26 @@ def test_fifo_admission_fills_free_slots_lowest_first():
 
 def test_slot_refill_mid_decode_no_stop_the_world():
     """Request C is admitted into A's freed slot while B is still
-    decoding — B's stream never pauses and C's prefill lands between
-    decode steps (continuous batching, not batch barriers)."""
+    decoding — B's stream never pauses and C's prefill chunk lands
+    between decode steps (continuous batching, not batch barriers)."""
     sched, backend, _ = _sched(
         scripts={1: [10, 11], 2: [20, 21, 22, 23, 24], 3: [30, 31, 32]}
     )
     ta = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=1))
     tb = sched.submit(GenRequest(prompt=(5,), max_new_tokens=5, seed=2))
-    sched.tick()  # admit A(slot0)+B(slot1), one step: A done, slot 0 free
+    sched.tick()  # admit A+B, A's chunk runs + A decodes once
+    sched.tick()  # B's chunk runs; A finishes
     assert ta.done() and ta.result["tokens"] == [10, 11]
     assert not tb.done()
     tc = sched.submit(GenRequest(prompt=(5,), max_new_tokens=3, seed=3))
-    live = sched.tick()  # C admitted into slot 0 while B decodes
-    assert live == 2
-    assert ("prefill", 0, 3) in backend.log
-    # B stepped in EVERY tick, including the one that admitted C
-    steps = [e for e in backend.log if e[0] == "step"]
-    assert all(2 in e[1] for e in steps)
-    for _ in range(4):
-        sched.tick()
+    start_idx = len(backend.log)
+    sched.tick()  # C admitted into A's old slot while B decodes
+    assert ("start", 0, 3) in backend.log
+    # B stepped in EVERY tick from C's admission on, including the one
+    # that ran C's prefill chunk — no stop-the-world
+    steps = [e for e in backend.log[start_idx:] if e[0] == "step"]
+    assert steps and all(2 in e[1] for e in steps)
+    _drain(sched, (tb, tc))
     assert tc.result["tokens"] == [30, 31, 32]
     assert tb.result["tokens"] == [20, 21, 22, 23, 24]
 
@@ -114,22 +147,21 @@ def test_eos_retirement_frees_slot_and_truncates():
         GenRequest(prompt=(5,), max_new_tokens=4, seed=1, stop_token=99)
     )
     t2 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=3, seed=2))
-    sched.tick()  # admit 1, step emits 99 -> retired
+    sched.tick()  # admit 1, chunk + step emits 99 -> retired
     assert t1.done()
     assert t1.result["tokens"] == [10, 99]
     assert t1.result["finish_reason"] == "stop"
     assert ("release", 0) in backend.log
-    for _ in range(3):
-        sched.tick()
+    _drain(sched, (t2,))
     assert t2.result["tokens"] == [20, 21, 22]
 
 
-def test_instant_stop_at_prefill_never_occupies_a_slot():
-    """First sampled token == stop_token: the request finishes at
-    admission, its backend slot is RELEASED (an unreleased instant
-    finish would keep decoding as a zombie and, under MoE, spend shared
-    expert capacity), and the SAME slot admits the next queued request
-    within the same tick."""
+def test_instant_stop_at_prefill_releases_the_slot():
+    """First sampled token == stop_token: the request finishes at its
+    final prefill chunk, its backend slot is RELEASED (an unreleased
+    instant finish would keep decoding as a zombie and, under MoE,
+    spend shared expert capacity), and the slot admits the next queued
+    request on the following tick."""
     sched, backend, _ = _sched(
         scripts={1: [99], 2: [20, 21]}, num_slots=1
     )
@@ -140,9 +172,9 @@ def test_instant_stop_at_prefill_never_occupies_a_slot():
     sched.tick()
     assert t1.done() and t1.result["finish_reason"] == "stop"
     assert backend.log[:3] == [
-        ("prefill", 0, 1), ("release", 0), ("prefill", 0, 2)
+        ("start", 0, 1), ("chunk", 0, 1), ("release", 0)
     ]
-    sched.tick()
+    _drain(sched, (t2,))
     assert t2.done() and t2.result["tokens"] == [20, 21]
 
 
@@ -156,6 +188,287 @@ def test_queue_full_raises_and_counts_rejection():
     assert sched.stats()["queue_depth"] == 2
 
 
+# -- SLO-aware admission ordering ---------------------------------------------
+
+
+def test_priority_classes_admit_before_fifo_order():
+    """A later-submitted priority-0 request takes the free slot ahead
+    of earlier priority-1 and priority-2 traffic; within a class,
+    submit order still holds."""
+    sched, backend, _ = _sched(
+        num_slots=1,
+        scripts={1: [10], 2: [20], 3: [30], 4: [40]},
+        starvation_s=None,
+    )
+    tickets = [
+        sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=1,
+                                priority=2)),
+        sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=2,
+                                priority=1)),
+        sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=3,
+                                priority=0)),
+        sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=4,
+                                priority=1)),
+    ]
+    _drain(sched, tickets)
+    order = [e[2] for e in backend.log if e[0] == "start"]
+    assert order == [3, 2, 4, 1]  # class 0, then class 1 in FIFO, then 2
+
+
+def test_edf_within_priority_class():
+    """Within one class the earliest DEADLINE goes first, regardless of
+    submit order; deadline-less requests sort after any deadline."""
+    sched, backend, _ = _sched(
+        num_slots=1, scripts={1: [10], 2: [20], 3: [30]},
+        starvation_s=None,
+    )
+    tickets = [
+        sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=1)),
+        sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=2,
+                                deadline_s=50.0)),
+        sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=3,
+                                deadline_s=20.0)),
+    ]
+    _drain(sched, tickets)
+    order = [e[2] for e in backend.log if e[0] == "start"]
+    assert order == [3, 2, 1]
+    assert all(t.result["finish_reason"] == "length" for t in tickets)
+
+
+def test_starvation_bound_boosts_best_effort():
+    """A best-effort request (priority 9) overtaken by a stream of
+    priority-0 arrivals is admitted anyway once its wait crosses
+    ``starvation_s`` — delayed, never starved."""
+    clock = FakeClock()
+    scripts = {k: [100 + k] for k in range(20)}
+    sched, backend, clock = _sched(
+        num_slots=1, scripts=scripts, clock=clock, max_queue=32,
+        starvation_s=5.0,
+    )
+    tb = sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=0,
+                                 priority=9))
+    urgent = []
+    # urgent arrivals keep coming; each tick serves one request fully
+    for k in range(1, 8):
+        urgent.append(sched.submit(
+            GenRequest(prompt=(5,), max_new_tokens=1, seed=k, priority=0)
+        ))
+        clock.advance(1.0)
+        sched.tick()
+    order = [e[2] for e in backend.log if e[0] == "start"]
+    # seed 0 was boosted once its wait reached 5s — BEFORE the later
+    # urgent arrivals that would otherwise always outrank it
+    assert 0 in order
+    boosted_at = order.index(0)
+    assert 0 < boosted_at < len(order) - 1
+    assert tb.done() and tb.result["tokens"] == [100]
+
+
+def test_pure_priority_starves_without_bound():
+    """Contrast pin for the test above: with starvation_s=None the
+    best-effort request never runs while urgent traffic keeps arriving."""
+    clock = FakeClock()
+    scripts = {k: [100 + k] for k in range(20)}
+    sched, backend, clock = _sched(
+        num_slots=1, scripts=scripts, clock=clock, max_queue=32,
+        starvation_s=None,
+    )
+    tb = sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=0,
+                                 priority=9))
+    for k in range(1, 8):
+        sched.submit(
+            GenRequest(prompt=(5,), max_new_tokens=1, seed=k, priority=0)
+        )
+        clock.advance(1.0)
+        sched.tick()
+    assert not tb.done()
+    assert 0 not in [e[2] for e in backend.log if e[0] == "start"]
+
+
+# -- chunked prefill ----------------------------------------------------------
+
+
+def test_long_prefill_interleaves_with_decode():
+    """One chunk per tick: a 5-chunk prompt admits while another
+    request decodes, and the decoder advances on EVERY tick of the long
+    prefill — the stall chunked prefill exists to remove."""
+    sched, backend, _ = _sched(
+        scripts={1: [10, 11, 12, 13, 14, 15, 16, 17],
+                 2: [20, 21]},
+        chunks={2: 5},
+    )
+    t1 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=8, seed=1))
+    sched.tick()  # 1 decoding
+    t2 = sched.submit(GenRequest(prompt=(5,) * 50, max_new_tokens=2, seed=2))
+    for _ in range(5):
+        sched.tick()
+    # every tick while 2 prefilled also stepped 1's decode
+    chunk_ticks = [i for i, e in enumerate(backend.log) if e[0] == "chunk"
+                   and e[2] == 2]
+    steps = [i for i, e in enumerate(backend.log) if e[0] == "step"]
+    assert len(chunk_ticks) == 5
+    for c in chunk_ticks[:-1]:
+        assert any(s > c for s in steps), "decode stalled behind prefill"
+    _drain(sched, (t1, t2))
+    assert t1.result["tokens"] == [10, 11, 12, 13, 14, 15, 16, 17]
+    assert t2.result["tokens"] == [20, 21]
+
+
+def test_short_prefill_jumps_long_prefill_srpt():
+    """Shortest-remaining-first chunk scheduling: a 1-chunk short
+    admitted while a 10-chunk long is mid-prefill gets the very next
+    chunk slot — its TTFT is bounded by ~one tick, not the long
+    prompt's remaining chunks."""
+    sched, backend, _ = _sched(
+        scripts={1: [10, 11], 2: [20, 21]},
+        chunks={1: 10, 2: 1},
+    )
+    tl = sched.submit(GenRequest(prompt=(5,) * 100, max_new_tokens=2, seed=1))
+    sched.tick()  # long admitted, chunk 1/10
+    sched.tick()  # chunk 2/10
+    ts = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=2))
+    sched.tick()  # short admitted; SRPT: ITS chunk runs, not the long's
+    chunk_seeds = [e[2] for e in backend.log if e[0] == "chunk"]
+    assert chunk_seeds[:3] == [1, 1, 2]
+    # the short's whole life fit in ONE tick (chunk -> first token ->
+    # decode step) while the long still has 8 chunks to go
+    assert ts.done() and ts.result["tokens"] == [20, 21]
+    assert not tl.done()
+    _drain(sched, (tl, ts))
+    assert tl.result["tokens"] == [10, 11]
+
+
+def test_aging_bounds_srpt_long_prefill_starvation():
+    """SRPT alone would starve a long prefill under a steady stream of
+    one-chunk shorts (every fresh short outranks it each tick); the
+    aging bound caps the bypass streak, so the long request is delayed
+    but completes. Contrast half: the chunk it takes every
+    ``prefill_aging_ticks+1`` ticks barely moves short latency."""
+    scripts = {0: [50, 51]}
+    scripts.update({k: [100 + k] for k in range(1, 40)})
+    sched, backend, _ = _sched(
+        num_slots=2, scripts=scripts, max_queue=64,
+        chunks={0: 6}, prefill_aging_ticks=3,
+    )
+    tl = sched.submit(GenRequest(prompt=(5,) * 60, max_new_tokens=2, seed=0))
+    sched.tick()  # long admitted alone: its chunk 1/6 runs
+    shorts = []
+    for k in range(1, 25):  # one fresh 1-chunk short EVERY tick
+        shorts.append(sched.submit(
+            GenRequest(prompt=(5,), max_new_tokens=1, seed=k)
+        ))
+        sched.tick()
+        if tl.done():
+            break
+    assert tl.done(), "long prefill starved behind the short stream"
+    assert tl.result["tokens"] == [50, 51]
+    # the long's chunks were interleaved at the aging cadence: never
+    # more than prefill_aging_ticks shorts between two long chunks
+    long_chunk_idx = [i for i, e in enumerate(backend.log)
+                      if e[0] == "chunk" and e[2] == 0]
+    gaps = [b - a for a, b in zip(long_chunk_idx, long_chunk_idx[1:])]
+    assert gaps and max(gaps) <= 4 * (3 + 1)  # bounded, not unbounded
+    # shorts kept flowing throughout (no inversion into long-first):
+    # each aged tick defers at most one short, so at most one pending
+    # short per long chunk taken during the stream (5) plus the
+    # final-tick arrival
+    assert sum(1 for t in shorts if t.done()) >= len(shorts) - 6
+
+
+def test_bad_queue_head_does_not_cost_a_free_slot():
+    """A ValueError pop (invalid request at the queue head) retries the
+    SAME free slot with the next queued request in the same tick — a
+    dud must not forfeit a viable request's admission tick."""
+
+    class Exploding(FakeBackend):
+        def start_prefill(self, slot, request):
+            if request.seed == 13:
+                raise ValueError("bad request")
+            return super().start_prefill(slot, request)
+
+    backend = Exploding(1, {1: [10, 11]})
+    sched = Scheduler(backend, max_queue=8, clock=FakeClock())
+    bad = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=13))
+    good = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=1))
+    sched.tick()  # bad errors AND good admits+completes, one tick
+    assert bad.done() and bad.result["finish_reason"] == "error"
+    assert good.done() and good.result["tokens"] == [10, 11]
+
+
+def test_deadline_expires_mid_chunked_prefill():
+    """A deadline passing BETWEEN chunks retires the request with the
+    usual empty-output expiry and frees the slot (the PR-4 scheduler
+    could only expire queued or decoding requests — mid-prefill is a
+    new state and must not be a deadline blind spot)."""
+    clock = FakeClock()
+    sched, backend, clock = _sched(
+        num_slots=1, scripts={1: [10], 2: [20, 21]},
+        chunks={1: 10}, clock=clock,
+    )
+    t1 = sched.submit(GenRequest(prompt=(5,) * 100, max_new_tokens=1, seed=1,
+                                 deadline_s=1.0))
+    sched.tick()  # admitted, chunk 1/10
+    sched.tick()  # chunk 2/10
+    clock.advance(2.0)  # deadline passes mid-prefill
+    sched.tick()
+    assert t1.done()
+    assert t1.result["finish_reason"] == "deadline"
+    assert t1.result["tokens"] == []
+    assert ("release", 0) in backend.log
+    assert sched.stats()["expired"] == 1
+    # the slot is genuinely free: the next request admits and completes
+    t2 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=2))
+    _drain(sched, (t2,))
+    assert t2.result["tokens"] == [20, 21]
+
+
+def test_cancel_mid_chunked_prefill_frees_slot():
+    sched, backend, _ = _sched(
+        num_slots=1, scripts={1: [10]}, chunks={1: 10},
+    )
+    t1 = sched.submit(GenRequest(prompt=(5,) * 100, max_new_tokens=1, seed=1))
+    sched.tick()
+    sched.tick()
+    t1.cancel()
+    sched.tick()
+    assert t1.done()
+    assert t1.result["finish_reason"] == "cancelled"
+    assert t1.result["tokens"] == []
+    assert ("release", 0) in backend.log
+    assert sched.stats()["cancelled"] == 1
+
+
+def test_prefill_chunk_stats():
+    sched, backend, _ = _sched(
+        num_slots=2, scripts={1: [10], 2: [20]}, chunks={1: 4, 2: 2},
+    )
+    sched.submit(GenRequest(prompt=(5,) * 40, max_new_tokens=1, seed=1))
+    sched.submit(GenRequest(prompt=(5,) * 20, max_new_tokens=1, seed=2))
+    sched.tick()  # both admitted; one chunk ran (SRPT: seed 2)
+    s = sched.stats()
+    assert s["slots_prefilling"] == 2
+    assert s["prefill_chunks_total"] == 1
+    assert s["prefill_chunks_pending"] == 4 + 2 - 1
+    for _ in range(8):
+        sched.tick()
+    s = sched.stats()
+    assert s["prefill_chunks_pending"] == 0
+    assert s["prefill_chunks_total"] == 6
+
+
+def test_prefix_stats_passthrough():
+    """A backend exposing ``prefix_stats`` (the engine's prefix cache)
+    surfaces it verbatim in the scheduler stats; one without stays
+    absent."""
+    sched, backend, _ = _sched(scripts={})
+    assert "prefix_cache" not in sched.stats()
+    backend.prefix_stats = lambda: {"hits": 3, "misses": 1}
+    assert sched.stats()["prefix_cache"] == {"hits": 3, "misses": 1}
+
+
+# -- deadlines / cancellation (queued + decoding) -----------------------------
+
+
 def test_queued_deadline_expires_before_a_slot_is_held():
     clock = FakeClock()
     sched, backend, clock = _sched(
@@ -163,19 +476,19 @@ def test_queued_deadline_expires_before_a_slot_is_held():
         clock=clock,
     )
     t1 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=5, seed=1))
+    sched.tick()  # request 1 takes the only slot
     t2 = sched.submit(
         GenRequest(prompt=(5,), max_new_tokens=2, seed=2, deadline_s=1.0)
     )
-    sched.tick()  # request 1 takes the only slot; 2 waits
+    sched.tick()  # 2 waits queued (EDF can't preempt a held slot)
     clock.advance(2.0)  # past request 2's deadline while still queued
     sched.tick()
     assert t2.done()
     assert t2.result["finish_reason"] == "deadline"
     assert t2.result["tokens"] == []
-    assert not any(e == ("prefill", 0, 2) for e in backend.log)
+    assert not any(e == ("start", 0, 2) for e in backend.log)
     assert sched.stats()["expired"] == 1
-    for _ in range(5):
-        sched.tick()
+    _drain(sched, (t1,))
     assert t1.result["tokens"] == [10, 11, 12, 13, 14]
 
 
@@ -208,7 +521,7 @@ def test_cancel_queued_request_never_takes_a_slot():
         sched.tick()
     assert t2.result["finish_reason"] == "cancelled"
     assert t2.result["tokens"] == []
-    assert not any(e == ("prefill", 0, 2) for e in backend.log)
+    assert not any(e == ("start", 0, 2) for e in backend.log)
     assert t1.result["tokens"] == [10, 11, 12]
     assert sched.stats()["cancelled"] == 1
 
@@ -248,10 +561,10 @@ def test_queued_s_measures_wait_not_prefill():
 
 def test_prefill_error_fails_one_request_not_the_loop():
     class Exploding(FakeBackend):
-        def prefill(self, slot, request):
+        def start_prefill(self, slot, request):
             if request.seed == 13:
                 raise ValueError("prompt too long for the engine")
-            return super().prefill(slot, request)
+            return super().start_prefill(slot, request)
 
     backend = Exploding(1, {1: [10, 11]})
     sched = Scheduler(backend, max_queue=4, clock=FakeClock())
@@ -260,7 +573,8 @@ def test_prefill_error_fails_one_request_not_the_loop():
     sched.tick()
     assert bad.done() and bad.result["finish_reason"] == "error"
     assert "too long" in bad.result["error"]
-    sched.tick()
+    for _ in range(3):
+        sched.tick()
     assert good.done() and good.result["tokens"] == [10, 11]
     assert sched.stats()["errors"] == 1
 
@@ -287,19 +601,20 @@ def test_ttft_percentiles_use_nearest_rank():
 
 def test_request_spans_and_histograms():
     """Per-request observability: queued/prefill/decode spans land on
-    the injected tracer with the request's correlation id, and the
-    TTFT / queue-wait / per-tick-decode histograms fill with correct
+    the injected tracer with the request's correlation id, the prefill
+    span counts its chunks, and the TTFT / queue-wait (overall AND
+    per-priority) / per-tick-decode histograms fill with correct
     cumulative buckets."""
     from nanodiloco_tpu.obs import SpanTracer
 
     clock = FakeClock()
     tracer = SpanTracer(clock=clock)  # SAME clock as the scheduler
-    backend = FakeBackend(1, {1: [10, 11, 12], 2: [20, 21]})
+    backend = FakeBackend(1, {1: [10, 11, 12], 2: [20, 21]}, {1: 2})
     sched = Scheduler(backend, max_queue=4, clock=clock, tracer=tracer)
     t1 = sched.submit(GenRequest(prompt=(5, 6), max_new_tokens=3, seed=1,
-                                 request_id="client-abc"))
+                                 request_id="client-abc", priority=0))
     t2 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=2))
-    for _ in range(6):
+    for _ in range(8):
         clock.advance(0.25)
         sched.tick()
     assert t1.done() and t2.done()
@@ -315,10 +630,15 @@ def test_request_spans_and_histograms():
     span_ids = {e["args"]["request_id"] for e in by_name["decode"]}
     assert span_ids == {"client-abc", f"req-{t2.rid}"}
     assert by_name["prefill"][0]["args"]["prompt_tokens"] == 2
+    assert by_name["prefill"][0]["args"]["chunks"] == 2
     # histograms: 2 admissions, every decode tick observed
     s = sched.stats()
     assert s["hist_ttft"]["count"] == 2
     assert s["hist_queue_wait"]["count"] == 2
+    # per-priority split: one admission each in class 0 and class 1
+    assert set(s["hist_queue_wait_by_priority"]) == {0, 1}
+    assert s["hist_queue_wait_by_priority"][0]["count"] == 1
+    assert s["hist_queue_wait_by_priority"][1]["count"] == 1
     ticks = len([e for e in backend.log if e[0] == "step"])
     assert s["hist_decode_tick"]["count"] == ticks
     # cumulative-bucket invariants: monotone, +Inf bucket == count
