@@ -404,6 +404,84 @@ def run_streaming(degraded: bool = False) -> dict:
     }
 
 
+def run_async(degraded: bool = False) -> dict:
+    """Sync vs ASYNC delayed-apply outer step (BENCH_ASYNC=1): identical
+    model, config, and batches — warm best-of-N fused rounds through the
+    synchronous round program vs the boundary-first async round program
+    (DilocoConfig.async_outer, delay 1), each differenced against the
+    SAME inner-only baseline to isolate what the outer boundary costs in
+    each mode. ``outer_sync_share_async`` < ``outer_sync_share_sync`` is
+    the recovered-overlap claim — real only where the backend can run
+    the collective under compute (XLA:TPU's latency-hiding scheduler, or
+    a multi-process Gloo group via scripts/streaming_overlap.py); a
+    single-process CPU run pins correctness and program structure, not
+    the speedup (PERF.md honest-measurement note)."""
+    from nanodiloco_tpu.models import LlamaConfig
+    from nanodiloco_tpu.parallel import (
+        Diloco, DilocoConfig, MeshConfig, build_mesh,
+    )
+
+    small = degraded or jax.default_backend() == "cpu"
+    n_dev = min(int(os.environ.get("BENCH_DEVICES", "1")), len(jax.devices()))
+    H = int(os.environ.get("BENCH_STREAM_H", "2" if small else "8"))
+    batch, seq = (2, 256) if small else (8, 1024)
+    model_cfg = LlamaConfig(
+        vocab_size=32000, dtype="bfloat16", loss_chunk=min(seq, 512)
+    )
+    mesh = build_mesh(MeshConfig(diloco=n_dev), devices=jax.devices()[:n_dev])
+    base = dict(num_workers=n_dev, inner_steps=H, warmup_steps=10,
+                total_steps=10_000, lr=4e-4, grad_accum=1)
+    tok = jax.random.randint(
+        jax.random.key(0), (H, n_dev, 1, batch, seq), 0, model_cfg.vocab_size
+    )
+    mask = jnp.ones_like(tok)
+    jax.block_until_ready(tok)
+
+    def best(step_fn, state, n=3):
+        state, loss = step_fn(state, tok, mask)[:2]  # compile + warm
+        jax.block_until_ready(loss)
+        t = float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            state, loss = step_fn(state, tok, mask)[:2]
+            jax.block_until_ready(loss)
+            t = min(t, time.perf_counter() - t0)
+        return t, state
+
+    classic = Diloco(model_cfg, DilocoConfig(**base), mesh)
+    cstate = classic.init_state(jax.random.key(1))
+    classic_t, cstate = best(classic.round_step, cstate)
+    inner_t = classic.measure_inner_round_time(cstate, tok, mask, repeats=2)
+
+    adl = Diloco(
+        model_cfg,
+        DilocoConfig(**base, async_outer=True, outer_delay=1),
+        mesh,
+    )
+    astate = adl.init_state(jax.random.key(1))
+    # every async_round_step call runs the full boundary-first program
+    # (the warm-up boundaries are value no-ops, not cost no-ops), so
+    # best-of-N over it measures the steady-state executable
+    async_t, astate = best(adl.async_round_step, astate)
+
+    tokens_per_round = H * n_dev * batch * seq
+    return {
+        "model": "llama-tiny-15M (ref default)",
+        "workers": n_dev, "inner_steps": H, "outer_delay": 1,
+        "sync_round_s": round(classic_t, 4),
+        "async_round_s": round(async_t, 4),
+        "sync_tokens_per_sec": round(tokens_per_round / classic_t, 1),
+        "async_tokens_per_sec": round(tokens_per_round / async_t, 1),
+        "async_speedup": round(classic_t / async_t, 4),
+        "outer_sync_share_sync": round(
+            max(0.0, classic_t - inner_t) / classic_t, 5
+        ),
+        "outer_sync_share_async": round(
+            max(0.0, async_t - inner_t) / async_t, 5
+        ),
+    }
+
+
 def main() -> None:
     # opt-in persistent compile cache (see utils.enable_compile_cache):
     # repeated bench runs skip the 20-40 s first-compiles
@@ -513,6 +591,8 @@ def main() -> None:
         result["moe"] = run_moe(peak, degraded=bool(degraded))
     if os.environ.get("BENCH_STREAMING") == "1":
         result["streaming"] = run_streaming(degraded=bool(degraded))
+    if os.environ.get("BENCH_ASYNC") == "1":
+        result["async_outer"] = run_async(degraded=bool(degraded))
 
     print(json.dumps(result))
 

@@ -110,6 +110,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--merge-alpha", type=float, default=1.0,
                    help="fragment merge blend: 1 = hard reset to global, "
                         "0.5 = half local/global mix")
+    p.add_argument("--async-outer", action="store_true",
+                   help="async delayed-apply outer step (classic rounds): "
+                        "launch each round boundary's all-reduce + Nesterov "
+                        "update without blocking, start the next round from "
+                        "the previous merge, apply the pending merge "
+                        "--outer-delay rounds late; each apply's lateness "
+                        "lands as outer_staleness in the JSONL/telemetry. "
+                        "--outer-delay 0 is bit-identical to the "
+                        "synchronous outer step")
+    p.add_argument("--outer-delay", type=int, default=1,
+                   help="rounds between an async outer launch and its "
+                        "apply (the staleness bound; with --async-outer)")
     p.add_argument("--outer-comm-dtype", type=str, default=None,
                    help="quantization of the outer-sync pseudo-gradient: "
                         "a float dtype casts (bfloat16), a signed-int "
@@ -318,6 +330,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         streaming_fragments=args.streaming_fragments,
         streaming_delay=args.streaming_delay,
         merge_alpha=args.merge_alpha,
+        async_outer=args.async_outer,
+        outer_delay=args.outer_delay,
         outer_comm_dtype=args.outer_comm_dtype,
         outer_wire_collective=args.outer_wire_collective,
         model=model,

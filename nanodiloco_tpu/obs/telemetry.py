@@ -96,6 +96,15 @@ _GAUGE_KEYS = {
         "cosine(mean pseudo-gradient, applied outer update descent "
         "direction) at the last sync",
     ),
+    # async delayed-apply outer step (parallel/diloco.py async_outer):
+    # rounds between the applied merge's launch and its apply — the
+    # realized staleness of the overlap (streaming logs its fragment
+    # stagger here as a fraction of a round)
+    "outer_staleness": (
+        "nanodiloco_outer_staleness",
+        "rounds the last applied outer merge landed late "
+        "(async delayed-apply / streaming stagger)",
+    ),
 }
 
 

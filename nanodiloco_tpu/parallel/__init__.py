@@ -1,4 +1,9 @@
-from nanodiloco_tpu.parallel.diloco import Diloco, DilocoConfig, DilocoState
+from nanodiloco_tpu.parallel.diloco import (
+    AsyncDilocoState,
+    Diloco,
+    DilocoConfig,
+    DilocoState,
+)
 from nanodiloco_tpu.parallel.feed import BatchFeeder, device_set_slices
 from nanodiloco_tpu.parallel.mesh import (
     AXES,
@@ -15,6 +20,7 @@ from nanodiloco_tpu.parallel.streaming import (
 )
 
 __all__ = [
+    "AsyncDilocoState",
     "BatchFeeder",
     "device_set_slices",
     "Diloco",

@@ -121,6 +121,27 @@ class DilocoConfig:
     # ``round_step`` returns a 4th element and ``outer_step`` a 2nd:
     # the dynamics dict (see ``_sync_dynamics``).
     dynamics_metrics: bool = False
+    # Async delayed-apply outer step (the whole-model analog of
+    # streaming's per-fragment launch/apply split, arXiv:2501.18512):
+    # at each round boundary the pseudo-gradient all-reduce + Nesterov
+    # update is LAUNCHED into a pending slot without blocking, the next
+    # round's inner steps start from the PREVIOUS merge (a base
+    # ``outer_delay`` outer updates stale), and the pending merge is
+    # applied ``outer_delay`` round boundaries after its launch. With
+    # ``outer_delay=0`` the launch and apply coincide and the math is
+    # bit-identical to the synchronous ``_outer_step`` (pinned by
+    # tests/test_async_outer.py, the classic-DiLoCo analog of
+    # streaming's ``test_p1_delay0_equals_classic_diloco``). The fused
+    # async round program puts the boundary FIRST (launch + apply, then
+    # the H-step inner scan): the collective's output feeds only the
+    # NEXT boundary, so XLA's latency-hiding scheduler is free to
+    # overlap the all-reduce with the whole round of inner compute —
+    # the ``outer_sync_share`` dead time this mode exists to recover.
+    async_outer: bool = False
+    # rounds between a pending merge's launch and its apply (the
+    # staleness bound; each apply's actual lateness is surfaced as the
+    # ``outer_staleness`` JSONL key / telemetry gauge)
+    outer_delay: int = 1
 
 
 def _wire_accumulator_dtype(num_workers: int, q_max: float):
@@ -144,6 +165,33 @@ class DilocoState(struct.PyTreeNode):
     snapshot: Any        # unstacked — params at last sync (θ in the paper)
     outer_opt_state: Any  # unstacked — Nesterov momentum buffer
     inner_step_count: jax.Array  # completed inner steps (scalar int32)
+
+
+class AsyncDilocoState(struct.PyTreeNode):
+    """Classic DiLoCo state plus the in-flight outer merge(s) of the
+    async delayed-apply path (``DilocoConfig.async_outer``).
+
+    ``snapshot`` is the base every worker started the CURRENT round
+    from — the last APPLIED merge, ``outer_delay`` outer updates behind
+    the newest launch. ``pending`` is the FIFO of launched-but-unapplied
+    merged models, oldest first (length ``max(outer_delay, 1)``; with
+    ``outer_delay=0`` the single slot mirrors the just-applied merge so
+    the pytree shape — and therefore checkpoints — stay uniform across
+    delays). ``pending_round`` records each slot's launch round (0 =
+    init copy, never a real launch); ``launched_round`` is the newest
+    round whose boundary has run — the marker that lets a resume decide
+    whether a boundary is still owed for ``inner_step_count``'s round
+    (fused checkpoints land pre-boundary, stepwise ones post-boundary;
+    both must resume bit-exact through either loop)."""
+
+    params: Any
+    inner_opt_state: Any
+    snapshot: Any
+    outer_opt_state: Any
+    pending: Any                 # tuple of unstacked param trees, oldest first
+    pending_round: jax.Array     # int32 [len(pending)] launch round per slot
+    launched_round: jax.Array    # int32 scalar — newest boundary that ran
+    inner_step_count: jax.Array
 
 
 class Diloco:
@@ -263,6 +311,24 @@ class Diloco:
                     f"num_workers={cfg.num_workers} with wire {wire.name} "
                     "overflows the int32 psum accumulator"
                 )
+        if cfg.async_outer:
+            if cfg.outer_delay < 0:
+                raise ValueError(f"outer_delay must be >= 0, got {cfg.outer_delay}")
+            if cfg.quarantine_nonfinite:
+                raise ValueError(
+                    "quarantine_nonfinite is synchronous-outer-only: the "
+                    "async boundary sits at the top of the NEXT round's "
+                    "program, after the round's [W] loss-finiteness verdict "
+                    "has left the program that computed it; run the "
+                    "synchronous outer step for fault quarantine"
+                )
+            if cfg.offload_snapshot:
+                raise ValueError(
+                    "offload_snapshot is synchronous-outer-only: the async "
+                    "path keeps the snapshot AND the pending merge(s) as "
+                    "live program inputs every round — there is no "
+                    "between-syncs window to park them in host memory"
+                )
         self.loss_fn = loss_fn or (
             lambda p, t, m: causal_lm_loss(p, t, model_cfg, loss_mask=m)
         )
@@ -338,6 +404,24 @@ class Diloco:
         self.inner_round_step = self._with_mesh(
             jax.jit(self._inner_round_step, donate_argnums=(0,))
         )
+        if cfg.async_outer:
+            # boundary-first fused round (launch + apply, THEN the H-step
+            # scan — the collective's consumers all live one program
+            # later, so the scheduler may overlap it with the scan), the
+            # stepwise boundary, and the end-of-run flush/drain
+            self._async_round_jit = jax.jit(
+                self._async_round_step, donate_argnums=(0,)
+            )
+            self.async_round_step = self._with_mesh(self._async_round_jit)
+            self.async_boundary = self._with_mesh(
+                jax.jit(self._async_boundary, donate_argnums=(0,))
+            )
+            self.async_flush = self._with_mesh(
+                jax.jit(self._async_flush, donate_argnums=(0,))
+            )
+            self.async_drain = self._with_mesh(
+                jax.jit(self._async_drain, donate_argnums=(0,))
+            )
 
     def _with_mesh(self, fn):
         """Run ``fn`` with this mesh as the ambient mesh — the partial-manual
@@ -407,7 +491,39 @@ class Diloco:
         else:
             with jax.set_mesh(self.mesh):
                 state = fn()
+        if self.cfg.async_outer:
+            return self._as_async_state(state)
         return self._offload(state)
+
+    def _as_async_state(self, base: DilocoState) -> AsyncDilocoState:
+        """Fresh async state: every pending slot starts as a copy of the
+        init snapshot with launch round 0 (the init marker), so the
+        warm-up boundaries are uniform programs whose applies are
+        no-ops — no special-cased first round inside the executable."""
+        slots = max(self.cfg.outer_delay, 1)
+        pending = tuple(
+            jax.tree.map(jnp.copy, base.snapshot) for _ in range(slots)
+        )
+
+        def rep(x):
+            # replicated over the mesh, like every other scalar in the
+            # state: an eagerly-created counter would sit committed on
+            # one device and collide with the mesh-sharded params at the
+            # first jitted dispatch
+            if self.mesh.size == 1:
+                return x
+            return jax.device_put(x, NamedSharding(self.mesh, P()))
+
+        return AsyncDilocoState(
+            params=base.params,
+            inner_opt_state=base.inner_opt_state,
+            snapshot=base.snapshot,
+            outer_opt_state=base.outer_opt_state,
+            pending=pending,
+            pending_round=rep(jnp.zeros((slots,), jnp.int32)),
+            launched_round=rep(jnp.zeros((), jnp.int32)),
+            inner_step_count=base.inner_step_count,
+        )
 
     # -- inner step (H of these between syncs; zero cross-worker comms) -----
 
@@ -1344,6 +1460,159 @@ class Diloco:
                 best = min(best, time.perf_counter() - t0)
         del probe
         return best
+
+    # -- async delayed-apply outer step (DilocoConfig.async_outer) -----------
+
+    def _async_boundary(self, state: AsyncDilocoState):
+        """The uniform round-boundary program of the async outer path:
+        LAUNCH this round's outer update and APPLY the oldest pending
+        merge, in one traced region.
+
+        - Launch: the pseudo-gradient is measured from ``snapshot`` (the
+          base this round's workers actually started from) against the
+          pre-reset worker params; the Nesterov update is anchored at the
+          HEAD of the outer trajectory (the newest pending merge), so the
+          outer optimizer advances one coherent model — the gradient is
+          ``outer_delay`` updates stale, classic bounded-staleness async
+          SGD.
+        - Apply: every worker resets to ``pending[0]`` — the merge
+          launched ``outer_delay`` boundaries ago — which becomes the new
+          ``snapshot``. No worker delta is ever dropped or double-counted:
+          each round's progress enters exactly one pseudo-gradient,
+          measured from the base the round really ran on.
+
+        With ``outer_delay=0`` the head is ``snapshot`` and the apply is
+        the just-launched merge: op-for-op the synchronous
+        ``_outer_step``. The warm-up boundaries (pending slots still
+        holding init copies) are value no-ops by construction: Δ of a
+        just-reset worker set is exactly zero, and a zero pseudo-gradient
+        through Nesterov SGD moves nothing.
+
+        Returns ``(state, aux)``: aux carries ``boundary_round``,
+        ``applied_launch_round`` (0 = warm-up init slot), the
+        ``outer_staleness`` rounds the applied merge landed late, and —
+        under ``dynamics_metrics`` — the ``_sync_dynamics`` dict, all
+        replicated for pod-safe host fetches."""
+        W = self.cfg.num_workers
+        d = self.cfg.outer_delay
+        delta = self._pseudograd(state.snapshot, state.params)
+        delta = self._constrain(delta, worker_axis=False)
+        head = state.pending[-1] if d > 0 else state.snapshot
+        updates, outer_opt = self.outer_tx.update(
+            delta, state.outer_opt_state, head
+        )
+        dyn = (
+            self._sync_dynamics(
+                state.snapshot, state.params, delta, updates, outer_opt
+            )
+            if self.cfg.dynamics_metrics
+            else None
+        )
+        new = optax.apply_updates(head, updates)
+        new = self._constrain(new, worker_axis=False)
+        # this boundary's round index: the scan for round b has run, so
+        # inner_step_count == b * H
+        rnd = (state.inner_step_count // self.cfg.inner_steps).astype(jnp.int32)
+        if d > 0:
+            applied = state.pending[0]
+            applied_launch = state.pending_round[0]
+            pending = tuple(state.pending[1:]) + (new,)
+            pending_round = jnp.concatenate(
+                [state.pending_round[1:], rnd[None]]
+            )
+        else:
+            # immediate apply; the single slot mirrors the merge so the
+            # pytree (and checkpoint) shape is delay-invariant
+            applied = new
+            applied_launch = rnd
+            pending = (new,)
+            pending_round = rnd[None]
+        snapshot = self._constrain(applied, worker_axis=False)
+        params = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), snapshot
+        )
+        params = self._constrain(params, worker_axis=True)
+        rep = self._replicated_scalar_constraint
+        aux = {
+            "boundary_round": rep(rnd),
+            "applied_launch_round": rep(applied_launch),
+            "outer_staleness": rep(rnd - applied_launch),
+        }
+        if dyn is not None:
+            aux["dynamics"] = dyn
+        return state.replace(
+            params=params,
+            snapshot=snapshot,
+            outer_opt_state=outer_opt,
+            pending=pending,
+            pending_round=pending_round,
+            launched_round=rnd,
+        ), aux
+
+    def _async_round_step(self, state: AsyncDilocoState, tokens, loss_mask):
+        """One steady-state async round as a SINGLE XLA program, boundary
+        FIRST: [launch round N's outer update + apply the pending merge]
+        then [round N+1's H-step inner scan]. The scan depends only on
+        the applied merge (resident since ``outer_delay`` rounds ago);
+        the launch's all-reduce feeds nothing until the NEXT program's
+        boundary — the dataflow independence that lets XLA's
+        latency-hiding scheduler run the collective under the round's
+        compute. tokens/loss_mask: [H, W, accum, B, S]. Returns
+        (state, [H, W] losses, boundary aux)."""
+        if tokens.ndim != 5 or tokens.shape[0] != self.cfg.inner_steps:
+            raise ValueError(
+                f"round tokens must be [inner_steps={self.cfg.inner_steps}, "
+                f"W, accum, B, S]; got {tokens.shape}"
+            )
+        state, aux = self._async_boundary(state)
+
+        def one(s, batch):
+            s, loss = self._inner_step(s, batch[0], batch[1])
+            return s, loss
+
+        state, losses = jax.lax.scan(one, state, (tokens, loss_mask))
+        return state, losses, aux
+
+    def _async_drain(self, state: AsyncDilocoState) -> AsyncDilocoState:
+        """Apply every remaining pending merge in launch order (the net
+        effect: the NEWEST pending becomes the model) without launching
+        anything — the end-of-run settling step, so the final
+        checkpoint/eval see all completed outer work. The refilled slots
+        are init-marked copies of the final snapshot: the drained state
+        is a valid warm-up state, so extending a finished run resumes
+        through the ordinary machinery."""
+        if self.cfg.outer_delay == 0:
+            return state  # applies are never deferred
+        final = state.pending[-1]
+        snapshot = self._constrain(final, worker_axis=False)
+        params = jax.tree.map(
+            lambda x: jnp.broadcast_to(
+                x[None], (self.cfg.num_workers,) + x.shape
+            ),
+            snapshot,
+        )
+        params = self._constrain(params, worker_axis=True)
+        return state.replace(
+            params=params,
+            snapshot=snapshot,
+            pending=tuple(snapshot for _ in state.pending),
+            pending_round=jnp.zeros_like(state.pending_round),
+        )
+
+    def _async_flush(self, state: AsyncDilocoState):
+        """Final round boundary + drain: launch the last round's outer
+        update, apply it (and any older pendings) immediately. Run once
+        after the last round's inner scan; with ``outer_delay=0`` the
+        drain is a no-op and this IS the ordinary boundary."""
+        state, aux = self._async_boundary(state)
+        return self._async_drain(state), aux
+
+    def async_round_cost_analysis(self, state, tokens, loss_mask):
+        """Cost analysis of the fused ASYNC round program (boundary +
+        H-step scan) — the executable an async fused run dispatches."""
+        return self._jit_cost_analysis(
+            self._async_round_jit, state, tokens, loss_mask
+        )
 
     # -- XLA cost analytics (obs/costs) --------------------------------------
 

@@ -153,6 +153,16 @@ class StreamingDiloco(Diloco):
                 "and drift exist (each fragment launches on its own "
                 "stagger); run classic rounds for the dynamics telemetry"
             )
+        if cfg.async_outer:
+            raise ValueError(
+                "async_outer is classic-DiLoCo-only: streaming IS the "
+                "fragment-granularity async outer step — each fragment's "
+                "launch/apply is already split by StreamingConfig.delay "
+                "inner steps, overlapping the collective with the inner "
+                "compute; a second, round-granularity delay on top would "
+                "double-defer the same merges. Use streaming_delay for "
+                "the staleness bound here"
+            )
         if cfg.offload_snapshot:
             raise ValueError(
                 "offload_snapshot is classic-DiLoCo-only: streaming's "

@@ -144,10 +144,13 @@ class CheckpointManager:
     def restore(self, abstract_state: Any, step: int | None = None) -> DilocoState:
         """``abstract_state``: a DilocoState of jax.ShapeDtypeStruct leaves
         (e.g. from ``jax.eval_shape`` of init) carrying target shardings,
-        so arrays restore directly to their mesh placement."""
+        so arrays restore directly to their mesh placement. Per-leaf, the
+        SAVED partition spec overrides the caller's when the mesh matches
+        (see ``_with_saved_shardings``)."""
         step = self.latest_step if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        abstract_state = self._with_saved_shardings(abstract_state, step)
 
         def attempt():
             _faults.check_io("restore")
@@ -156,6 +159,58 @@ class CheckpointManager:
             )
 
         return self._attempt("ckpt_restore", attempt)
+
+    def _with_saved_shardings(self, abstract_state: Any, step: int) -> Any:
+        """Re-target each leaf's restore sharding to the partition spec it
+        was SAVED with (same mesh only). The caller's abstract state comes
+        from a fresh init state, and init-time shardings can differ from
+        the steady-state shardings the jitted step programs settle on
+        (inner Adam moments: unconstrained at init, 'diloco'-propagated by
+        the first compiled step's output). Restoring onto the init
+        sharding is bit-exact on the wire but makes the resumed process's
+        jits specialize on DIFFERENT input shardings than the interrupted
+        run's — the partitioner reassociates differently and the resumed
+        trajectory drifts by ulps (observed ~4e-9 on the async-outer
+        stepwise resume; resume must be bit-exact). Falls back per leaf to
+        the caller's sharding when the checkpoint predates sharding
+        metadata or was written on a different mesh (elastic resumes go
+        through ``restore_elastic``, never here)."""
+        try:
+            meta = self._mngr.item_metadata(step)
+            meta = getattr(meta, "tree", meta)
+        except Exception:
+            return abstract_state
+        if meta is None:
+            return abstract_state
+        meta_map = _path_leaf_map(meta)
+
+        def retarget(path, ab):
+            sh = getattr(ab, "sharding", None)
+            saved = getattr(meta_map.get(_path_names(path)), "sharding", None)
+            if not isinstance(sh, jax.sharding.NamedSharding) or saved is None:
+                return ab
+            names = getattr(saved, "axis_names", None)
+            mesh_shape = getattr(saved, "shape", None)
+            if (
+                names is None
+                or mesh_shape is None
+                or tuple(names) != tuple(sh.mesh.axis_names)
+                or tuple(mesh_shape) != tuple(sh.mesh.devices.shape)
+            ):
+                return ab
+            new = jax.sharding.NamedSharding(
+                sh.mesh, jax.sharding.PartitionSpec(*saved.partition_spec)
+            )
+            if getattr(sh, "memory_kind", None) is not None:
+                # an offloaded target (pinned_host snapshot) stays
+                # offloaded regardless of where the save ran from
+                new = new.with_memory_kind(sh.memory_kind)
+            return jax.ShapeDtypeStruct(ab.shape, ab.dtype, sharding=new)
+
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(abstract_state)
+        return jax.tree_util.tree_unflatten(
+            treedef, [retarget(p, ab) for p, ab in leaves]
+        )
 
     def restore_raw(
         self, step: int | None = None, only: set[str] | None = None
@@ -266,8 +321,12 @@ class CheckpointManager:
         # streaming states carry per-fragment outer opt states + pending
         # merges instead of the single outer_opt_state — both are
         # unstacked (no worker axis), so they re-broadcast across a
-        # worker-count change exactly like the classic snapshot
+        # worker-count change exactly like the classic snapshot. Async
+        # classic states (AsyncDilocoState) likewise carry unstacked
+        # pending merge(s) plus the launch bookkeeping — all global
+        # state, restored exactly; only the worker stacking is rebuilt.
         is_streaming = hasattr(fresh_state, "outer_opt_states")
+        is_async = not is_streaming and hasattr(fresh_state, "pending")
         if is_streaming:
             only = {"snapshot", "outer_opt_states", "pending",
                     "inner_step_count"}
@@ -275,6 +334,17 @@ class CheckpointManager:
                 "snapshot": fresh_state.snapshot,
                 "outer_opt_states": fresh_state.outer_opt_states,
                 "pending": fresh_state.pending,
+                "inner_step_count": fresh_state.inner_step_count,
+            }
+        elif is_async:
+            only = {"snapshot", "outer_opt_state", "pending",
+                    "pending_round", "launched_round", "inner_step_count"}
+            fresh_map = {
+                "snapshot": fresh_state.snapshot,
+                "outer_opt_state": fresh_state.outer_opt_state,
+                "pending": fresh_state.pending,
+                "pending_round": fresh_state.pending_round,
+                "launched_round": fresh_state.launched_round,
                 "inner_step_count": fresh_state.inner_step_count,
             }
         else:
@@ -422,6 +492,21 @@ class CheckpointManager:
             )
         outer = to_fresh(raw["outer_opt_state"], fresh_state.outer_opt_state)
         inner = jax.tree.map(_advance_counts(count), fresh_state.inner_opt_state)
+        if is_async:
+            # pending merges / launch markers are global state: exact.
+            # Workers reset to the restored snapshot (the elastic
+            # contract), so an owed boundary's pseudo-gradient reads
+            # zero after the restart — the interrupted round's worker
+            # deltas left with the old replicas; the outer trajectory
+            # stays coherent and deterministic.
+            pending = to_fresh(raw["pending"], fresh_state.pending)
+            return fresh_state.replace(
+                params=params, snapshot=snapshot, inner_opt_state=inner,
+                outer_opt_state=outer, pending=pending,
+                pending_round=jnp.asarray(raw["pending_round"], jnp.int32),
+                launched_round=jnp.asarray(raw["launched_round"], jnp.int32),
+                inner_step_count=count,
+            )
         return fresh_state.replace(
             params=params, snapshot=snapshot, inner_opt_state=inner,
             outer_opt_state=outer, inner_step_count=count,

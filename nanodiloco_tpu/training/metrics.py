@@ -222,6 +222,18 @@ def summarize_run(path: str) -> dict[str, Any]:
     cos = series("outer_update_cos")
     if cos:
         out["outer_update_cos_last"] = round(cos[-1], 4)
+    # async delayed-apply outer step: the realized staleness of each
+    # applied merge (rounds late), plus the mode flag itself — so a
+    # summary says which outer-sync regime produced the run's numbers
+    stale = series("outer_staleness")
+    if stale:
+        out["outer_staleness_last"] = round(float(stale[-1]), 4)
+        out["outer_staleness_max"] = round(float(max(stale)), 4)
+    if any(r.get("async_outer") for r in recs):
+        out["async_outer"] = True
+        delays = series("outer_delay")
+        if delays:
+            out["outer_delay"] = int(delays[-1])
     drop = series("moe_dropped_frac")
     if drop:
         out["moe_dropped_frac_last"] = round(drop[-1], 5)
@@ -343,7 +355,19 @@ _COMPARE_METRICS = [
     ("short_ttft_p95_s", True),
     ("decode_tokens_per_sec", False),
     ("client_tokens_per_sec", False),
+    # sync-vs-async outer-sync shares from the overlap bench differencing
+    # (scripts/streaming_overlap.py / bench.py BENCH_ASYNC): the fraction
+    # of a warm round the outer boundary costs in each mode. Shares are
+    # already ratios — gated ABSOLUTE like comm_share, only when both
+    # summaries carry them (training compares are untouched).
+    ("outer_sync_share_sync", True),
+    ("outer_sync_share_async", True),
 ]
+
+# share-of-wall-clock keys (already ratios): regress on an ABSOLUTE
+# increase past max_comm_share_increase, never a relative one
+_SHARE_KEYS = {"comm_share_last", "outer_sync_share_sync",
+               "outer_sync_share_async"}
 
 # serve latency keys (seconds, lower better) that use the dedicated
 # latency threshold instead of the loss one
@@ -403,7 +427,7 @@ def compare_runs(
             continue
         b, c = float(b), float(c)
         delta = c - b
-        if key == "comm_share_last":
+        if key in _SHARE_KEYS:
             regressed = delta > max_comm_share_increase
         elif key in _LATENCY_KEYS:
             regressed = delta > max_latency_increase * max(abs(b), 1e-12)

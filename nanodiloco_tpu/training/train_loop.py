@@ -152,6 +152,16 @@ class TrainConfig:
     # or off (smoke-gate-asserted). Classic rounds only; ignored (with
     # a notice) under streaming.
     dynamics_metrics: bool = True
+    # Async delayed-apply outer step (DilocoConfig.async_outer): launch
+    # each round boundary's all-reduce + Nesterov update without
+    # blocking, run the next round from the previous merge, apply the
+    # pending merge outer_delay rounds late. Classic rounds only
+    # (streaming IS the fragment-granularity version of this — use
+    # --streaming-delay there). Every apply's actual lateness lands in
+    # the JSONL / telemetry as outer_staleness; --watch-drift observes
+    # the delayed path through the same dynamics records.
+    async_outer: bool = False
+    outer_delay: int = 1
     model: LlamaConfig = dataclasses.field(default_factory=LlamaConfig)
     # initialize weights from an HF Llama checkpoint directory (sharded
     # or single-file safetensors) — continued pretraining. Streams
@@ -448,6 +458,15 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             "with --dynamics-metrics) — there is no drift signal to "
             "watch without them"
         )
+    async_on = cfg.async_outer and cfg.streaming_fragments == 0
+    if cfg.async_outer and not async_on:
+        raise ValueError(
+            "--async-outer is classic-rounds-only: streaming DiLoCo is "
+            "already the fragment-granularity async outer step (its "
+            "launch/apply split is --streaming-delay inner steps); a "
+            "second round-granularity delay would double-defer the same "
+            "merges"
+        )
     dcfg = DilocoConfig(
         num_workers=cfg.num_workers,
         inner_steps=cfg.inner_steps,
@@ -462,6 +481,8 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         outer_wire_collective=cfg.outer_wire_collective,
         quarantine_nonfinite=cfg.quarantine_nonfinite,
         dynamics_metrics=dynamics_on,
+        async_outer=cfg.async_outer,
+        outer_delay=cfg.outer_delay,
     )
 
     tokenizer = get_tokenizer(cfg.tokenizer)
@@ -865,6 +886,43 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     }
     wire_bytes_total = 0
 
+    # mode tag spliced into every sync-step record: async on/off (+ the
+    # configured delay), or — under streaming — the staleness its
+    # staggered applies run at (delay inner steps = delay/H rounds), so
+    # the JSONL says which outer-sync regime produced each record
+    if async_on:
+        mode_extras: dict[str, Any] = {
+            "async_outer": True, "outer_delay": cfg.outer_delay,
+        }
+    elif streaming:
+        mode_extras = {
+            "outer_staleness": cfg.streaming_delay / cfg.inner_steps,
+        }
+    else:
+        mode_extras = {}
+
+    def _log_async_boundary(aux: dict) -> None:
+        """One JSONL record per async round boundary, logged AFTER the
+        program that computed it has been fenced (fused: same-iteration;
+        stepwise: one boundary later, so the fetch never blocks on the
+        in-flight collective): the boundary's round, how many rounds
+        late the applied merge landed (outer_staleness — omitted for the
+        warm-up applies of init copies, never a fake 0), and the
+        dynamics readout, which also feeds the --watch-drift sentinel —
+        the delayed path stays under the same divergence instrument."""
+        b = int(aux["boundary_round"])
+        if b < 1:
+            return  # init no-op boundary (fresh-start fused round 1)
+        rec: dict[str, Any] = {**mode_extras}
+        if int(aux["applied_launch_round"]) >= 1:
+            rec["outer_staleness"] = int(aux["outer_staleness"])
+        step = b * cfg.inner_steps
+        if "dynamics" in aux:
+            dynm = _host_dynamics(aux["dynamics"])
+            rec.update(dynm)
+            watchdog.observe_drift(step, dynm["drift_max"])
+        logger.log(rec, step=step)
+
     # --- resilience helpers shared by both dispatch loops -------------------
     def _pump_faults(cursor_step: int, state):
         """Fault-plan hook point at the top of each dispatch unit (per
@@ -1060,6 +1118,19 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 "[nanodiloco] fused rounds disabled: resume at step "
                 f"{start_step} is mid-round"
             )
+        # Async resume can land on EITHER side of a round boundary: a
+        # fused-mode checkpoint is written pre-boundary (the state's
+        # round has run, its launch/apply has not — a pending outer is
+        # owed), a stepwise one post-boundary. launched_round is the
+        # tie-breaker; the old start_step%H guard alone cannot see an
+        # owed boundary and a resume through the wrong assumption
+        # double-applies (or drops) an outer update.
+        boundary_owed = (
+            async_on
+            and start_step > 0
+            and start_step % cfg.inner_steps == 0
+            and int(state.launched_round) < start_step // cfg.inner_steps
+        )
         # fused-mode comm estimate (the sync is compiled into the round
         # program, so its cost is measured by differencing against an
         # inner-only round — not reported as a fake 0.0)
@@ -1113,8 +1184,10 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         # donates the state buffers
                         with trace_span("cost_analysis"):
                             log_cost(
-                                dl.round_cost_analysis(state, toks, masks),
-                                "fused_round",
+                                dl.async_round_cost_analysis(state, toks, masks)
+                                if async_on
+                                else dl.round_cost_analysis(state, toks, masks),
+                                "async_round" if async_on else "fused_round",
                             )
                     measuring = cfg.measure_comm and est_inner_s is None
                     if rnd < last_round and not measuring:
@@ -1129,9 +1202,33 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         # differenced measure_comm estimate below
                         with trace_span("inner", round=rnd):
                             t0 = time.perf_counter()
-                            out = dl.round_step(state, toks, masks)
-                            state, losses, eff_mask = out[0], out[1], out[2]
-                            round_dyn = out[3] if dynamics_on else None
+                            boundary_auxes: list[dict] = []
+                            if async_on:
+                                # boundary-first async program: the
+                                # PREVIOUS round's launch/apply rides at
+                                # the top, overlappable with this round's
+                                # scan. The first program of a session
+                                # with no boundary owed (fresh start, or
+                                # a post-boundary stepwise checkpoint) is
+                                # the plain inner-only scan.
+                                if boundary_owed:
+                                    state, losses, baux = dl.async_round_step(
+                                        state, toks, masks
+                                    )
+                                    boundary_auxes.append(baux)
+                                else:
+                                    state, losses, _ = dl.inner_round_step(
+                                        state, toks, masks
+                                    )
+                                boundary_owed = True
+                                eff_mask = jnp.ones(
+                                    (cfg.num_workers,), bool
+                                )
+                                round_dyn = None
+                            else:
+                                out = dl.round_step(state, toks, masks)
+                                state, losses, eff_mask = out[0], out[1], out[2]
+                                round_dyn = out[3] if dynamics_on else None
                             jax.block_until_ready(losses)
                             round_s = time.perf_counter() - t0
                     finally:
@@ -1159,7 +1256,11 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                                 if rnd == last_round:  # no warm round 2 will come
                                     probe = jax.tree.map(jnp.copy, state)
                                     t0 = time.perf_counter()
-                                    pout = dl.round_step(probe, toks, masks)
+                                    pout = (
+                                        dl.async_round_step(probe, toks, masks)
+                                        if async_on
+                                        else dl.round_step(probe, toks, masks)
+                                    )
                                     probe, probe_loss = pout[0], pout[1]
                                     jax.block_until_ready(probe_loss)
                                     best_full_s = time.perf_counter() - t0
@@ -1179,6 +1280,16 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     if pending is None and rnd < last_round:
                         # resume the pipeline after the measurement pause
                         pending = prefetcher.submit(dl.stack_round_batches, batches)
+                    if async_on and rnd == last_round:
+                        # final boundary + drain BEFORE this round's
+                        # checkpoint/eval: the saved state and the
+                        # evaluated snapshot must carry every completed
+                        # outer update (and a resume of the finished run
+                        # must find no boundary owed)
+                        with trace_span("sync"):
+                            state, flush_aux = dl.async_flush(state)
+                            jax.block_until_ready(state.snapshot)
+                        boundary_auxes.append(flush_aux)
                     real_step = rnd * cfg.inner_steps
                     if ckpt and rnd % cfg.checkpoint_every == 0:
                         _guarded_save(real_step, state)
@@ -1267,6 +1378,14 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         watchdog.observe_drift(
                             real_step, dyn_metrics["drift_max"]
                         )
+                    for baux in boundary_auxes:
+                        # async boundary records (round, staleness, drift
+                        # dynamics): this iteration's program is already
+                        # fenced, so the host fetches stall nothing. The
+                        # record lands at the boundary's OWN step — for
+                        # the in-round aux that is the PREVIOUS round's
+                        # sync, executed at the top of this program.
+                        _log_async_boundary(baux)
                     tps = (real_step - start_step) * tokens_per_step / compute_time
                     with trace_span("log"):
                         for i in range(cfg.inner_steps):
@@ -1292,7 +1411,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                                     **(
                                         {**wire_metrics,
                                          "wire_bytes_total": wire_bytes_total,
-                                         **dyn_metrics}
+                                         **dyn_metrics, **mode_extras}
                                         if i == cfg.inner_steps - 1 else {}
                                     ),
                                 },
@@ -1332,6 +1451,17 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
 
         round_ok = None  # per-round device-side [W] finiteness (quarantine)
         quarantined_last_round = 0
+        # async stepwise: the newest boundary's aux, NOT yet host-fetched
+        # — its program was dispatched without a fence, so the record is
+        # logged one boundary later (or at the end), when fetching the
+        # scalars can no longer block on the in-flight collective
+        pending_baux: dict | None = None
+        if not fused and boundary_owed:
+            # a fused-mode async checkpoint lands pre-boundary; the owed
+            # launch/apply must run before this loop's next inner step or
+            # the resumed trajectory diverges (the pending-outer resume
+            # the start_step%H guard alone could not see)
+            state, pending_baux = dl.async_boundary(state)
         round_t0 = time.perf_counter()  # sync-to-sync wall-clock (watchdog)
         for real_step in ([] if fused else range(start_step + 1, cfg.total_steps + 1)):
             # fault hook per dispatch unit (one inner step here): a
@@ -1387,10 +1517,43 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         )
                     synced = real_step % cfg.inner_steps == 0
                     # sync steps fence on the updated params (the sync
-                    # consumes them); plain steps fence on the loss
-                    jax.block_until_ready(state.params if synced else loss)
+                    # consumes them); plain steps fence on the loss —
+                    # async boundaries consume nothing the loss does not,
+                    # so they fence the loss like any other step
+                    jax.block_until_ready(
+                        state.params if (synced and not async_on) else loss
+                    )
                     compute_time += time.perf_counter() - t0
-                if synced:
+                if synced and async_on:
+                    if pending_baux is not None:
+                        # the PREVIOUS boundary's record: its program
+                        # finished a whole round ago, the fetch is free
+                        _log_async_boundary(pending_baux)
+                        pending_baux = None
+                    step_dyn = None
+                    with trace_span("sync"), sync_timer:
+                        # the explicit fence of the async contract sits
+                        # at the APPLY: wait (only) for the merge
+                        # launched outer_delay rounds ago — the residual,
+                        # un-hidden sync cost is what the timer reads.
+                        # The fresh launch below is dispatched WITHOUT a
+                        # fence; jax's async dispatch lets the next inner
+                        # step queue behind it immediately.
+                        jax.block_until_ready(state.pending)
+                    if real_step == cfg.total_steps:
+                        # final boundary + drain as ONE program — the
+                        # SAME executable the fused loop flushes with:
+                        # splitting boundary and drain into two
+                        # dispatches lets XLA fuse the boundary's tail
+                        # differently and the settled params drift a few
+                        # ulps from the fused run's (observed ~5e-7;
+                        # cross-mode resume must stay bit-exact)
+                        state, pending_baux = dl.async_flush(state)
+                    else:
+                        state, pending_baux = dl.async_boundary(state)
+                    if ckpt and (real_step // cfg.inner_steps) % cfg.checkpoint_every == 0:
+                        _guarded_save(real_step, state)
+                elif synced:
                     if cfg.quarantine_nonfinite:
                         # EXACT count for the log: same criterion the
                         # sync applies (loss finiteness AND replica-
@@ -1482,6 +1645,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 wire_bytes_total += wire_rec["wire_bytes_per_sync"]
                 sync_extras = {
                     **wire_metrics, "wire_bytes_total": wire_bytes_total,
+                    **mode_extras,
                 }
                 if not streaming and dynamics_on and step_dyn is not None:
                     # host conversion OUTSIDE the sync timer (readout
@@ -1528,6 +1692,11 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 # round of the signal, at a resumable sync point)
                 _maybe_graceful_exit(real_step, state)
 
+        if pending_baux is not None:
+            # the run's final async boundary record (stepwise defers each
+            # by one boundary; nothing later will flush this one)
+            _log_async_boundary(pending_baux)
+            pending_baux = None
         if profiling:
             try:
                 _profiler_stop()
@@ -1644,6 +1813,8 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         **final_eval,
         "final_loss": last_loss,
         "steps": cfg.total_steps,
+        **({"async_outer": True, "outer_delay": cfg.outer_delay}
+           if async_on else {}),
         **sync_summary,
         **wire_metrics,
         "wire_bytes_total": wire_bytes_total,

@@ -1,0 +1,444 @@
+"""Async delayed-apply outer step (parallel/diloco.py async_outer):
+delay=0 bit-equivalence to the synchronous outer step, fused/stepwise
+packaging parity at delay=1, staleness bookkeeping, crash/preempt
+resume with a pending merge in flight (the fault-plan harness), and the
+JSONL/summary surfacing of outer_staleness.
+
+The semantics are the whole-model, round-granularity analog of
+streaming DiLoCo's per-fragment launch/apply split (arXiv:2501.18512):
+launch the pseudo-gradient all-reduce + Nesterov update at a round
+boundary without blocking, run the next round from the previous merge,
+apply the pending merge ``outer_delay`` boundaries late.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanodiloco_tpu.models import LlamaConfig
+from nanodiloco_tpu.parallel import (
+    AsyncDilocoState,
+    Diloco,
+    DilocoConfig,
+    MeshConfig,
+    StreamingConfig,
+    StreamingDiloco,
+    build_mesh,
+)
+from nanodiloco_tpu.resilience.faults import InjectedCrash
+from nanodiloco_tpu.resilience.supervisor import latest_checkpoint_step
+from nanodiloco_tpu.training.train_loop import TrainConfig, train
+
+TINY = LlamaConfig(
+    vocab_size=64, hidden_size=32, intermediate_size=64,
+    num_attention_heads=4, num_hidden_layers=4, max_position_embeddings=32,
+)
+
+SMALL_MODEL = LlamaConfig(
+    vocab_size=384, hidden_size=32, intermediate_size=64,
+    num_attention_heads=4, num_hidden_layers=2, max_position_embeddings=64,
+)
+
+
+def make_batch(key, W, accum=1, B=2, S=8):
+    tokens = jax.random.randint(key, (W, accum, B, S), 0, TINY.vocab_size)
+    return tokens, jnp.ones_like(tokens)
+
+
+def tree_max_diff(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return max(float(jnp.max(jnp.abs(x - y))) for x, y in zip(la, lb))
+
+
+def assert_trees_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def small_cfg(tmp_path, **kw):
+    defaults = dict(
+        seed=1337, batch_size=4, per_device_batch_size=2, seq_length=32,
+        warmup_steps=2, total_steps=9, inner_steps=3, lr=1e-3, num_workers=2,
+        model=SMALL_MODEL, log_dir=str(tmp_path / "runs"), quiet=True,
+        measure_comm=False,
+    )
+    defaults.update(kw)
+    return TrainConfig(**defaults)
+
+
+def run_jsonl(tmp_path, run_name):
+    return str(tmp_path / "runs" / f"{run_name}.jsonl")
+
+
+def read_lines(path):
+    return [json.loads(line) for line in open(path)]
+
+
+# ---------------------------------------------------------------------------
+# delay=0 ≡ synchronous classic DiLoCo (the classic analog of streaming's
+# test_p1_delay0_equals_classic_diloco)
+# ---------------------------------------------------------------------------
+
+def test_delay0_equals_classic_bitwise():
+    """outer_delay=0 must reproduce the synchronous outer step EXACTLY,
+    step-for-step — through the stepwise boundary AND through the fused
+    boundary-first packaging (inner-only first round, boundary+scan
+    after, flush at the end)."""
+    W, H, K = 4, 2, 3
+    mesh = build_mesh(MeshConfig(diloco=W))
+    batches = [make_batch(jax.random.key(i), W) for i in range(1, K * H + 1)]
+
+    cfg = DilocoConfig(num_workers=W, inner_steps=H, warmup_steps=2,
+                       total_steps=20, lr=1e-3)
+    classic = Diloco(TINY, cfg, mesh)
+    cs = classic.init_state(jax.random.key(0))
+    closs = []
+    for t, (tok, m) in enumerate(batches, start=1):
+        cs, loss = classic.inner_step(cs, tok, m)
+        closs.append(np.asarray(loss))
+        if t % H == 0:
+            cs = classic.outer_step(cs)
+
+    acfg = DilocoConfig(num_workers=W, inner_steps=H, warmup_steps=2,
+                        total_steps=20, lr=1e-3,
+                        async_outer=True, outer_delay=0)
+    a = Diloco(TINY, acfg, mesh)
+    sw = a.init_state(jax.random.key(0))
+    swloss = []
+    for t, (tok, m) in enumerate(batches, start=1):
+        sw, loss = a.inner_step(sw, tok, m)
+        swloss.append(np.asarray(loss))
+        if t % H == 0:
+            sw, aux = a.async_boundary(sw)
+            assert int(aux["outer_staleness"]) == 0  # launch IS the apply
+    np.testing.assert_array_equal(np.stack(closs), np.stack(swloss))
+    assert_trees_equal(cs.snapshot, sw.snapshot)
+    assert_trees_equal(cs.params, sw.params)
+
+    fu = a.init_state(jax.random.key(0))
+    fuloss = []
+    for k in range(K):
+        toks = jnp.stack([b[0] for b in batches[k * H:(k + 1) * H]])
+        masks = jnp.stack([b[1] for b in batches[k * H:(k + 1) * H]])
+        if k == 0:  # fresh start: no boundary owed yet
+            fu, loss, _ = a.inner_round_step(fu, toks, masks)
+        else:       # boundary-first steady-state program
+            fu, loss, _ = a.async_round_step(fu, toks, masks)
+        fuloss.append(np.asarray(loss))
+    fu, _ = a.async_flush(fu)
+    np.testing.assert_array_equal(
+        np.stack(closs), np.concatenate(fuloss).reshape(-1, W)
+    )
+    assert_trees_equal(cs.snapshot, fu.snapshot)
+    assert_trees_equal(cs.params, fu.params)
+
+
+# ---------------------------------------------------------------------------
+# delay=1: fused/stepwise packaging parity + staleness bookkeeping
+# ---------------------------------------------------------------------------
+
+def test_delay1_fused_matches_stepwise_and_staleness():
+    """The boundary-first fused round program must be bit-identical to
+    driving the same boundaries stepwise; every steady-state apply lands
+    exactly outer_delay rounds late, the warm-up applies are init copies
+    (launch round 0), and the trajectory genuinely differs from the
+    synchronous path (the staleness is real, not a relabeling)."""
+    W, H, K = 4, 2, 3
+    mesh = build_mesh(MeshConfig(diloco=W))
+    batches = [make_batch(jax.random.key(i), W) for i in range(1, K * H + 1)]
+    acfg = DilocoConfig(num_workers=W, inner_steps=H, warmup_steps=2,
+                        total_steps=20, lr=1e-3,
+                        async_outer=True, outer_delay=1,
+                        dynamics_metrics=True)
+    a = Diloco(TINY, acfg, mesh)
+
+    sw = a.init_state(jax.random.key(0))
+    assert isinstance(sw, AsyncDilocoState)
+    marks = []
+    for t, (tok, m) in enumerate(batches, start=1):
+        sw, _ = a.inner_step(sw, tok, m)
+        if t % H == 0:
+            # final boundary settles via flush — the SAME executable the
+            # fused path drains with (a separate boundary+drain pair can
+            # fuse differently and drift a few ulps)
+            sw, aux = (a.async_flush(sw) if t == K * H
+                       else a.async_boundary(sw))
+            marks.append((int(aux["boundary_round"]),
+                          int(aux["applied_launch_round"]),
+                          int(aux["outer_staleness"])))
+            assert "dynamics" in aux and "drift_max" in aux["dynamics"]
+    # boundary 1 applies the init copy (warm-up); every later apply is
+    # the merge launched exactly one round earlier
+    assert marks == [(1, 0, 1), (2, 1, 1), (3, 2, 1)]
+
+    fu = a.init_state(jax.random.key(0))
+    for k in range(K):
+        toks = jnp.stack([b[0] for b in batches[k * H:(k + 1) * H]])
+        masks = jnp.stack([b[1] for b in batches[k * H:(k + 1) * H]])
+        if k == 0:
+            fu, _, _ = a.inner_round_step(fu, toks, masks)
+        else:
+            fu, _, aux = a.async_round_step(fu, toks, masks)
+            assert int(aux["boundary_round"]) == k  # the PREVIOUS round's
+    fu, flush_aux = a.async_flush(fu)
+    assert int(flush_aux["boundary_round"]) == K
+    assert int(flush_aux["outer_staleness"]) == 1
+    assert_trees_equal(sw.snapshot, fu.snapshot)
+    assert_trees_equal(sw.params, fu.params)
+    assert int(fu.launched_round) == K
+    # drained slots are init-marked copies of the final snapshot
+    assert np.asarray(fu.pending_round).tolist() == [0]
+    assert tree_max_diff(fu.pending[0], fu.snapshot) == 0.0
+
+    # the delayed path is a DIFFERENT (staleness-1) trajectory from the
+    # synchronous one — if they matched bitwise the delay did nothing
+    cfg = DilocoConfig(num_workers=W, inner_steps=H, warmup_steps=2,
+                       total_steps=20, lr=1e-3)
+    classic = Diloco(TINY, cfg, mesh)
+    cs = classic.init_state(jax.random.key(0))
+    for t, (tok, m) in enumerate(batches, start=1):
+        cs, _ = classic.inner_step(cs, tok, m)
+        if t % H == 0:
+            cs = classic.outer_step(cs)
+    assert tree_max_diff(cs.snapshot, fu.snapshot) > 0.0
+
+
+def test_async_rejected_combinations():
+    mesh = build_mesh(MeshConfig(diloco=2))
+    with pytest.raises(ValueError, match="outer_delay"):
+        Diloco(TINY, DilocoConfig(num_workers=2, inner_steps=2,
+                                  async_outer=True, outer_delay=-1), mesh)
+    with pytest.raises(ValueError, match="synchronous-outer-only"):
+        Diloco(TINY, DilocoConfig(num_workers=2, inner_steps=2,
+                                  async_outer=True,
+                                  quarantine_nonfinite=True), mesh)
+    with pytest.raises(ValueError, match="synchronous-outer-only"):
+        Diloco(TINY, DilocoConfig(num_workers=2, inner_steps=2,
+                                  async_outer=True,
+                                  offload_snapshot=True), mesh)
+    with pytest.raises(ValueError, match="classic-DiLoCo-only"):
+        StreamingDiloco(
+            TINY,
+            DilocoConfig(num_workers=2, inner_steps=4, async_outer=True),
+            mesh, StreamingConfig(num_fragments=2, delay=1),
+        )
+
+
+def test_cli_async_flags(tmp_path):
+    from nanodiloco_tpu.cli import build_parser, config_from_args
+
+    args = build_parser().parse_args(
+        ["--async-outer", "--outer-delay", "2", "--num-workers", "2"]
+    )
+    cfg = config_from_args(args)
+    assert cfg.async_outer is True and cfg.outer_delay == 2
+    # streaming + async is a contradiction, rejected up front
+    with pytest.raises(ValueError, match="classic-rounds-only"):
+        train(small_cfg(tmp_path, async_outer=True, streaming_fragments=2))
+
+
+# ---------------------------------------------------------------------------
+# the training driver: delay=0 ≡ classic end to end; JSONL surfacing
+# ---------------------------------------------------------------------------
+
+def test_train_async_delay0_matches_classic(tmp_path):
+    """--async-outer --outer-delay 0 through the real driver (fused
+    default, dynamics on) is bit-identical to the synchronous path —
+    the train-loop wiring adds nothing to the math."""
+    a = train(small_cfg(tmp_path / "a", total_steps=6))
+    b = train(small_cfg(tmp_path / "b", total_steps=6,
+                        async_outer=True, outer_delay=0))
+    assert b["final_loss"] == a["final_loss"]
+    assert_trees_equal(a["state"].params, b["state"].params)
+
+
+def test_train_async_jsonl_staleness_and_summary(tmp_path):
+    """A delay=1 run records outer_staleness >= 1 applies and the
+    async_outer mode flag in the sync JSONL, the boundary records carry
+    the drift dynamics (the --watch-drift instrument observes the
+    delayed path), and summarize_run surfaces all of it."""
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    summary = train(small_cfg(
+        tmp_path, async_outer=True, outer_delay=1, run_name="async",
+    ))
+    assert summary["async_outer"] is True and summary["outer_delay"] == 1
+    recs = read_lines(run_jsonl(tmp_path, "async"))
+    stale = [r for r in recs if r.get("outer_staleness") is not None]
+    assert stale and all(r["outer_staleness"] == 1 for r in stale)
+    # boundary 1's apply is the warm-up init copy: no staleness key at
+    # step 3; boundaries 2 and 3 (flush) apply real merges
+    assert sorted(r["step"] for r in stale) == [6, 9]
+    drift = [r for r in recs if r.get("drift_max") is not None]
+    assert len(drift) == 3  # one dynamics readout per boundary
+    syncs = [r for r in recs if r.get("outer_synced")]
+    assert all(r.get("async_outer") for r in syncs)
+    out = summarize_run(run_jsonl(tmp_path, "async"))
+    assert out["async_outer"] is True and out["outer_delay"] == 1
+    assert out["outer_staleness_last"] == 1 and out["outer_staleness_max"] == 1
+    assert "drift_max_last" in out
+
+
+def test_train_async_stepwise_matches_fused(tmp_path):
+    """The stepwise driver (unfenced boundary dispatch, apply-side fence)
+    lands bit-identical to the fused boundary-first packaging."""
+    a = train(small_cfg(tmp_path / "a", async_outer=True, outer_delay=1))
+    b = train(small_cfg(tmp_path / "b", async_outer=True, outer_delay=1,
+                        fused_rounds=False))
+    assert_trees_equal(a["state"].params, b["state"].params)
+    # the stepwise summary's comm_share is the RESIDUAL apply-wait, not
+    # the collective's cost (which overlaps); it must exist and be sane
+    assert 0 <= b["comm_share"] < 1
+
+
+# ---------------------------------------------------------------------------
+# crash + resume with a pending merge in flight (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_async_crash_resume_bit_exact_with_pending_outer(tmp_path):
+    """Crashes at both kinds of async checkpoint — one before any real
+    merge exists (warm-up) and one with a launched-but-unapplied merge
+    in the checkpoint — must resume bit-exact through BOTH loop modes
+    (fused checkpoints land pre-boundary, so the resume owes a boundary;
+    the stepwise resume exercises the owed-boundary path the old
+    start_step%H guard could not see)."""
+    full = train(small_cfg(tmp_path / "a", async_outer=True, outer_delay=1,
+                           run_name="full"))
+    full_lines = read_lines(run_jsonl(tmp_path / "a", "full"))
+    full_by_step = {l["step"]: l["loss"] for l in full_lines if "loss" in l}
+
+    def crash_then_resume(tag, crash_step, expect_ckpt, resume_fused):
+        plan = str(tmp_path / f"plan{tag}.json")
+        with open(plan, "w") as f:
+            json.dump({"faults": [
+                {"kind": "crash", "step": crash_step, "raise": True}
+            ]}, f)
+        ck = str(tmp_path / f"ck{tag}")
+        with pytest.raises(InjectedCrash):
+            train(small_cfg(tmp_path / f"b{tag}", async_outer=True,
+                            outer_delay=1, checkpoint_dir=ck,
+                            fault_plan=plan, run_name="crashed"))
+        deadline = time.time() + 30
+        while latest_checkpoint_step(ck) != expect_ckpt and time.time() < deadline:
+            time.sleep(0.1)
+        assert latest_checkpoint_step(ck) == expect_ckpt
+        resumed = train(small_cfg(
+            tmp_path / f"c{tag}", async_outer=True, outer_delay=1,
+            checkpoint_dir=ck, fault_plan=plan, fused_rounds=resume_fused,
+            run_name="resumed",
+        ))
+        for l in read_lines(run_jsonl(tmp_path / f"c{tag}", "resumed")):
+            if "loss" in l:
+                assert l["loss"] == full_by_step[l["step"]], (tag, l["step"])
+        assert_trees_equal(full["state"].params, resumed["state"].params)
+
+    # ckpt at step 3: round 1 ran, boundary 1 owed, pendings still init
+    crash_then_resume("warmup", crash_step=5, expect_ckpt=3,
+                      resume_fused=True)
+    # ckpt at step 6: boundary 1 ran inside round 2's program — the
+    # checkpoint carries a REAL launched-but-unapplied merge; resume
+    # through the stepwise loop (cross-mode, owed boundary up front)
+    crash_then_resume("pending", crash_step=8, expect_ckpt=6,
+                      resume_fused=False)
+
+
+def test_async_elastic_restore_preserves_pending(tmp_path):
+    """restore_elastic at a different worker count keeps the async
+    global state exactly — snapshot, pending merge(s), launch markers,
+    outer momentum — and rebuilds the worker stacking from the
+    snapshot (the classic elastic contract)."""
+    from nanodiloco_tpu.training.checkpoint import CheckpointManager
+
+    H = 2
+    mesh = build_mesh(MeshConfig(diloco=2))
+    acfg = DilocoConfig(num_workers=2, inner_steps=H, warmup_steps=2,
+                        total_steps=20, lr=1e-3,
+                        async_outer=True, outer_delay=1)
+    a = Diloco(TINY, acfg, mesh)
+    state = a.init_state(jax.random.key(0))
+    for t in range(1, 2 * H + 1):
+        tok, m = make_batch(jax.random.key(t), 2)
+        state, _ = a.inner_step(state, tok, m)
+        if t % H == 0:
+            state, _ = a.async_boundary(state)
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    ck.save(2 * H, state)
+    ck.wait()
+
+    mesh1 = build_mesh(MeshConfig(diloco=1), devices=jax.devices()[:1])
+    a1 = Diloco(TINY, DilocoConfig(num_workers=1, inner_steps=H,
+                                   warmup_steps=2, total_steps=20, lr=1e-3,
+                                   async_outer=True, outer_delay=1), mesh1)
+    fresh = a1.init_state(jax.random.key(7))
+    ck1 = CheckpointManager(str(tmp_path / "ck"))
+    assert ck1.saved_worker_count() == 2
+    restored = ck1.restore_elastic(fresh)
+    ck.close()
+    ck1.close()
+    host = jax.device_get
+    assert tree_max_diff(host(restored.snapshot), host(state.snapshot)) == 0.0
+    assert tree_max_diff(host(restored.pending), host(state.pending)) == 0.0
+    assert int(restored.launched_round) == int(state.launched_round) == 2
+    assert np.asarray(restored.pending_round).tolist() == \
+        np.asarray(state.pending_round).tolist()
+    # workers rebuilt by broadcast of the restored snapshot
+    for leaf, snap in zip(jax.tree.leaves(restored.params),
+                          jax.tree.leaves(restored.snapshot)):
+        np.testing.assert_array_equal(
+            np.asarray(leaf), np.asarray(snap)[None]
+        )
+
+
+# ---------------------------------------------------------------------------
+# report compare gating of the overlap-bench shares
+# ---------------------------------------------------------------------------
+
+def test_report_compare_gates_outer_sync_share(tmp_path):
+    """The committed async-overlap baseline gates outer_sync_share_sync
+    and outer_sync_share_async through report compare in BOTH
+    directions (absolute-share threshold, like comm_share)."""
+    import os
+
+    from nanodiloco_tpu.training.metrics import compare_runs, load_comparable
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base = load_comparable(os.path.join(repo, "async_overlap_baseline.json"))
+    assert 0 <= base["outer_sync_share_async"] <= 1
+    assert 0 <= base["outer_sync_share_sync"] <= 1
+
+    worse = {**base,
+             "outer_sync_share_async": base["outer_sync_share_async"] + 0.2}
+    res = compare_runs(base, worse)
+    assert res["regressions"] == ["outer_sync_share_async"]
+
+    better = {**base,
+              "outer_sync_share_async": 0.0, "outer_sync_share_sync": 0.0}
+    res = compare_runs(base, better)
+    assert res["ok"]
+    # and the reverse direction flags the sync share too
+    res = compare_runs(better, base)
+    assert "outer_sync_share_sync" in res["regressions"] or \
+        base["outer_sync_share_sync"] <= 0.05
+
+
+def test_summarize_surfaces_streaming_staleness(tmp_path):
+    """Streaming sync records carry their fragment stagger as
+    outer_staleness (delay/H rounds); summarize_run surfaces it without
+    claiming the run was async."""
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    path = tmp_path / "run.jsonl"
+    with open(path, "w") as f:
+        for step in (2, 4):
+            f.write(json.dumps({
+                "loss": 5.0, "step": step, "outer_synced": 1,
+                "outer_staleness": 0.25,
+            }) + "\n")
+    out = summarize_run(str(path))
+    assert out["outer_staleness_last"] == 0.25
+    assert "async_outer" not in out

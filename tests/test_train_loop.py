@@ -143,6 +143,15 @@ def test_train_loop_streaming(tmp_path):
         streaming_fragments=2, streaming_delay=1, merge_alpha=0.5,
     ))
     assert np.isfinite(full["final_loss"])
+    # streaming sync records surface the fragment stagger as its
+    # staleness in rounds (delay / inner_steps) — the same key the
+    # async outer path logs its realized apply lateness under
+    runs = os.listdir(tmp_path / "a" / "runs")
+    sync_lines = [l for l in _metric_lines(tmp_path / "a" / "runs" / runs[0])
+                  if l.get("outer_synced")]
+    assert sync_lines and all(
+        l.get("outer_staleness") == pytest.approx(1 / 3) for l in sync_lines
+    )
     train(small_cfg(
         tmp_path / "b", total_steps=3,
         streaming_fragments=2, streaming_delay=1, merge_alpha=0.5,
