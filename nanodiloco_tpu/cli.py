@@ -521,6 +521,11 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "trace-event JSON at shutdown — merges with "
                         "training shards via `report merge-trace` onto "
                         "one Perfetto timeline")
+    p.add_argument("--blackbox", type=str, default=None, metavar="JSON",
+                   help="arm the crash flight recorder (obs/flightrec): "
+                        "keep a bounded ring of recent request outcomes "
+                        "and dump it atomically to this path if the "
+                        "engine loop dies — render with `report blackbox`")
     p.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
                    help="enable POST /debug/profile?seconds=N: capture a "
                         "jax.profiler trace from the LIVE serving process "
@@ -582,6 +587,16 @@ def serve_main(argv: list[str]) -> None:
         f"(slots={args.slots}, max_len={max_len}); POST /v1/generate",
         flush=True,
     )
+    # installed only once construction/startup succeeded — a failed
+    # launch must not leak the process-global recorder; the finally
+    # below always runs from here on and restores it
+    prev_recorder = None
+    if args.blackbox:
+        from nanodiloco_tpu.obs import flightrec
+
+        prev_recorder = flightrec.install(
+            flightrec.FlightRecorder(dump_path=args.blackbox)
+        )
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         try:
@@ -605,6 +620,10 @@ def serve_main(argv: list[str]) -> None:
                 print(f"serve span trace -> {args.trace_out}", flush=True)
             except OSError:
                 pass  # a full disk must not mask the shutdown
+        if args.blackbox:
+            from nanodiloco_tpu.obs import flightrec
+
+            flightrec.install(prev_recorder)
 
 
 def _append_serve_stats(path: str, scheduler) -> None:
@@ -730,6 +749,17 @@ def report_main(argv: list[str]) -> None:
     step order — reconstructed from the JSONL records the resilience
     stack writes.
 
+    ``report goodput RUN.jsonl``: the run's wall-clock budget — every
+    second attributed to a cause (compute, outer_sync, compile_warmup,
+    checkpoint, data_wait, eval, resume_restore, stall,
+    restart_downtime, other), stitched across supervised restarts into
+    one end-to-end goodput fraction and tokens-per-wall-clock-second
+    (obs/goodput ledger records).
+
+    ``report blackbox DUMP.json``: the crash flight recorder's last-N
+    event timeline (obs/flightrec) — the spans, heartbeats, alarms, and
+    records a dying process managed to dump.
+
     ``report drift RUN.jsonl``: the run's DiLoCo dynamics timeline —
     per-sync cross-worker drift, per-worker pseudo-gradient norms,
     outer-momentum norm, and pseudo-gradient/update cosine (the
@@ -740,6 +770,12 @@ def report_main(argv: list[str]) -> None:
         return
     if argv[:1] == ["drift"]:
         report_drift_main(argv[1:])
+        return
+    if argv[:1] == ["goodput"]:
+        report_goodput_main(argv[1:])
+        return
+    if argv[:1] == ["blackbox"]:
+        report_blackbox_main(argv[1:])
         return
     if argv[:1] == ["merge-trace"]:
         report_merge_trace_main(argv[1:])
@@ -963,6 +999,100 @@ def report_faults_main(argv: list[str]) -> None:
         )
         label = e.get("kind") or e.get("op") or e.get("reason") or ""
         print(f"step {e.get('step', '?'):>8}  {e['event']:<8} {label:<18} {detail}")
+
+
+def report_goodput_main(argv: list[str]) -> None:
+    """``report goodput RUN.jsonl``: the cause-ordered wall-clock budget
+    table plus the goodput fraction — stitched across process lifetimes
+    when the JSONL spans supervised restarts, so a crash-loopy run
+    reports ONE honest end-to-end number (restart downtime included)."""
+    p = argparse.ArgumentParser(prog="nanodiloco_tpu report goodput")
+    p.add_argument("jsonl", help="metrics JSONL written by training "
+                                 "(goodput records are on by default)")
+    p.add_argument("--json", action="store_true",
+                   help="print the stitched ledger as one JSON object")
+    args = p.parse_args(argv)
+
+    from nanodiloco_tpu.obs.goodput import CAUSES, stitch_goodput_records
+    from nanodiloco_tpu.training.metrics import read_jsonl_records
+
+    recs, _torn = read_jsonl_records(args.jsonl)
+    stitched = stitch_goodput_records(recs)
+    if stitched is None:
+        raise SystemExit(
+            f"{args.jsonl} has no goodput records: the run predates the "
+            "goodput ledger"
+        )
+    if args.json:
+        print(json.dumps(stitched))
+        return
+    elapsed = stitched["elapsed_s"]
+    print(f"{'elapsed':>18}: {elapsed:.3f} s over "
+          f"{stitched['lifetimes']} process lifetime(s)")
+    # cause-ordered budget: biggest first — the table an operator reads
+    # top-down to find where the wall-clock went
+    by_cause = sorted(
+        ((c, stitched.get(f"{c}_s", 0.0)) for c in CAUSES),
+        key=lambda cv: -cv[1],
+    )
+    for cause, s in by_cause:
+        if s <= 0:
+            continue
+        share = s / elapsed if elapsed else 0.0
+        print(f"{cause:>18}: {s:10.3f} s  {share:7.2%}")
+    gf = stitched.get("goodput_fraction")
+    print(f"{'goodput_fraction':>18}: "
+          + (f"{gf:.4f}" if gf is not None else "n/a"))
+    if stitched.get("badput_top_cause"):
+        print(f"{'badput_top_cause':>18}: {stitched['badput_top_cause']}")
+    if stitched.get("tokens_per_wall_s") is not None:
+        print(f"{'tokens_per_wall_s':>18}: {stitched['tokens_per_wall_s']}"
+              " (restarts included)")
+
+
+def report_blackbox_main(argv: list[str]) -> None:
+    """``report blackbox DUMP.json``: render a crash flight-recorder
+    dump (obs/flightrec) as a last-N event timeline — the forensic view
+    of a process's final moments."""
+    p = argparse.ArgumentParser(prog="nanodiloco_tpu report blackbox")
+    p.add_argument("dump", help="a <run>-blackbox.json flight-recorder "
+                                "dump (the supervisor's crash event "
+                                "records its path)")
+    p.add_argument("-n", "--last", type=int, default=50,
+                   help="how many trailing events to show (default 50)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw dump document")
+    args = p.parse_args(argv)
+
+    with open(args.dump) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or not doc.get("blackbox"):
+        raise SystemExit(
+            f"{args.dump} is not a flight-recorder dump (no 'blackbox' "
+            "marker)"
+        )
+    if args.json:
+        print(json.dumps(doc))
+        return
+    import datetime as _dt
+
+    def _ts(t) -> str:
+        if not isinstance(t, (int, float)):
+            return "?"
+        return _dt.datetime.fromtimestamp(t).strftime("%H:%M:%S.%f")[:-3]
+
+    events = doc.get("events") or []
+    print(f"blackbox: reason={doc.get('reason')} pid={doc.get('pid')} "
+          f"dumped_at={_ts(doc.get('t_unix'))} "
+          f"events={len(events)}"
+          + (f" (+{doc['dropped_events']} older dropped)"
+             if doc.get("dropped_events") else ""))
+    for ev in (events[-args.last:] if args.last > 0 else []):
+        data = ev.get("data") or {}
+        detail = " ".join(f"{k}={v}" for k, v in data.items())
+        if len(detail) > 140:
+            detail = detail[:137] + "..."
+        print(f"{_ts(ev.get('t_unix')):>14}  {ev.get('kind', '?'):<10} {detail}")
 
 
 def report_drift_main(argv: list[str]) -> None:

@@ -19,6 +19,9 @@ retries, resumes, supervisor restarts) alongside the training gauges —
 see ``nanodiloco_tpu/resilience``.
 """
 
+from nanodiloco_tpu.obs.flightrec import FlightRecorder
+from nanodiloco_tpu.obs.goodput import CAUSES as GOODPUT_CAUSES
+from nanodiloco_tpu.obs.goodput import GoodputLedger, stitch_goodput_records
 from nanodiloco_tpu.obs.tracer import (
     SpanTracer,
     current_tracer,
@@ -37,6 +40,10 @@ from nanodiloco_tpu.obs.telemetry import (
 )
 
 __all__ = [
+    "FlightRecorder",
+    "GoodputLedger",
+    "GOODPUT_CAUSES",
+    "stitch_goodput_records",
     "SpanTracer",
     "current_tracer",
     "merge_chrome_traces",

@@ -202,6 +202,7 @@ class TelemetryServer:
         self._gauges: dict[str, float] = {}
         self._worker_pg: dict[int, float] = {}  # worker -> last pg norm
         self._phases: dict[str, float] = {}
+        self._badput: dict[str, float] = {}  # cause -> cumulative seconds
         self._alarms: dict[str, int] = {}
         self._faults: dict[str, int] = {}    # injected-fault records by kind
         self._retries: dict[str, int] = {}   # IO retry records by op
@@ -301,6 +302,22 @@ class TelemetryServer:
                     for w, nv in enumerate(v):
                         if isinstance(nv, (int, float)):
                             self._worker_pg[w] = float(nv)
+                elif k == "goodput" and isinstance(v, dict):
+                    # goodput ledger snapshot (obs/goodput): the
+                    # fraction as a gauge, every badput cause's
+                    # cumulative seconds as a labeled counter family —
+                    # the scrapeable wall-clock budget
+                    gf = v.get("goodput_fraction")
+                    if isinstance(gf, (int, float)):
+                        self._gauges["nanodiloco_goodput_fraction"] = float(gf)
+                    from nanodiloco_tpu.obs.goodput import CAUSES
+
+                    for cause in CAUSES:
+                        if cause == "compute":
+                            continue
+                        s = v.get(f"{cause}_s")
+                        if isinstance(s, (int, float)):
+                            self._badput[cause] = float(s)
                 elif k.startswith("t_") and isinstance(v, (int, float)):
                     self._phases[k[2:]] = float(v)
                 elif k == "cost_analysis" and isinstance(v, dict):
@@ -320,6 +337,7 @@ class TelemetryServer:
             gauges = dict(self._gauges)
             worker_pg = dict(self._worker_pg)
             phases = dict(self._phases)
+            badput = dict(self._badput)
             alarms = dict(self._alarms)
             faults = dict(self._faults)
             retries = dict(self._retries)
@@ -334,6 +352,10 @@ class TelemetryServer:
         helps["nanodiloco_restarts"] = (
             "supervisor restarts preceding this process (from the "
             "resume record)"
+        )
+        helps["nanodiloco_goodput_fraction"] = (
+            "fraction of this lifetime's wall-clock attributed to "
+            "compute (obs/goodput ledger)"
         )
         families: list = [
             (name, "gauge", helps.get(name), [(None, gauges[name])])
@@ -351,6 +373,13 @@ class TelemetryServer:
                 "nanodiloco_phase_seconds", "gauge",
                 "last round's host-side phase budget",
                 [({"phase": ph}, phases[ph]) for ph in sorted(phases)],
+            ))
+        if badput:
+            families.append((
+                "nanodiloco_badput_seconds", "counter",
+                "this lifetime's wall-clock seconds NOT spent computing, "
+                "by attributed cause (obs/goodput ledger)",
+                [({"cause": c}, badput[c]) for c in sorted(badput)],
             ))
         # resilience counters: alarms/injected faults by kind, IO retries
         # by op, checkpoint resumes — the scrapeable fault timeline

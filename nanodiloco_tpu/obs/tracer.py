@@ -35,6 +35,8 @@ import time
 from contextlib import contextmanager
 from typing import Any, Callable
 
+from nanodiloco_tpu.obs import flightrec
+
 
 class SpanTracer:
     """Records nested host-side spans; thread-safe, clock-injectable.
@@ -122,6 +124,11 @@ class SpanTracer:
                     self._dropped += drop
                 if depth == 0:
                     self._totals[name] = self._totals.get(name, 0.0) + (t1 - t0)
+            if depth == 0:
+                # black-box feed (obs/flightrec): the crash dump's last-N
+                # timeline should show which phases ran up to the fatal
+                # moment. One is-None check when no recorder is installed.
+                flightrec.record_event("span", name=name, s=round(t1 - t0, 6))
 
     def record_span(self, name: str, t0: float, t1: float, **args: Any) -> None:
         """Record an ALREADY-TIMED span: ``t0``/``t1`` are values of

@@ -38,6 +38,8 @@ import time
 from collections import deque
 from typing import Any, Callable
 
+from nanodiloco_tpu.obs import flightrec
+
 
 @dataclasses.dataclass(frozen=True)
 class WatchdogConfig:
@@ -98,6 +100,11 @@ class Watchdog:
         self._status_extra: dict[str, Any] = {}
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        # run age: /healthz and --status-file must answer "how long has
+        # this run existed", not just "how fresh is the last step" — a
+        # restart loop looks perfectly fresh step-wise while uptime
+        # keeps resetting
+        self._started_unix = time.time()
 
     # -- alarm plumbing ------------------------------------------------------
 
@@ -112,11 +119,22 @@ class Watchdog:
             self._last_alarm = rec
         self._emit(rec)
         self._write_status()
-        if self._on_fatal is not None and kind in self._fatal_kinds:
+        if kind in self._fatal_kinds:
+            # black-box dump on FATAL alarms regardless of watch action:
+            # a stalled/NaN'd run is exactly the one whose recent
+            # timeline must survive whatever happens next (the emit
+            # above already put the alarm record in the ring via the
+            # logger feed). Observe-only runs keep the dump too — it is
+            # evidence, not an action.
             try:
-                self._on_fatal(kind, step)
+                flightrec.dump_current(f"watchdog:{kind}")
             except Exception:
                 pass
+            if self._on_fatal is not None:
+                try:
+                    self._on_fatal(kind, step)
+                except Exception:
+                    pass
 
     def alarm(self, kind: str, step: int, **detail: Any) -> None:
         """Explicitly-raised external alarm (e.g. the train loop's
@@ -230,6 +248,7 @@ class Watchdog:
             self._last_step = int(step)
             self._status_extra.update(status)
         self._rearm("stall")
+        flightrec.record_event("heartbeat", step=int(step), **status)
         self._write_status()
 
     def check_stall(self, now: float | None = None) -> bool:
@@ -292,10 +311,13 @@ class Watchdog:
     def _status_doc_locked(self, state: str) -> dict:
         """Build the status document; caller holds ``self._lock``."""
         stalled = not self._armed["stall"]
+        now = time.time()
         return {
             "state": "stalled" if (state == "running" and stalled) else state,
             "step": self._last_step,
-            "updated_unix": time.time(),
+            "updated_unix": now,
+            "started_unix": self._started_unix,
+            "uptime_s": round(now - self._started_unix, 3),
             "alarms": self._alarm_count,
             **({"alarm_kinds": dict(self._alarm_kinds)}
                if self._alarm_kinds else {}),

@@ -299,6 +299,15 @@ def fire_crash(fault: dict[str, Any]) -> None:
         raise InjectedCrash(fault["step"], fault["code"])
     import os
 
+    # the hard crash skips EVERY teardown path by design — the flight
+    # recorder must dump before the exit or the black box dies with the
+    # process (the whole point of a black box)
+    try:
+        from nanodiloco_tpu.obs import flightrec
+
+        flightrec.dump_current(f"crash_fault:step{fault['step']}")
+    except Exception:
+        pass
     os._exit(fault["code"])
 
 

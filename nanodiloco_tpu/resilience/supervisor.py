@@ -36,6 +36,7 @@ supervisor preempts the whole tree cleanly.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import random
 import signal
@@ -59,6 +60,62 @@ WATCHDOG_EXIT_CODE = 76
 #: stream.
 RESTART_ENV = "NANODILOCO_RESTART"
 
+#: Environment variable the supervisor sets for the child: seconds of
+#: wall-clock between the PREVIOUS child's exit and this launch (the
+#: relaunch gap — backoff sleep plus spawn overhead). The child's
+#: goodput ledger (obs/goodput.py) books it as ``restart_downtime``, so
+#: the gap during which NO process existed still lands in the one
+#: JSONL stream and the stitched end-to-end goodput fraction is honest.
+DOWNTIME_ENV = "NANODILOCO_DOWNTIME_S"
+
+
+def find_blackbox_dump(
+    log_dir: str | None, since_unix: float, child_pid: int | None = None
+) -> str | None:
+    """Newest ``*-blackbox.json`` flight-recorder dump (obs/flightrec)
+    in ``log_dir`` written by THIS child — how the supervisor attaches
+    the crashed child's black box to its ``crash`` event without
+    knowing the child's run name. The dump document's own ``pid`` is
+    the discriminator when the caller knows the child's (two supervised
+    runs sharing one log dir, or a stale dump from a previous child
+    surviving a short backoff, must never cross-attach); the document's
+    ``t_unix`` (falling back to file mtime) must be at/after the
+    child's launch. None when the child never dumped (or the dir is
+    unset/missing)."""
+    if not log_dir or not os.path.isdir(log_dir):
+        return None
+    best: tuple[float, str] | None = None
+    try:
+        names = os.listdir(log_dir)
+    except OSError:
+        return None
+    for name in names:
+        if not name.endswith("-blackbox.json"):
+            continue
+        path = os.path.join(log_dir, name)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue  # torn/foreign file — never a crash's evidence
+        if not isinstance(doc, dict) or not doc.get("blackbox"):
+            continue
+        pid = doc.get("pid")
+        if (
+            child_pid is not None and pid is not None
+            and int(pid) != int(child_pid)
+        ):
+            continue
+        t = doc.get("t_unix")
+        if not isinstance(t, (int, float)):
+            try:
+                t = os.path.getmtime(path)
+            except OSError:
+                continue
+        if t >= since_unix and (best is None or t > best[0]):
+            best = (t, path)
+    return best[1] if best else None
+
 
 def latest_checkpoint_step(directory: str | None) -> int | None:
     """Latest committed checkpoint step in an Orbax checkpoint dir, read
@@ -80,6 +137,9 @@ class SupervisorConfig:
     degrade_after: int = 3       # consecutive no-progress crashes before degrading
     min_workers: int = 1
     checkpoint_dir: str | None = None  # progress detection (and the resume story)
+    # where the child writes its flight-recorder black box — the crash
+    # event attaches the newest dump found here (None = don't look)
+    log_dir: str | None = None
 
 
 class Supervisor:
@@ -98,19 +158,31 @@ class Supervisor:
         sleep: Callable[[float], None] = time.sleep,
         rng: random.Random | None = None,
         env: dict[str, str] | None = None,
+        wall: Callable[[], float] = time.time,
     ) -> None:
         self.command = list(command)
         self.cfg = cfg or SupervisorConfig()
-        self._emit = emit or (lambda rec: None)
+        self._raw_emit = emit or (lambda rec: None)
         self._popen = popen
         self._sleep = sleep
         self._rng = rng or random.Random()
         self._env = dict(env) if env is not None else dict(os.environ)
+        # injectable wall clock: every event is timestamped and the
+        # child-lifetime/downtime durations derive from it — tests drive
+        # a fake timeline instead of sleeping
+        self._wall = wall
         self._child: subprocess.Popen | None = None
         self._terminating = False
         self.restarts = 0            # launches after the first, any class
         self.budget_used = 0         # crash budget consumed
+        self.downtime_total_s = 0.0  # relaunch gaps accumulated (crash+preempt)
         self.workers = self._read_workers()
+
+    def _emit(self, rec: dict) -> None:
+        """Every supervision event carries ``t_unix``: the JSONL was
+        orderable but UNDATABLE before — a crash-loop timeline without
+        timestamps cannot answer "how long were we down"."""
+        self._raw_emit({**rec, "t_unix": round(self._wall(), 3)})
 
     # -- child argv surgery --------------------------------------------------
 
@@ -157,31 +229,60 @@ class Supervisor:
                 prev_handlers[sig] = signal.signal(sig, self._forward)
         consecutive_no_progress = 0
         progress = latest_checkpoint_step(cfg.checkpoint_dir)
+        # downtime accounting: the gap between a child's exit and the
+        # next launch (backoff + spawn overhead) is wall-clock the RUN
+        # paid with no process alive — each launch reports its gap, the
+        # child books it as restart_downtime in its goodput ledger
+        # (DOWNTIME_ENV), and the terminal event carries the total
+        prev_exit_wall: float | None = None
         try:
             while True:
-                env = {**self._env, RESTART_ENV: str(self.restarts)}
+                t_launch = self._wall()
+                downtime_s = (
+                    max(0.0, t_launch - prev_exit_wall)
+                    if prev_exit_wall is not None else 0.0
+                )
+                self.downtime_total_s += downtime_s
+                env = {
+                    **self._env,
+                    RESTART_ENV: str(self.restarts),
+                    DOWNTIME_ENV: f"{downtime_s:.3f}",
+                }
                 self._emit({
                     "event": "launch", "restart": self.restarts,
                     "workers": self.workers,
                     "resume_step": progress,
+                    **({"downtime_s": round(downtime_s, 3)}
+                       if prev_exit_wall is not None else {}),
                 })
                 self._child = self._popen(self.command, env=env)
+                # the child's pid discriminates ITS blackbox dump from a
+                # previous child's (or another run's) in a shared log dir
+                child_pid = getattr(self._child, "pid", None)
                 rc = self._child.wait()
                 self._child = None
+                t_exit = self._wall()
+                prev_exit_wall = t_exit
+                child_s = round(max(0.0, t_exit - t_launch), 3)
                 new_progress = latest_checkpoint_step(cfg.checkpoint_dir)
                 advanced = (
                     new_progress is not None
                     and (progress is None or new_progress > progress)
                 )
                 if rc == 0:
-                    self._emit({"event": "finished", "restarts": self.restarts})
+                    self._emit({
+                        "event": "finished", "restarts": self.restarts,
+                        "child_s": child_s,
+                        "downtime_total_s": round(self.downtime_total_s, 3),
+                    })
                     return 0
                 if self._terminating:
                     # the OPERATOR preempted the supervisor tree: the
                     # child checkpointed and exited; do not restart —
                     # hand the child's code up so a wrapping scheduler
                     # sees the same preempt semantics
-                    self._emit({"event": "terminated", "exit_code": rc})
+                    self._emit({"event": "terminated", "exit_code": rc,
+                                "child_s": child_s})
                     return rc
                 if rc == PREEMPT_EXIT_CODE:
                     # a clean preemption: immediate resume, no backoff,
@@ -190,7 +291,7 @@ class Supervisor:
                     self.restarts += 1
                     self._emit({
                         "event": "preempt_resume", "restart": self.restarts,
-                        "resume_step": new_progress,
+                        "resume_step": new_progress, "child_s": child_s,
                     })
                     progress = new_progress
                     consecutive_no_progress = 0
@@ -201,16 +302,24 @@ class Supervisor:
                 self.restarts += 1
                 consecutive_no_progress = 0 if advanced else consecutive_no_progress + 1
                 reason = "watchdog" if rc == WATCHDOG_EXIT_CODE else "crash"
+                # attach the crashed child's black box (obs/flightrec):
+                # the dump it wrote on the way down is the only record
+                # of its final moments — the crash event is where an
+                # operator (or report blackbox) should find it
+                blackbox = find_blackbox_dump(cfg.log_dir, t_launch, child_pid)
                 self._emit({
                     "event": "crash", "reason": reason, "exit_code": rc,
                     "budget_used": self.budget_used,
                     "budget": cfg.max_restarts,
                     "progress_step": new_progress, "advanced": advanced,
+                    "child_s": child_s,
+                    **({"blackbox": blackbox} if blackbox else {}),
                 })
                 if self.budget_used > cfg.max_restarts:
                     self._emit({
                         "event": "giveup", "exit_code": rc,
                         "budget_used": self.budget_used,
+                        "downtime_total_s": round(self.downtime_total_s, 3),
                     })
                     return rc
                 if (
@@ -273,24 +382,40 @@ def supervise_main(argv: list[str]) -> None:
     p.add_argument("--checkpoint-dir", type=str, default=None,
                    help="progress-detection dir; default: the --checkpoint-dir "
                         "in the train flags")
+    p.add_argument("--events-jsonl", type=str, default=None, metavar="JSONL",
+                   help="append every supervision event (launch/crash/"
+                        "preempt_resume/backoff/degrade/giveup, each with "
+                        "t_unix + child/downtime durations) to this JSONL — "
+                        "the supervisor's half of the run timeline")
     p.add_argument("train_args", nargs=argparse.REMAINDER,
                    help="train CLI flags, after an optional `--`")
     args = p.parse_args(argv)
     train_args = args.train_args
     if train_args[:1] == ["--"]:
         train_args = train_args[1:]
-    ckpt = args.checkpoint_dir
-    if ckpt is None:
+
+    def _train_flag(name: str) -> str | None:
+        # LAST occurrence wins, matching what argparse does in the
+        # child — watching a dir the child doesn't write would turn
+        # every crash into a fake no-progress crash
+        val = None
         for i, a in enumerate(train_args):
-            if a == "--checkpoint-dir" and i + 1 < len(train_args):
-                ckpt = train_args[i + 1]
-            elif a.startswith("--checkpoint-dir="):
-                ckpt = a.split("=", 1)[1]
+            if a == name and i + 1 < len(train_args):
+                val = train_args[i + 1]
+            elif a.startswith(name + "="):
+                val = a.split("=", 1)[1]
+        return val
+
+    ckpt = args.checkpoint_dir or _train_flag("--checkpoint-dir")
     if ckpt is None:
         print(
             "[supervise] warning: no --checkpoint-dir in the train flags — "
             "every restart will begin from step 0", file=sys.stderr,
         )
+    # where the child's flight recorder dumps its black box: the train
+    # CLI's --log-dir (its default is "runs") — the crash event attaches
+    # the newest dump found there
+    log_dir = _train_flag("--log-dir") or "runs"
     cfg = SupervisorConfig(
         max_restarts=args.max_restarts,
         backoff_base_s=args.backoff_base,
@@ -298,10 +423,28 @@ def supervise_main(argv: list[str]) -> None:
         degrade_after=args.degrade_after,
         min_workers=args.min_workers,
         checkpoint_dir=ckpt,
+        log_dir=log_dir,
     )
+
+    events_file = None
+    if args.events_jsonl:
+        d = os.path.dirname(os.path.abspath(args.events_jsonl))
+        os.makedirs(d, exist_ok=True)
+        events_file = open(args.events_jsonl, "a")
+
+    def _emit(rec: dict) -> None:
+        print(f"[supervise] {rec}", flush=True)
+        if events_file is not None:
+            events_file.write(json.dumps(rec) + "\n")
+            events_file.flush()
+
     sup = Supervisor(
         [sys.executable, "-m", "nanodiloco_tpu", *train_args],
         cfg,
-        emit=lambda rec: print(f"[supervise] {rec}", flush=True),
+        emit=_emit,
     )
-    raise SystemExit(sup.run())
+    try:
+        raise SystemExit(sup.run())
+    finally:
+        if events_file is not None:
+            events_file.close()

@@ -62,6 +62,7 @@ import threading
 import time
 from typing import Callable
 
+from nanodiloco_tpu.obs import flightrec
 from nanodiloco_tpu.obs.telemetry import Histogram, nearest_rank_percentile
 
 
@@ -539,6 +540,15 @@ class Scheduler:
         }
         if error is not None:
             result["error"] = error
+        # black-box feed (obs/flightrec): one bounded event per request
+        # outcome, so an engine-loop death dump shows the requests in
+        # flight around the fatal tick. No-op without a recorder.
+        flightrec.record_event(
+            "serve_finish",
+            request_id=result["request_id"], reason=reason,
+            tokens=len(tokens),
+            **({"error": error} if error else {}),
+        )
         ticket.result = result
         ticket._event.set()
 

@@ -175,9 +175,23 @@ class ServeServer:
         while not self._stop.is_set():
             try:
                 live = self._scheduler.tick()
-            except Exception as e:  # pragma: no cover - defensive
-                # a dead loop must flip /healthz to 503, not vanish
+            except Exception as e:
+                # a dead loop must flip /healthz to 503, not vanish —
+                # and its black box must land on disk: the engine
+                # thread's death is exactly the event no clean-exit
+                # exporter will ever see (obs/flightrec)
                 self._loop_error = f"{type(e).__name__}: {e}"
+                try:
+                    from nanodiloco_tpu.obs import flightrec
+
+                    flightrec.record_event(
+                        "serve_loop_death", error=self._loop_error
+                    )
+                    flightrec.dump_current(
+                        f"serve_loop:{type(e).__name__}"
+                    )
+                except Exception:
+                    pass
                 return
             if live == 0 and self._scheduler.queue_depth() == 0:
                 time.sleep(self._idle_sleep_s)

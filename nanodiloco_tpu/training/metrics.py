@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Any
 
+from nanodiloco_tpu.obs import flightrec
+
 
 class SyncTimer:
     """Accumulates outer-sync wall-clock (the reference's avg_sync_time
@@ -126,6 +128,14 @@ class MetricsLogger:
                 self.telemetry.observe(rec)
             except Exception:
                 pass  # a scrape-mirror bug must never take down training
+        # black-box feed (obs/flightrec): every JSONL record also lands
+        # in the bounded crash ring, so a dump shows the last metrics/
+        # alarms/faults before the fatal moment. No-op when no recorder
+        # is installed; a ring bug must never take down training either.
+        try:
+            flightrec.record_event("record", **rec)
+        except Exception:
+            pass
         if not self.quiet:
             parts = " ".join(
                 f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
@@ -187,7 +197,13 @@ def summarize_run(path: str) -> dict[str, Any]:
 
     losses = series("loss")
     out: dict[str, Any] = {
-        "steps": recs[-1].get("step", len(recs)),
+        # last record CARRYING a step — the trailing record may be a
+        # step-less terminal one (the final goodput snapshot)
+        "steps": next(
+            (r["step"] for r in reversed(recs)
+             if r.get("step") is not None),
+            len(recs),
+        ),
         "records": len(recs),
         **({"torn_lines_skipped": torn} if torn else {}),
         "first_loss": round(losses[0], 4) if losses else None,
@@ -309,6 +325,25 @@ def summarize_run(path: str) -> dict[str, Any]:
                 out["prefix_cache_hit_rate"] = round(
                     (pc.get("hits") or 0) / looked, 4
                 )
+    # goodput ledger (obs/goodput): stitch the per-lifetime snapshots —
+    # a supervised crash-loopy run appends several lifetimes to ONE
+    # JSONL, and the honest number is the merged fraction including the
+    # restart downtime each resumed lifetime booked. Keys appear only
+    # when the run logged goodput records (older JSONLs summarize as
+    # before).
+    from nanodiloco_tpu.obs.goodput import stitch_goodput_records
+
+    stitched = stitch_goodput_records(recs)
+    if stitched is not None:
+        if stitched.get("goodput_fraction") is not None:
+            out["goodput_fraction"] = stitched["goodput_fraction"]
+        if stitched.get("badput_top_cause") is not None:
+            out["badput_top_cause"] = stitched["badput_top_cause"]
+        out["restart_downtime_s"] = stitched.get("restart_downtime_s", 0.0)
+        if stitched.get("lifetimes", 1) > 1:
+            out["goodput_lifetimes"] = stitched["lifetimes"]
+        if stitched.get("tokens_per_wall_s") is not None:
+            out["tokens_per_wall_s"] = stitched["tokens_per_wall_s"]
     phase_keys = sorted(
         {k for r in recs for k in r if k.startswith("t_") and r[k] is not None}
     )
@@ -362,12 +397,18 @@ _COMPARE_METRICS = [
     # summaries carry them (training compares are untouched).
     ("outer_sync_share_sync", True),
     ("outer_sync_share_async", True),
+    # goodput fraction (obs/goodput ledger, stitched across restarts):
+    # a share of wall-clock like comm_share, so it gates on an ABSOLUTE
+    # move past max_comm_share_increase — but HIGHER is better (a drop
+    # is the regression). Only gated when both summaries carry it.
+    ("goodput_fraction", False),
 ]
 
 # share-of-wall-clock keys (already ratios): regress on an ABSOLUTE
-# increase past max_comm_share_increase, never a relative one
+# move past max_comm_share_increase, never a relative one; the
+# regression direction follows the key's lower_better flag
 _SHARE_KEYS = {"comm_share_last", "outer_sync_share_sync",
-               "outer_sync_share_async"}
+               "outer_sync_share_async", "goodput_fraction"}
 
 # serve latency keys (seconds, lower better) that use the dedicated
 # latency threshold instead of the loss one
@@ -428,7 +469,10 @@ def compare_runs(
         b, c = float(b), float(c)
         delta = c - b
         if key in _SHARE_KEYS:
-            regressed = delta > max_comm_share_increase
+            regressed = (
+                delta > max_comm_share_increase if lower_better
+                else -delta > max_comm_share_increase
+            )
         elif key in _LATENCY_KEYS:
             regressed = delta > max_latency_increase * max(abs(b), 1e-12)
         elif lower_better:

@@ -37,11 +37,14 @@ import numpy as np
 from nanodiloco_tpu.data import DilocoBatcher, get_tokenizer, pack_corpus, synthetic_corpus
 from nanodiloco_tpu.models.config import LlamaConfig
 from nanodiloco_tpu.obs import SpanTracer, Watchdog, WatchdogConfig, set_tracer, trace_span
+from nanodiloco_tpu.obs import flightrec
+from nanodiloco_tpu.obs.goodput import GoodputLedger
 from nanodiloco_tpu.parallel.diloco import Diloco, DilocoConfig
 from nanodiloco_tpu.parallel.mesh import MeshConfig, build_mesh
 from nanodiloco_tpu.resilience import faults as _faults
 from nanodiloco_tpu.resilience.retry import RetryPolicy, retry_call
 from nanodiloco_tpu.resilience.supervisor import (
+    DOWNTIME_ENV,
     PREEMPT_EXIT_CODE,
     RESTART_ENV,
     WATCHDOG_EXIT_CODE,
@@ -312,6 +315,24 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     # first compiles cost 20-40 s each through the tunneled runtime and a
     # run compiles several programs — later process starts go warm
     enable_compile_cache()
+    # goodput ledger (obs/goodput): opened FIRST so every second of this
+    # process lifetime — setup included — is inside the partition
+    # (unspanned setup lands in `other`). The lifetime ordinal comes
+    # from the supervisor's restart env; the relaunch gap it measured
+    # (DOWNTIME_ENV) is booked as restart_downtime, so a supervised
+    # crash-loopy run's one JSONL stitches into an honest end-to-end
+    # goodput fraction that includes the seconds no process existed for.
+    try:
+        _lifetime = int(os.environ.get(RESTART_ENV, "0") or 0)
+    except ValueError:
+        _lifetime = 0
+    ledger = GoodputLedger(lifetime=_lifetime).start()
+    try:
+        _downtime_s = float(os.environ.get(DOWNTIME_ENV, "0") or 0.0)
+    except ValueError:
+        _downtime_s = 0.0
+    if _downtime_s > 0:
+        ledger.book_external("restart_downtime", _downtime_s)
     # rank-0-only console: on a pod every process runs this function;
     # unguarded prints would interleave N copies of each notice
     # (VERDICT r2 missing #3 — the observability gap the reference also
@@ -678,6 +699,11 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     f, indent=1,
                 )
         if cfg.resume and ckpt.latest_step is not None:
+            # restore wall-clock -> the ledger's resume_restore cause
+            # (the tracer is not installed yet this early, so the span
+            # machinery can't cover it) and a t_restore JSONL key on the
+            # resume record
+            _t_restore0 = time.perf_counter()
             saved_w = ckpt.saved_worker_count()
             if saved_w == cfg.num_workers:
                 state = ckpt.restore(abstract_state_like(state))
@@ -706,10 +732,13 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 restart_count = int(os.environ.get(RESTART_ENV, "0") or 0)
             except ValueError:
                 restart_count = 0
+            _t_restore = time.perf_counter() - _t_restore0
+            ledger.note("resume_restore", _t_restore)
             resume_rec = {
                 "resume": int(ckpt.latest_step),
                 "elastic": saved_w != cfg.num_workers,
                 "restart_count": restart_count,
+                "t_restore": round(_t_restore, 6),
             }
 
     # resolve_run_name broadcasts process 0's name so a pod produces ONE
@@ -753,6 +782,19 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         process_index=jax.process_index(),
     )
     prev_tracer = set_tracer(tracer)
+    # --- crash flight recorder (obs/flightrec) ------------------------------
+    # bounded black box of recent spans/heartbeats/records, dumped to
+    # <log_dir>/<run>-blackbox.json on fatal watchdog alarms, unhandled
+    # exceptions, hard-crash faults, and (best-effort) fatal signals —
+    # the runs that never reach the clean trace export are the ones
+    # whose last moments matter most. Writer rank only: the dump path
+    # follows the JSONL's ownership.
+    recorder = flightrec.FlightRecorder(
+        dump_path=(
+            os.path.join(cfg.log_dir, f"{run_name}-blackbox.json")
+            if cfg.log_dir and logger.is_writer else None
+        ),
+    )
     # --- resilience: emergency-stop latch (resilience/supervisor) -----------
     # ONE latch for every graceful-stop source — SIGTERM/SIGINT preemption
     # and fatal watchdog alarms under --watch-action checkpoint-exit. The
@@ -1015,6 +1057,15 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     # (holds the process-global profiler lock) — defined OUTSIDE the try
     # so the teardown can release a window an exception left open
     profiling = False
+    # install the flight recorder (and arm the fatal-signal dumpers)
+    # IMMEDIATELY before the try whose finally restores them: a setup
+    # exception in between would leak the process-global recorder and
+    # replaced signal dispositions into the embedding process
+    prev_recorder = flightrec.install(recorder)
+    if recorder.dump_path and cfg.preempt_signals:
+        # same main-thread gate as the preempt handlers; restored at
+        # teardown so embedders keep their signal dispositions
+        flightrec.arm_fatal_signals()
     try:
         evaluator = None
         if cfg.eval_every:
@@ -1367,6 +1418,16 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         round_budget["t_inner"] = round(
                             max(0.0, round_budget["t_inner"] - sync_est), 6
                         )
+                    # goodput attribution from the SAME budget the JSONL
+                    # carries (t_inner/t_sync after the differenced
+                    # split, comm_probe, ckpt, data, eval): the first
+                    # round's compute is compile_warmup — its inner span
+                    # is dominated by the XLA compile, and booking it as
+                    # compute would flatter the fraction
+                    ledger.observe_phases(
+                        round_budget, warmup=(rnd == first_round)
+                    )
+                    ledger.add_tokens(cfg.inner_steps * tokens_per_step)
                     wire_bytes_total += wire_rec["wire_bytes_per_sync"]
                     # dynamics readout (host fetch AFTER the timing
                     # fences): per-worker pg norms, drift, momentum,
@@ -1417,6 +1478,14 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                                 },
                                 step=step,
                             )
+                        # per-round goodput record: the RUNNING ledger
+                        # snapshot for this lifetime (cumulative causes,
+                        # elapsed, fraction) — snapshots, not deltas, so
+                        # a crashed lifetime's last record still stands
+                        # for it when stitching across restarts
+                        logger.log(
+                            {"goodput": ledger.snapshot()}, step=real_step
+                        )
                     # the collapse sentinel needs PER-ROUND throughput: the
                     # cumulative tps above dilutes a mid-run collapse into
                     # invisibility (100 rounds at 10% speed barely move a
@@ -1642,6 +1711,19 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     f"t_{k}": round(v, 6)
                     for k, v in tracer.phase_totals().items()
                 }
+                # goodput attribution, per round at the sync boundary.
+                # Async mode books ONLY the residual apply-wait (the
+                # `sync` span around block_until_ready(state.pending))
+                # as outer_sync — the launched collective overlaps the
+                # next round's inner compute, which is the point; the
+                # classic path's sync span is the full fenced outer
+                # step. The lifetime's first round is compile_warmup:
+                # its first inner step and first sync carry the compiles.
+                ledger.observe_phases(
+                    round_budget,
+                    warmup=(real_step - start_step <= cfg.inner_steps),
+                )
+                ledger.add_tokens(cfg.inner_steps * tokens_per_step)
                 wire_bytes_total += wire_rec["wire_bytes_per_sync"]
                 sync_extras = {
                     **wire_metrics, "wire_bytes_total": wire_bytes_total,
@@ -1686,6 +1768,12 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     },
                     step=real_step,
                 )
+                if synced:
+                    # per-round goodput record (running lifetime
+                    # snapshot — same contract as the fused path)
+                    logger.log(
+                        {"goodput": ledger.snapshot()}, step=real_step
+                    )
             if synced:
                 # preempt / watchdog emergency stop — round boundaries
                 # only (the preempt contract: a checkpoint within one
@@ -1738,6 +1826,16 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         emergency = e
         if ckpt is not None:
             ckpt.close()
+    except BaseException as e:
+        # an unhandled exception escaping train() IS a crash: dump the
+        # flight recorder's black box before teardown (the ring shows
+        # the last spans/records/heartbeats leading to this), then let
+        # the exception propagate — the dump must never replace it
+        try:
+            flightrec.dump_current(f"train_exception:{type(e).__name__}")
+        except Exception:
+            pass
+        raise
     finally:
         # teardown runs on EVERY exit (an exception mid-train must not
         # leak the process-global tracer or leave the heartbeat daemon
@@ -1754,6 +1852,24 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             except Exception:
                 pass
             profiling = False
+        # FINAL goodput snapshot before the logger closes: the run-level
+        # ledger this lifetime stands for when stitched. A watchdog-
+        # stall exit books its unattributed dead tail as `stall` instead
+        # of `other` — the one case the residual's cause is known.
+        try:
+            logger.log({
+                "goodput": ledger.snapshot(
+                    final=True,
+                    residual_cause=(
+                        "stall"
+                        if emergency is not None
+                        and emergency.reason == "watchdog:stall"
+                        else "other"
+                    ),
+                )
+            })
+        except Exception:
+            pass
         watchdog.stop(
             "finished" if completed else (
                 "preempted"
@@ -1767,6 +1883,8 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             # races a closed logger
             telemetry.stop()
         set_tracer(prev_tracer)
+        flightrec.disarm_fatal_signals()
+        flightrec.install(prev_recorder)
         if cfg.trace_out:
             # every process exports: rank 0 to the requested path,
             # rank k to the rank-tagged shard next to it — `report

@@ -665,6 +665,106 @@ def phase_resilience() -> None:
     })
 
 
+def phase_goodput() -> None:
+    """The goodput/black-box drill against a REAL (short) supervised run
+    on this backend: inject a hard crash (os._exit) mid-run via the
+    fault plan, let `supervise` restart it to completion, then assert
+    the three contracts — a flight-recorder blackbox dump exists and
+    `report blackbox` renders it, the supervisor's crash event carries
+    the dump path, and the stitched goodput ledger (`report goodput`)
+    accounts restart_downtime > 0 with a sane fraction."""
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="nanodiloco-goodput-")
+    ckpt = os.path.join(tmp, "ckpt")
+    model_cfg = os.path.join(tmp, "model.json")
+    with open(model_cfg, "w") as f:
+        json.dump({
+            "vocab_size": 2048, "hidden_size": 128, "intermediate_size": 256,
+            "num_attention_heads": 4, "num_hidden_layers": 2,
+            "max_position_embeddings": 256,
+        }, f)
+    plan = os.path.join(tmp, "plan.json")
+    with open(plan, "w") as f:
+        # crash AFTER the first checkpointed round so the restart
+        # resumes (progress advanced -> budget cost 1) and both
+        # lifetimes contribute goodput snapshots
+        json.dump({"faults": [{"kind": "crash", "step": 5}]}, f)
+    events_jsonl = os.path.join(tmp, "supervise.jsonl")
+    args = [
+        "--total-steps", "12", "--inner-steps", "2",
+        "--batch-size", "8", "--per-device-batch-size", "4",
+        "--seq-length", "256", "--warmup-steps", "2",
+        "--llama-config-file", model_cfg, "--no-measure-comm",
+        "--no-cost-analysis", "--quiet",
+        "--checkpoint-dir", ckpt, "--log-dir", tmp,
+        "--run-name", "goodput-probe", "--fault-plan", plan,
+    ]
+    budget = float(os.environ.get("NANODILOCO_AGENDA_TIMEOUT_GOODPUT", "1200"))
+    sup = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu", "supervise",
+         "--max-restarts", "3", "--backoff-base", "0.5",
+         "--events-jsonl", events_jsonl, "--", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=budget * 0.8,
+    )
+    if sup.returncode != 0:
+        record({"phase": "goodput",
+                "error": f"supervised run exit {sup.returncode}",
+                "tail": (sup.stdout or "")[-400:]})
+        raise SystemExit(1)
+    blackbox = os.path.join(tmp, "goodput-probe-blackbox.json")
+    sup_events = []
+    with open(events_jsonl) as f:
+        for ln in f:
+            try:
+                sup_events.append(json.loads(ln))
+            except ValueError:
+                continue
+    crash_events = [e for e in sup_events if e.get("event") == "crash"]
+    if not os.path.exists(blackbox) or not crash_events \
+            or crash_events[0].get("blackbox") != blackbox:
+        record({"phase": "goodput",
+                "error": "blackbox dump missing or not attached to the "
+                         "supervisor's crash event",
+                "dump_exists": os.path.exists(blackbox),
+                "crash_events": crash_events[-2:]})
+        raise SystemExit(1)
+    # the dump must RENDER (a torn/garbled dump is forensics lost)
+    bb = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu", "report", "blackbox",
+         blackbox], cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    if bb.returncode != 0 or "reason=crash_fault" not in bb.stdout:
+        record({"phase": "goodput", "error": "report blackbox failed",
+                "tail": (bb.stdout + bb.stderr)[-400:]})
+        raise SystemExit(1)
+    gp = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu", "report", "goodput",
+         os.path.join(tmp, "goodput-probe.jsonl"), "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    ledger = json.loads(gp.stdout) if gp.returncode == 0 else {}
+    if (
+        gp.returncode != 0
+        or ledger.get("lifetimes", 0) < 2
+        or not ledger.get("restart_downtime_s", 0) > 0
+        or not 0 < (ledger.get("goodput_fraction") or 0) <= 1
+    ):
+        record({"phase": "goodput",
+                "error": "stitched ledger missing restart downtime",
+                "ledger": ledger, "tail": (gp.stderr or "")[-300:]})
+        raise SystemExit(1)
+    record({
+        "phase": "goodput",
+        "lifetimes": ledger["lifetimes"],
+        "goodput_fraction": ledger["goodput_fraction"],
+        "restart_downtime_s": ledger["restart_downtime_s"],
+        "badput_top_cause": ledger.get("badput_top_cause"),
+        "blackbox_events": len(json.load(open(blackbox)).get("events", [])),
+        "crash_blackbox_attached": True,
+    })
+
+
 def phase_serve() -> None:
     """The serving path on this backend end to end: train a tiny REAL
     checkpoint, launch the `serve` CLI on it, drive TWO overlapping
@@ -988,6 +1088,7 @@ PHASES = {
     "async_overlap": phase_async_overlap,
     "live_profile": phase_live_profile,
     "resilience": phase_resilience,
+    "goodput": phase_goodput,
     "serve": phase_serve,
     "serve_interference": phase_serve_interference,
 }
@@ -1030,6 +1131,7 @@ PHASE_TIMEOUT_S = {
     "async_overlap": 900,
     "live_profile": 900,
     "resilience": 1200,
+    "goodput": 1200,
     "serve": 900,
     "serve_interference": 900,
 }

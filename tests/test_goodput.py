@@ -1,0 +1,657 @@
+"""Goodput ledger + crash flight recorder (nanodiloco_tpu/obs/goodput,
+obs/flightrec) — the wall-clock accounting and black-box forensics PR.
+
+The properties pinned here:
+- PARTITION: attributed cause seconds sum to elapsed wall-clock —
+  exactly under an injected clock, within 1% on REAL fused and
+  stepwise(+async) runs; async mode books only the residual apply-wait
+  as outer_sync (no double count with compute).
+- STITCHING: a crash+resume lineage appended to one JSONL folds into
+  one run-level ledger whose restart_downtime matches the injected gap.
+- BLACK BOX: the ring is bounded, dumps are atomic and render through
+  `report blackbox`, and every fatal trigger (watchdog fatal alarm,
+  unhandled train() exception, serve engine-loop death) leaves a dump.
+- SURFACES: supervisor events carry t_unix/child_s/downtime_s,
+  `report goodput` renders the stitched table, summarize_run surfaces
+  goodput keys (tolerating older JSONLs), and `report compare` gates
+  goodput_fraction in BOTH directions.
+"""
+
+import json
+import os
+
+import pytest
+
+from nanodiloco_tpu.obs import flightrec
+from nanodiloco_tpu.obs.flightrec import FlightRecorder
+from nanodiloco_tpu.obs.goodput import (
+    CAUSES,
+    GoodputLedger,
+    stitch_goodput_records,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_recorder():
+    """Every test leaves the process-global recorder as it found it —
+    the same discipline the tracer tests use."""
+    prev = flightrec.current()
+    yield
+    flightrec.install(prev)
+
+
+# -- ledger units (injected clock — exact partition) -------------------------
+
+
+def _fake_clock():
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    return t, clock
+
+
+def test_ledger_partition_is_exact_under_injected_clock():
+    t, clock = _fake_clock()
+    led = GoodputLedger(clock=clock, wall=lambda: 5000.0, lifetime=3).start()
+    t[0] = 100.0
+    led.observe_phases({
+        "t_inner": 55.0, "t_sync": 12.0, "t_data": 6.0,
+        "t_ckpt": 4.0, "t_eval": 3.0, "t_log": 1.0,
+    })
+    led.add_tokens(12_000)
+    snap = led.snapshot()
+    assert snap["lifetime"] == 3
+    assert snap["elapsed_s"] == 100.0
+    assert sum(snap[f"{c}_s"] for c in CAUSES) == pytest.approx(100.0)
+    assert snap["compute_s"] == 55.0
+    assert snap["outer_sync_s"] == 12.0
+    # the unattributed residual lands in `other` (plus t_log's 1.0),
+    # never silently dropped
+    assert snap["other_s"] == pytest.approx(100.0 - 55 - 12 - 6 - 4 - 3)
+    assert snap["goodput_fraction"] == pytest.approx(0.55)
+    assert snap["tokens_per_wall_s"] == pytest.approx(120.0)
+
+
+def test_ledger_warmup_routes_compute_to_compile_warmup():
+    t, clock = _fake_clock()
+    led = GoodputLedger(clock=clock).start()
+    t[0] = 50.0
+    led.observe_phases({"t_inner": 40.0, "t_comm_probe": 5.0}, warmup=True)
+    snap = led.snapshot()
+    assert snap["compute_s"] == 0.0
+    assert snap["compile_warmup_s"] == 45.0  # inner + the probe rounds
+    # an UNKNOWN phase name must land in `other`, not vanish
+    led.observe_phases({"t_mystery": 2.0})
+    assert led.snapshot()["other_s"] >= 2.0
+
+
+def test_ledger_external_downtime_extends_elapsed():
+    t, clock = _fake_clock()
+    led = GoodputLedger(clock=clock).start()
+    led.book_external("restart_downtime", 30.0)
+    t[0] = 70.0
+    led.observe_phases({"t_inner": 70.0})
+    snap = led.snapshot(final=True)
+    assert snap["final"] is True
+    assert snap["elapsed_s"] == 100.0  # 70 on our clock + 30 external
+    assert snap["restart_downtime_s"] == 30.0
+    assert snap["goodput_fraction"] == pytest.approx(0.7)
+    assert sum(snap[f"{c}_s"] for c in CAUSES) == pytest.approx(100.0)
+
+
+def test_ledger_overshoot_scales_to_fit():
+    """Sub-ms skew between the tracer's clock and the ledger's can make
+    attribution overshoot elapsed; the partition must hold in both
+    directions (scaled down, never a negative residual)."""
+    t, clock = _fake_clock()
+    led = GoodputLedger(clock=clock).start()
+    t[0] = 10.0
+    led.observe_phases({"t_inner": 8.0, "t_sync": 4.0})  # 12 > 10
+    snap = led.snapshot()
+    assert sum(snap[f"{c}_s"] for c in CAUSES) == pytest.approx(10.0)
+    assert snap["compute_s"] == pytest.approx(10.0 * 8 / 12)
+
+
+def test_ledger_residual_cause_stall():
+    t, clock = _fake_clock()
+    led = GoodputLedger(clock=clock).start()
+    t[0] = 20.0
+    led.observe_phases({"t_inner": 5.0})
+    snap = led.snapshot(final=True, residual_cause="stall")
+    assert snap["stall_s"] == pytest.approx(15.0)
+    assert snap["other_s"] == 0.0
+
+
+def test_stitch_takes_last_snapshot_per_lifetime():
+    """Snapshots are cumulative per lifetime: the stitcher must take
+    the LAST of each (a crashed lifetime's last snapshot stands for
+    it), sum across lifetimes, and keep the downtime a resumed
+    lifetime booked."""
+    recs = [
+        {"goodput": {"lifetime": 0, "elapsed_s": 10.0, "compute_s": 8.0,
+                     "other_s": 2.0, "tokens": 100}},
+        # lifetime 0's LATER snapshot supersedes the one above
+        {"goodput": {"lifetime": 0, "elapsed_s": 40.0, "compute_s": 30.0,
+                     "other_s": 10.0, "tokens": 400}},
+        {"loss": 1.0, "step": 3},  # unrelated records interleave freely
+        {"goodput": {"lifetime": 1, "elapsed_s": 60.0, "compute_s": 40.0,
+                     "restart_downtime_s": 12.5, "other_s": 7.5,
+                     "tokens": 600, "final": True}},
+    ]
+    st = stitch_goodput_records(recs)
+    assert st["lifetimes"] == 2
+    assert st["elapsed_s"] == pytest.approx(100.0)
+    assert st["restart_downtime_s"] == pytest.approx(12.5)
+    assert st["goodput_fraction"] == pytest.approx(0.70)
+    assert st["tokens"] == 1000
+    assert st["tokens_per_wall_s"] == pytest.approx(10.0)
+    assert st["badput_top_cause"] == "other"  # 17.5 > 12.5
+
+
+def test_stitch_returns_none_without_goodput_records():
+    assert stitch_goodput_records([{"loss": 1.0}, {"alarm": "stall"}]) is None
+
+
+def test_stitch_segments_repeated_lifetime_ordinals():
+    """The supervisor's restart ordinal resets to 0 per `supervise`
+    invocation: a run supervised TWICE appends two lifetime-0 series to
+    one JSONL. Keying by ordinal would silently drop the first
+    invocation's seconds — segmentation by order (elapsed going
+    backwards = a fresh process) must keep both."""
+    recs = [
+        {"goodput": {"lifetime": 0, "elapsed_s": 30.0, "compute_s": 30.0,
+                     "tokens": 300}},
+        {"goodput": {"lifetime": 1, "elapsed_s": 20.0, "compute_s": 20.0,
+                     "tokens": 200}},
+        # second supervise invocation: ordinals restart at 0
+        {"goodput": {"lifetime": 0, "elapsed_s": 10.0, "compute_s": 5.0,
+                     "other_s": 5.0, "tokens": 50}},
+        {"goodput": {"lifetime": 0, "elapsed_s": 40.0, "compute_s": 30.0,
+                     "other_s": 10.0, "tokens": 400, "final": True}},
+    ]
+    st = stitch_goodput_records(recs)
+    assert st["lifetimes"] == 3
+    assert st["elapsed_s"] == pytest.approx(30.0 + 20.0 + 40.0)
+    assert st["tokens"] == 900
+    assert st["goodput_fraction"] == pytest.approx(80.0 / 90.0)
+
+
+def test_stitch_pid_splits_overtaking_elapsed():
+    """A fresh supervise invocation whose compile-heavy FIRST snapshot
+    already overtakes the previous invocation's final elapsed (same
+    ordinal 0) is only distinguishable by the writing process's pid —
+    the elapsed heuristic alone would merge them and drop the first
+    invocation's seconds."""
+    recs = [
+        {"goodput": {"lifetime": 0, "pid": 100, "elapsed_s": 8.0,
+                     "compute_s": 8.0, "tokens": 80}},
+        # new process, same ordinal, LARGER elapsed — must still split
+        {"goodput": {"lifetime": 0, "pid": 200, "elapsed_s": 12.0,
+                     "compute_s": 4.0, "other_s": 8.0, "tokens": 40}},
+    ]
+    st = stitch_goodput_records(recs)
+    assert st["lifetimes"] == 2
+    assert st["elapsed_s"] == pytest.approx(20.0)
+    assert st["goodput_fraction"] == pytest.approx(12.0 / 20.0)
+    # and a same-pid same-ordinal elapsed RESET still splits (an
+    # embedder running train() twice in one process)
+    recs2 = [
+        {"goodput": {"lifetime": 0, "pid": 100, "elapsed_s": 8.0,
+                     "compute_s": 8.0, "tokens": 80}},
+        {"goodput": {"lifetime": 0, "pid": 100, "elapsed_s": 3.0,
+                     "compute_s": 3.0, "tokens": 30}},
+    ]
+    assert stitch_goodput_records(recs2)["lifetimes"] == 2
+
+
+# -- flight recorder units ----------------------------------------------------
+
+
+def test_flightrec_ring_is_bounded_and_dump_is_complete(tmp_path):
+    path = str(tmp_path / "run-blackbox.json")
+    rec = FlightRecorder(capacity=4, dump_path=path, wall=lambda: 7.0)
+    for i in range(9):
+        rec.record("span", name=f"s{i}")
+    out = rec.dump("watchdog:stall")
+    assert out == path
+    doc = json.load(open(path))
+    assert doc["blackbox"] is True and doc["reason"] == "watchdog:stall"
+    assert [e["data"]["name"] for e in doc["events"]] == [
+        "s5", "s6", "s7", "s8"
+    ]
+    assert doc["dropped_events"] == 5
+    # a second dump overwrites but keeps the prior reason visible
+    rec.record("alarm", kind="nan_loss")
+    rec.dump("train_exception:RuntimeError")
+    doc2 = json.load(open(path))
+    assert doc2["reason"] == "train_exception:RuntimeError"
+    assert doc2["prior_reason"] == "watchdog:stall"
+
+
+def test_flightrec_global_feed_is_noop_without_recorder(tmp_path):
+    flightrec.install(None)
+    flightrec.record_event("span", name="x")  # must not raise
+    assert flightrec.dump_current("whatever") is None
+    rec = FlightRecorder(dump_path=str(tmp_path / "b.json"))
+    prev = flightrec.install(rec)
+    flightrec.record_event("heartbeat", step=3)
+    assert flightrec.dump_current("r") is not None
+    flightrec.install(prev)
+    assert rec.events()[0]["kind"] == "heartbeat"
+
+
+def test_flightrec_dump_without_path_returns_none():
+    assert FlightRecorder().dump("r") is None
+
+
+def test_watchdog_fatal_alarm_dumps_blackbox(tmp_path):
+    """The stall sentinel (injected clock) is a FATAL kind: firing it
+    must dump the installed recorder's ring — observe-only runs
+    included (a dump is evidence, not an action)."""
+    from nanodiloco_tpu.obs.watchdog import Watchdog, WatchdogConfig
+
+    path = str(tmp_path / "wd-blackbox.json")
+    flightrec.install(FlightRecorder(dump_path=path))
+    t = [0.0]
+    wd = Watchdog(
+        WatchdogConfig(stall_factor=2.0, min_stall_s=1.0, poll_s=1000.0),
+        emit=lambda rec: None, clock=lambda: t[0],
+    )
+    wd.heartbeat(1)
+    t[0] = 1.0
+    wd.heartbeat(2)
+    t[0] = 50.0
+    assert wd.check_stall() is True
+    assert os.path.exists(path)
+    doc = json.load(open(path))
+    assert doc["reason"] == "watchdog:stall"
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "heartbeat" in kinds  # the ring shows the beats before death
+
+
+def test_watchdog_status_doc_reports_run_age():
+    from nanodiloco_tpu.obs.watchdog import Watchdog
+
+    import time as _time
+
+    wd = Watchdog(emit=lambda rec: None)
+    doc = wd.status_doc()
+    assert doc["started_unix"] <= _time.time()
+    assert doc["uptime_s"] >= 0
+    assert doc["uptime_s"] == pytest.approx(
+        doc["updated_unix"] - doc["started_unix"], abs=0.05
+    )
+
+
+def test_serve_loop_death_dumps_blackbox(tmp_path):
+    from nanodiloco_tpu.serve.scheduler import Scheduler
+    from nanodiloco_tpu.serve.server import ServeServer
+
+    class DoomedScheduler:
+        backend = None
+
+        def tick(self):
+            raise RuntimeError("device lost")
+
+        def queue_depth(self):
+            return 0
+
+        def stats(self):
+            return {}
+
+    path = str(tmp_path / "serve-blackbox.json")
+    flightrec.install(FlightRecorder(dump_path=path))
+    srv = ServeServer(DoomedScheduler(), port=0, host="127.0.0.1").start()
+    try:
+        srv._loop_thread.join(timeout=5)
+        assert not srv.loop_alive()
+        assert os.path.exists(path)
+        doc = json.load(open(path))
+        assert doc["reason"].startswith("serve_loop:RuntimeError")
+        assert any(e["kind"] == "serve_loop_death" for e in doc["events"])
+    finally:
+        srv.stop()
+    # Scheduler import used for the real-backend path elsewhere; keep
+    # the reference so the import is honest
+    assert Scheduler is not None
+
+
+# -- supervisor timing + stitching -------------------------------------------
+
+
+class _FakeChild:
+    def __init__(self, rc):
+        self.rc = rc
+
+    def wait(self):
+        return self.rc
+
+    def poll(self):
+        return self.rc
+
+
+def test_supervisor_events_are_dated_and_downtime_flows_to_child(tmp_path):
+    from nanodiloco_tpu.resilience.supervisor import (
+        DOWNTIME_ENV,
+        RESTART_ENV,
+        Supervisor,
+        SupervisorConfig,
+    )
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    blackbox = tmp_path / "run-blackbox.json"
+    t = [1000.0]
+    launches = []
+    codes = [71, 75, 0]
+    step = [0]
+
+    # a STALE dump from some other run sits in the log dir the whole
+    # time: its pid must keep it from ever being attached
+    stale = tmp_path / "other-blackbox.json"
+    stale.write_text(json.dumps(
+        {"blackbox": True, "pid": 999_999, "t_unix": 2000.0, "events": []}
+    ))
+
+    def popen(cmd, env=None):
+        launches.append(dict(env))
+        rc = codes[len(launches) - 1]
+        t[0] += 10.0  # every child lives exactly 10 fake seconds
+        step[0] += 2
+        (ckpt / str(step[0])).mkdir()
+        if rc == 71:
+            # the crashing child dumps its black box on the way down,
+            # stamped with its own pid + wall time (what FlightRecorder
+            # writes) — the supervisor matches on the pid
+            blackbox.write_text(json.dumps({
+                "blackbox": True, "pid": 4242, "t_unix": t[0],
+                "events": [],
+            }))
+        child = _FakeChild(rc)
+        child.pid = 4242
+        return child
+
+    def sleep(s):
+        t[0] += s
+
+    events = []
+    import random
+
+    sup = Supervisor(
+        ["train"],
+        SupervisorConfig(checkpoint_dir=str(ckpt), log_dir=str(tmp_path),
+                         backoff_base_s=4.0),
+        emit=events.append, popen=popen, sleep=sleep,
+        rng=random.Random(0), wall=lambda: t[0],
+    )
+    assert sup.run() == 0
+    assert [e["event"] for e in events] == [
+        "launch", "crash", "backoff", "launch", "preempt_resume",
+        "launch", "finished",
+    ]
+    assert all("t_unix" in e for e in events)
+    crash = events[1]
+    assert crash["child_s"] == 10.0
+    assert crash["blackbox"] == str(blackbox)
+    backoff = events[2]
+    launch2 = events[3]
+    # the second launch's downtime is the backoff the supervisor slept
+    assert launch2["downtime_s"] == pytest.approx(backoff["delay_s"], abs=0.01)
+    # preempt resume is immediate: zero downtime for the third launch
+    assert events[5]["downtime_s"] == pytest.approx(0.0)
+    assert events[6]["downtime_total_s"] == pytest.approx(
+        launch2["downtime_s"], abs=0.01
+    )
+    # the child's envs: restart ordinal + the downtime it must book
+    assert [e[RESTART_ENV] for e in launches] == ["0", "1", "2"]
+    assert launches[0][DOWNTIME_ENV] == "0.000"
+    assert float(launches[1][DOWNTIME_ENV]) == pytest.approx(
+        launch2["downtime_s"], abs=0.01
+    )
+    assert float(launches[2][DOWNTIME_ENV]) == pytest.approx(0.0)
+
+
+# -- report surfaces ----------------------------------------------------------
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_report_goodput_renders_and_summarize_surfaces_keys(tmp_path, capsys):
+    from nanodiloco_tpu.cli import report_main
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    jsonl = str(tmp_path / "run.jsonl")
+    _write_jsonl(jsonl, [
+        {"loss": 2.0, "step": 1},
+        {"goodput": {"lifetime": 0, "elapsed_s": 80.0, "compute_s": 60.0,
+                     "outer_sync_s": 12.0, "other_s": 8.0, "tokens": 800}},
+        {"goodput": {"lifetime": 1, "elapsed_s": 20.0, "compute_s": 10.0,
+                     "restart_downtime_s": 6.0, "other_s": 4.0,
+                     "tokens": 200, "final": True}},
+    ])
+    report_main(["goodput", jsonl])
+    out = capsys.readouterr().out
+    assert "2 process lifetime(s)" in out
+    assert "goodput_fraction" in out and "0.7000" in out
+    assert "restart_downtime" in out
+    report_main(["goodput", jsonl, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["goodput_fraction"] == pytest.approx(0.7)
+    summary = summarize_run(jsonl)
+    assert summary["goodput_fraction"] == pytest.approx(0.7)
+    assert summary["restart_downtime_s"] == pytest.approx(6.0)
+    assert summary["badput_top_cause"] == "outer_sync"
+    assert summary["goodput_lifetimes"] == 2
+    # an OLDER jsonl (no goodput records) summarizes without the keys
+    old = str(tmp_path / "old.jsonl")
+    _write_jsonl(old, [{"loss": 2.0, "step": 1}])
+    old_summary = summarize_run(old)
+    assert "goodput_fraction" not in old_summary
+    assert "restart_downtime_s" not in old_summary
+    with pytest.raises(SystemExit):
+        report_main(["goodput", old])
+
+
+def test_report_blackbox_renders_dump(tmp_path, capsys):
+    from nanodiloco_tpu.cli import report_main
+
+    path = str(tmp_path / "x-blackbox.json")
+    rec = FlightRecorder(capacity=8, dump_path=path, wall=lambda: 1700000000.0)
+    rec.record("span", name="inner", s=1.5)
+    rec.record("alarm", kind="nan_loss")
+    rec.dump("crash_fault:step5")
+    report_main(["blackbox", path])
+    out = capsys.readouterr().out
+    assert "reason=crash_fault:step5" in out
+    assert "span" in out and "alarm" in out and "kind=nan_loss" in out
+    report_main(["blackbox", path, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["reason"] == "crash_fault:step5"
+    # -n trims the timeline; -n 0 means NO events, not all of them
+    report_main(["blackbox", path, "-n", "1"])
+    out = capsys.readouterr().out
+    assert "alarm" in out and "name=inner" not in out
+    report_main(["blackbox", path, "-n", "0"])
+    out = capsys.readouterr().out
+    assert "name=inner" not in out and "kind=nan_loss" not in out
+    # not-a-dump rejects loudly
+    bad = str(tmp_path / "notdump.json")
+    with open(bad, "w") as f:
+        json.dump({"hello": 1}, f)
+    with pytest.raises(SystemExit):
+        report_main(["blackbox", bad])
+
+
+def test_compare_gates_goodput_fraction_both_directions():
+    from nanodiloco_tpu.training.metrics import compare_runs
+
+    base = {"final_loss": 2.0, "goodput_fraction": 0.70}
+    # a DROP past the absolute share threshold regresses...
+    worse = compare_runs(base, {"final_loss": 2.0, "goodput_fraction": 0.60})
+    assert "goodput_fraction" in worse["regressions"]
+    # ...a small drop within it does not...
+    ok = compare_runs(base, {"final_loss": 2.0, "goodput_fraction": 0.68})
+    assert ok["ok"]
+    # ...an INCREASE never does (higher is better)...
+    better = compare_runs(base, {"final_loss": 2.0, "goodput_fraction": 0.90})
+    assert better["ok"]
+    # ...and a candidate without the key is reported but ungated
+    missing = compare_runs(base, {"final_loss": 2.0})
+    assert missing["ok"]
+    assert missing["metrics"]["goodput_fraction"]["gated"] is False
+
+
+# -- real runs: the partition property end to end ----------------------------
+
+
+def _tiny_cfg(log_dir, run_name, **kw):
+    from nanodiloco_tpu.models.config import LlamaConfig
+    from nanodiloco_tpu.training.train_loop import TrainConfig
+
+    model = LlamaConfig(
+        vocab_size=384, hidden_size=32, intermediate_size=64,
+        num_attention_heads=4, num_hidden_layers=2,
+        max_position_embeddings=64,
+    )
+    return TrainConfig(**{
+        **dict(
+            seed=1337, batch_size=4, per_device_batch_size=2, seq_length=32,
+            warmup_steps=2, total_steps=6, inner_steps=3, lr=1e-3,
+            num_workers=2, model=model, log_dir=log_dir, quiet=True,
+            run_name=run_name, measure_comm=False, cost_analysis=False,
+        ),
+        **kw,
+    })
+
+
+def _goodput_snaps(jsonl):
+    snaps = []
+    with open(jsonl) as f:
+        for line in f:
+            r = json.loads(line)
+            if isinstance(r.get("goodput"), dict):
+                snaps.append(r["goodput"])
+    return snaps
+
+
+def _assert_partition(snap, rel=0.01):
+    total = sum(snap[f"{c}_s"] for c in CAUSES)
+    assert total == pytest.approx(snap["elapsed_s"], rel=rel)
+    # the FIRST round is all compile_warmup by policy, so an early
+    # snapshot's fraction may legitimately be 0
+    assert 0 <= snap["goodput_fraction"] <= 1
+
+
+def test_goodput_partition_real_fused_run(tmp_path):
+    from nanodiloco_tpu.training.train_loop import train
+
+    train(_tiny_cfg(str(tmp_path), "gp-fused"))
+    jsonl = str(tmp_path / "gp-fused.jsonl")
+    snaps = _goodput_snaps(jsonl)
+    # one per round (2 rounds) + the final teardown snapshot
+    assert len(snaps) == 3 and snaps[-1].get("final") is True
+    for snap in snaps:
+        _assert_partition(snap)
+    final = snaps[-1]
+    # the first round's compile landed as warm-up, not compute — and
+    # the warm second round's compute makes the final fraction real
+    assert final["compile_warmup_s"] > 0
+    assert final["compute_s"] > 0
+    assert final["goodput_fraction"] > 0
+    assert final["tokens"] == 6 * 2 * 2 * 2 * 32  # steps*W*accum*B*S
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    summary = summarize_run(jsonl)
+    assert 0 < summary["goodput_fraction"] <= 1
+    assert summary["restart_downtime_s"] == 0.0
+    assert "badput_top_cause" in summary
+    # the trailing step-less final snapshot must not break the step
+    # count (summarize scans back to the last record carrying one)
+    assert summary["steps"] == 6
+
+
+def test_goodput_partition_real_stepwise_async_run(tmp_path):
+    """Stepwise + async outer: the partition must hold with the sync
+    booked ONLY as the residual apply-wait — outer_sync and compute are
+    disjoint depth-0 spans, so their sum cannot double-count the
+    overlapped collective."""
+    from nanodiloco_tpu.training.train_loop import train
+
+    train(_tiny_cfg(
+        str(tmp_path), "gp-async", fused_rounds=False,
+        async_outer=True, outer_delay=1,
+    ))
+    snaps = _goodput_snaps(str(tmp_path / "gp-async.jsonl"))
+    assert snaps and snaps[-1].get("final") is True
+    for snap in snaps:
+        _assert_partition(snap)
+    final = snaps[-1]
+    assert final["outer_sync_s"] >= 0
+    assert final["compute_s"] > 0
+    # compute + outer_sync alone can never exceed elapsed (the
+    # no-double-count half of the property)
+    assert final["compute_s"] + final["outer_sync_s"] <= final["elapsed_s"]
+
+
+def test_crash_resume_lineage_stitches_with_downtime(tmp_path, monkeypatch):
+    """An in-process (raise-mode) crash fault kills lifetime 0 mid-run
+    — its black box must dump via the unhandled-exception trigger and
+    its goodput snapshots must survive in the JSONL; the resumed
+    lifetime (restart env + downtime env set, as the supervisor would)
+    books the injected relaunch gap, and the stitched ledger reports it
+    exactly."""
+    from nanodiloco_tpu.resilience.faults import InjectedCrash
+    from nanodiloco_tpu.resilience.supervisor import DOWNTIME_ENV, RESTART_ENV
+    from nanodiloco_tpu.training.train_loop import train
+
+    plan = str(tmp_path / "plan.json")
+    with open(plan, "w") as f:
+        json.dump({"faults": [
+            {"kind": "crash", "step": 4, "raise": True},
+        ]}, f)
+    ckpt = str(tmp_path / "ckpt")
+    # 3 rounds: lifetime 0 completes round 1 (warm-up) and crashes at
+    # the round-2 dispatch; lifetime 1 resumes and runs rounds 2-3, so
+    # its second round contributes real compute and the stitched
+    # fraction is non-degenerate
+    cfg = _tiny_cfg(
+        str(tmp_path), "gp-crash", checkpoint_dir=ckpt, fault_plan=plan,
+        total_steps=9,
+    )
+    with pytest.raises(InjectedCrash):
+        train(cfg)
+    blackbox = str(tmp_path / "gp-crash-blackbox.json")
+    assert os.path.exists(blackbox), (
+        "the unhandled-exception trigger must dump the black box"
+    )
+    doc = json.load(open(blackbox))
+    assert doc["reason"].startswith("train_exception:InjectedCrash")
+    assert any(e["kind"] == "span" for e in doc["events"])
+    snaps0 = _goodput_snaps(str(tmp_path / "gp-crash.jsonl"))
+    assert snaps0 and all(s["lifetime"] == 0 for s in snaps0)
+    # lifetime 1: what the supervisor's relaunch would set
+    monkeypatch.setenv(RESTART_ENV, "1")
+    monkeypatch.setenv(DOWNTIME_ENV, "7.500")
+    train(cfg)
+    snaps = _goodput_snaps(str(tmp_path / "gp-crash.jsonl"))
+    lifetimes = {s["lifetime"] for s in snaps}
+    assert lifetimes == {0, 1}
+    st = stitch_goodput_records(
+        [{"goodput": s} for s in snaps]
+    )
+    assert st["lifetimes"] == 2
+    assert st["restart_downtime_s"] == pytest.approx(7.5)
+    assert 0 < st["goodput_fraction"] < 1
+    # elapsed includes the gap no process existed for
+    last0 = [s for s in snaps if s["lifetime"] == 0][-1]
+    last1 = [s for s in snaps if s["lifetime"] == 1][-1]
+    assert st["elapsed_s"] == pytest.approx(
+        last0["elapsed_s"] + last1["elapsed_s"]
+    )
+    assert last1["restart_downtime_s"] == pytest.approx(7.5)

@@ -23,8 +23,10 @@ def _metric_lines(path):
     records — ``{"cost_analysis": ...}`` (obs/costs) and the resilience
     timeline's ``resume``/``fault``/``retry``/``preempt``/``alarm``
     records — are not step lines and would break step-count/index
-    assertions."""
-    meta_keys = ("cost_analysis", "resume", "fault", "retry", "preempt", "alarm")
+    assertions. The per-round ``goodput`` ledger snapshots
+    (obs/goodput) are the same class."""
+    meta_keys = ("cost_analysis", "resume", "fault", "retry", "preempt",
+                 "alarm", "goodput")
     return [
         r for r in (json.loads(l) for l in open(path))
         if not any(k in r for k in meta_keys)
