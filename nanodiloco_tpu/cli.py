@@ -496,6 +496,26 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="shared-prefix KV cache capacity in tokens (a "
                         "common system prompt prefills once and is "
                         "reused); 0 disables")
+    p.add_argument("--kv-block-size", type=int, default=0, metavar="TOKENS",
+                   help="page the KV cache into blocks of this many "
+                        "token rows (power of two <= --chunk-size): a "
+                        "request then holds only the blocks its "
+                        "sequence occupies instead of a worst-case "
+                        "max-len row, admission gates on free blocks, "
+                        "and shared prefixes map blocks copy-on-write; "
+                        "0 (default) keeps the dense per-slot cache")
+    p.add_argument("--kv-dtype", choices=("model", "int8"), default="model",
+                   help="KV cache storage dtype: 'model' stores the "
+                        "compute dtype (bit-identical streams); 'int8' "
+                        "(paged only) quantizes K/V per row for ~4x "
+                        "fp32 slots per HBM byte at a bounded logit "
+                        "perturbation")
+    p.add_argument("--kv-pool-blocks", type=int, default=None, metavar="N",
+                   help="paged KV pool size in blocks (the HBM budget: "
+                        "pool bytes = N x block rows); default "
+                        "slots x ceil(max_len/block) — the dense "
+                        "footprint, oversubscribable downward because "
+                        "short requests only hold what they use")
     p.add_argument("--starvation-s", type=float, default=30.0,
                    help="starvation bound for priority admission: a "
                         "queued request older than this is admitted next "
@@ -559,6 +579,9 @@ def serve_main(argv: list[str]) -> None:
         params, model_cfg, num_slots=args.slots, max_len=max_len,
         chunk_size=args.chunk_size,
         prefix_cache_tokens=args.prefix_cache_tokens,
+        kv_block_size=args.kv_block_size,
+        kv_dtype=args.kv_dtype,
+        kv_pool_blocks=args.kv_pool_blocks,
     )
     tracer = None
     if args.trace_out:
@@ -640,6 +663,12 @@ def _append_serve_stats(path: str, scheduler) -> None:
         "serve_stats": True,
         **{k: v for k, v in s.items() if not k.startswith("hist_")},
     }
+    if isinstance(rec.get("kv_pool"), dict):
+        # same scalars-only rule for the nested block-pool snapshot
+        rec["kv_pool"] = {
+            k: v for k, v in rec["kv_pool"].items()
+            if not k.startswith("hist_")
+        }
     _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "a") as f:
         f.write(json.dumps(rec) + "\n")

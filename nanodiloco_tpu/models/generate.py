@@ -402,29 +402,105 @@ def pad_prompts(prompts: list[list[int]], pad_id: int = 0):
 # ---------------------------------------------------------------------------
 # Slot-addressed serving programs (nanodiloco_tpu/serve)
 #
-# The continuous-batching engine owns ONE cache [L, B, S_max, Hkv, hd]
-# whose B rows are independent request slots at independent positions.
-# The programs covering its whole life:
-#   - prefill_chunk_fn: write one CHUNK of a request's prompt K/V into
-#     its slot at a traced offset (the same ``_cached_block`` the
-#     one-shot ``generate`` prefill uses, so the two paths can never
-#     drift) and return the chunk's last-real-position logits. Chunk
-#     lengths are BUCKETED to powers of two up to the engine's chunk
-#     size, so the compile count is bounded by log2(chunk_size)+1 —
-#     NOT one executable per prompt length, the PR-4 recompile trap.
-#   - sample_token_fn: sample one token from [1, V] logits with the
-#     request's key/temperature/top_k/top_p (``_sample_slots`` — the
-#     per-row mirror of ``_sample``, op for op).
-#   - decode_slots_fn: advance ALL slots one token with PER-SLOT
-#     positions, PRNG keys, and sampling params; compiled once per
-#     (config, B, S_max) — admitting or retiring a request never
-#     recompiles anything.
+# The continuous-batching engine owns either ONE dense cache
+# [L, B, S_max, Hkv, hd] whose B rows are independent request slots, or
+# (paged mode) ONE block arena [L, num_blocks, block_size, Hkv, hd]
+# addressed through per-slot block tables — a slot then holds only the
+# blocks its sequence actually occupies, so HBM caps concurrency by
+# TOKENS RESIDENT, not slots x worst-case S_max. The programs covering
+# a request's whole life:
+#   - prefill_chunk_fn / prefill_chunk_paged_fn: write one CHUNK of a
+#     request's prompt K/V into its slot at a traced offset (the same
+#     ``_cached_block`` the one-shot ``generate`` prefill uses, so the
+#     two paths can never drift), return the chunk's last-real-position
+#     logits AND the token sampled from them — sampling is fused into
+#     the chunk program, so a final chunk is ONE dispatch, not
+#     attention-then-sample. Chunk lengths are BUCKETED to powers of
+#     two up to the engine's chunk size, so the compile count is
+#     bounded by log2(chunk_size)+1 — NOT one executable per prompt
+#     length, the PR-4 recompile trap. The paged variant gathers the
+#     slot's dense view through its block table, runs the identical
+#     ``_cached_block`` math, and scatters only the touched blocks
+#     back (out-of-range table entries drop, so a bucketed pad tail
+#     past the slot's allocation is a no-op write).
+#   - decode_slots_fn / decode_slots_paged_fn: advance ALL slots one
+#     token with PER-SLOT positions, PRNG keys, and sampling params,
+#     sampling fused in — one executable per tick does
+#     attention+sampling with zero extra dispatch; compiled once per
+#     (config, B, S) — admitting or retiring a request never
+#     recompiles anything. The paged variant gathers each layer's K/V
+#     through the block tables INSIDE the layer scan, so the dense
+#     working view exists one layer at a time, and writes each slot's
+#     new row by physical (block, offset) scatter (inactive slots are
+#     redirected out of range and dropped).
 #   - extract_chunk_fn / insert_chunk_fn: copy one whole chunk of K/V
-#     rows out of / into a slot — the shared-prefix cache's device-side
-#     halves (one compile each; chunk shape is static).
+#     rows out of / into a dense slot — the shared-prefix cache's
+#     device-side halves in dense mode (one compile each; paged mode
+#     shares prefix BLOCKS by reference instead — zero device copies).
 # Sampling params ride as traced arrays so a new request with new
 # temperature/top_k/top_p reuses the same executable.
+#
+# int8 KV (paged only): the arena stores int8 K/V plus one float32
+# scale per (layer, block, row) — quantize on write (scale =
+# amax(|row|)/127 over the row's [Hkv, hd] values), dequantize in the
+# attention read. Per-ROW scales mean appending a token never
+# requantizes earlier rows, so there is no accumulation of repeated
+# quantization error; rewriting an untouched row round-trips to the
+# same int8 bits (the scale reproduces to within 2^-23 relative, and
+# |q| <= 127 keeps round() exact). ~4x serve slots per HBM byte vs a
+# float32 cache at the cost of a bounded logit perturbation — the fp
+# paged path stays bit-identical to solo ``generate()``.
 # ---------------------------------------------------------------------------
+
+
+def init_kv_pool(cfg: LlamaConfig, num_blocks: int, block_size: int,
+                 kv_dtype: str | None = None) -> dict:
+    """Preallocated block arena: k/v ``[L, num_blocks, block_size, Hkv,
+    hd]``. ``kv_dtype="int8"`` stores int8 values plus per-(layer,
+    block, row) float32 scales ``ks``/``vs`` ``[L, num_blocks,
+    block_size]``; otherwise the compute dtype (paged-fp)."""
+    shape = (
+        cfg.num_hidden_layers, num_blocks, block_size, cfg.kv_heads,
+        cfg.head_dim,
+    )
+    if kv_dtype == "int8":
+        sshape = shape[:3]
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "ks": jnp.zeros(sshape, jnp.float32),
+            "vs": jnp.zeros(sshape, jnp.float32),
+        }
+    cdt = jnp.dtype(kv_dtype or cfg.dtype)
+    return {"k": jnp.zeros(shape, cdt), "v": jnp.zeros(shape, cdt)}
+
+
+def kv_bytes_per_token(cfg: LlamaConfig, kv_dtype: str | None = None) -> int:
+    """HBM bytes one cached token position costs: K+V rows across all
+    layers, plus the per-row scales in int8 mode — the accounting the
+    capacity bench and the admission arithmetic share."""
+    row = cfg.num_hidden_layers * cfg.kv_heads * cfg.head_dim
+    if kv_dtype == "int8":
+        return 2 * row + 2 * cfg.num_hidden_layers * 4  # int8 + f32 scales
+    return 2 * row * jnp.dtype(kv_dtype or cfg.dtype).itemsize
+
+
+def _quantize_rows(rows):
+    """``[..., Hkv, hd]`` float rows -> (int8 rows, float32 scale
+    ``[...]``): symmetric per-row quantization at amax/127. The amax
+    floor keeps all-zero rows (never-written cache) at scale ~0 without
+    a divide-by-zero."""
+    f = rows.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=(-2, -1))
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(f / scale[..., None, None]), -127.0, 127.0
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def _dequantize_rows(q, scale, cdt):
+    return (q.astype(jnp.float32) * scale[..., None, None]).astype(cdt)
 
 
 def _sample_slots(logits, keys, temperature, top_k, top_p):
@@ -541,13 +617,27 @@ def _serve_donate():
     return () if jax.default_backend() == "cpu" else (1,)
 
 
+def _sample_one(logits, key_data, temperature, top_k, top_p):
+    """Single-row ``_sample_slots`` over raw key data: the fused
+    prefill-side sample (same op sequence the decode tick uses)."""
+    key = jax.random.wrap_key_data(key_data)
+    return _sample_slots(
+        logits, key[None], temperature[None], top_k[None], top_p[None]
+    )[0]
+
+
 @functools.lru_cache(maxsize=4)
 def prefill_chunk_fn(cfg: LlamaConfig):
     """Jitted ``(params, cache, chunk [1,C], chunk_valid [1,C], slot,
-    pos, last_idx) -> (logits [1,V] float32, cache)``: run ONE chunk of
+    pos, last_idx, key_data [2]u32, temperature, top_k, top_p) ->
+    (token scalar, logits [1,V] float32, cache)``: run ONE chunk of
     a prompt through the decoder, writing its K/V into cache slot
     ``slot`` (traced) at positions ``[pos, pos+C)`` (traced), attending
-    causally over everything already written. The SAME ``_cached_block``
+    causally over everything already written, and sample a token from
+    the chunk's last-real-position logits IN THE SAME EXECUTABLE (a
+    final chunk costs one dispatch, never attention-then-sample; an
+    interior chunk's sample is discarded by the caller — its cost is a
+    vocab sort, noise next to the decoder). The SAME ``_cached_block``
     program the one-shot ``generate`` prefill runs — the two paths can
     never drift — with the write offset and the last-real-token index
     traced so one executable per CHUNK LENGTH covers every slot, every
@@ -557,7 +647,8 @@ def prefill_chunk_fn(cfg: LlamaConfig):
     Retraces only per chunk length — the engine buckets those to powers
     of two, so mixed-length traffic compiles a bounded program set."""
 
-    def run(params, cache, chunk, chunk_valid, slot, pos, last_idx):
+    def run(params, cache, chunk, chunk_valid, slot, pos, last_idx,
+            key_data, temperature, top_k, top_p):
         l, _b, s_max, nkv, hd = cache["k"].shape
         ck = jax.lax.dynamic_slice(
             cache["k"], (0, slot, 0, 0, 0), (l, 1, s_max, nkv, hd)
@@ -581,25 +672,75 @@ def prefill_chunk_fn(cfg: LlamaConfig):
                 cache["v"], sub["v"], (0, slot, 0, 0, 0)
             ),
         }
-        return logits, cache
+        tok = _sample_one(logits, key_data, temperature, top_k, top_p)
+        return tok, logits, cache
 
     return jax.jit(run, donate_argnums=_serve_donate())
 
 
-@functools.lru_cache(maxsize=4)
-def sample_token_fn(cfg: LlamaConfig):
-    """Jitted ``(logits [1,V], key, temperature, top_k, top_p) ->
-    first_token scalar``: the prefill-side sample, split out of the
-    chunk program so intermediate chunks never pay for it. Uses
-    ``_sample_slots`` — the same op sequence the decode tick (and,
-    mirrored, the one-shot ``generate``) samples with."""
+@functools.lru_cache(maxsize=8)
+def prefill_chunk_paged_fn(cfg: LlamaConfig, kv_dtype: str | None = None):
+    """Paged twin of ``prefill_chunk_fn``: jitted ``(params, pool,
+    table [max_blocks] i32, chunk [1,C], chunk_valid [1,C], pos,
+    last_idx, key_data, temperature, top_k, top_p) -> (token, logits,
+    pool)``. Gathers the slot's dense K/V view through its block table
+    (clamped out-of-range sentinel entries read causally-dead garbage),
+    runs the IDENTICAL ``_cached_block`` math — so paged-fp logits are
+    bit-identical to the dense path — and scatters only the touched
+    blocks back. The engine guarantees ``pos`` is block-aligned (chunk
+    starts are multiples of chunk_size and block_size divides
+    chunk_size), so the touched window is ``[pos, pos + max(C,
+    block_size))``; rows past the slot's allocation are pad positions
+    whose writes drop at the out-of-range sentinel. int8 mode
+    dequantizes the gather and quantizes the scattered rows per-row
+    (see module notes: rewriting an untouched row round-trips)."""
+    quant = kv_dtype == "int8"
 
-    def run(logits, key, temperature, top_k, top_p):
-        return _sample_slots(
-            logits, key[None], temperature[None], top_k[None], top_p[None]
-        )[0]
+    def run(params, pool, table, chunk, chunk_valid, pos, last_idx,
+            key_data, temperature, top_k, top_p):
+        cdt = jnp.dtype(cfg.dtype)
+        l, nb, bs, nkv, hd = pool["k"].shape
+        mb = table.shape[0]
 
-    return jax.jit(run)
+        def gathered(name, sname):
+            g = pool[name][:, table]  # [L, mb, bs, Hkv, hd]
+            if quant:
+                g = _dequantize_rows(g, pool[sname][:, table], cdt)
+            return g.reshape(l, 1, mb * bs, nkv, hd).astype(cdt)
+
+        sub = {"k": gathered("k", "ks"), "v": gathered("v", "vs")}
+        key_valid = jnp.ones((1, mb * bs), jnp.int32)
+        logits, sub = _cached_block(
+            params, cfg, chunk, sub, pos, key_valid, chunk_valid,
+            block=0, last_index=last_idx,
+        )
+        c = chunk.shape[1]
+        # one block wider than the chunk itself: covers an unaligned
+        # start (the rare bucket-overflow refeed — see the engine's
+        # final-chunk note) and costs one identity rewrite of
+        # already-gathered rows in the aligned common case
+        n_touch = min(c // bs + 1, mb) if c >= bs else 1
+        # both slices clamp to the same block boundary; the explicit
+        # min keeps the table slice and the data slice in lockstep
+        b0 = jnp.minimum(pos // bs, mb - n_touch)
+        phys = jax.lax.dynamic_slice(table, (b0,), (n_touch,))
+        new = {}
+        for name, sname in (("k", "ks"), ("v", "vs")):
+            w = jax.lax.dynamic_slice(
+                sub[name], (0, 0, b0 * bs, 0, 0), (l, 1, n_touch * bs, nkv, hd)
+            ).reshape(l, n_touch, bs, nkv, hd)
+            if quant:
+                q, sc = _quantize_rows(w)
+                new[name] = pool[name].at[:, phys].set(q, mode="drop")
+                new[sname] = pool[sname].at[:, phys].set(sc, mode="drop")
+            else:
+                new[name] = pool[name].at[:, phys].set(
+                    w.astype(pool[name].dtype), mode="drop"
+                )
+        tok = _sample_one(logits, key_data, temperature, top_k, top_p)
+        return tok, logits, new
+
+    return jax.jit(run, donate_argnums=_serve_donate())
 
 
 @functools.lru_cache(maxsize=4)
@@ -659,5 +800,135 @@ def decode_slots_fn(cfg: LlamaConfig):
         keys = jax.random.wrap_key_data(key_data)
         nxt = _sample_slots(logits, keys, temperature, top_k, top_p)
         return nxt, cache
+
+    return jax.jit(run, donate_argnums=_serve_donate())
+
+
+def _decode_slots_paged_block(params, cfg: LlamaConfig, tokens, pool,
+                              tables, pos, active, quant: bool):
+    """``_decode_slots_block`` over the block arena: per-slot positions
+    resolve to a physical (block, row) through each slot's block table.
+    Each layer's K/V is gathered through the tables INSIDE the layer
+    scan — the dense working view exists one layer at a time, not as an
+    [L, B, S] resident tensor — and the new row is written by scatter
+    at its physical address BEFORE the gather, so a slot attends to its
+    own fresh token exactly as the dense path does. Inactive slots'
+    writes are redirected out of range and dropped (the paged analogue
+    of the dense path's masked select); their attention output is
+    garbage over causally-bounded finite rows and is discarded. Mask is
+    purely causal (``ki <= pos``): the serve path never left-pads, and
+    positions past a slot's live prefix — including stale rows behind
+    clamped sentinel table entries — are causally unreachable."""
+    cdt = jnp.dtype(cfg.dtype)
+    b = tokens.shape[0]
+    _l, nb, bs, nkv, hd = pool["k"].shape
+    mb = tables.shape[1]
+    s_view = mb * bs
+    nh = cfg.num_attention_heads
+    g = nh // nkv
+    scale = 1.0 / math.sqrt(hd)
+
+    x = params["embed"].astype(cdt)[tokens[:, None]]  # [B, 1, d]
+
+    # per-slot RoPE at global position pos[b] — op-for-op the dense
+    # decode tick's tables
+    inv_freq = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
+    )
+    freqs = pos.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    cos = jnp.cos(emb)[:, None, None, :].astype(cdt)
+    sin = jnp.sin(emb)[:, None, None, :].astype(cdt)
+
+    def rope(t):
+        half = t.shape[-1] // 2
+        t1, t2 = t[..., :half], t[..., half:]
+        return t * cos + jnp.concatenate([-t2, t1], axis=-1) * sin
+
+    ki = jnp.arange(s_view)
+    ok = ki[None, None, :] <= pos[:, None, None]
+    mask = jnp.where(ok, 0.0, MASK_VALUE)[:, None]        # [B, 1, T=1, S]
+    # physical write address per slot: table[pos // bs] row pos % bs;
+    # inactive slots aim past the arena and the scatter drops them
+    bi = jnp.clip(pos // bs, 0, mb - 1)
+    off = pos % bs
+    phys = jnp.take_along_axis(tables, bi[:, None], axis=1)[:, 0]
+    phys = jnp.where(active > 0, phys, nb)
+    token_valid = active[:, None]
+
+    def layer_body(x, scanned):
+        if quant:
+            layer, pk, pv, pks, pvs = scanned
+        else:
+            layer, pk, pv = scanned
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ layer["wq"].astype(cdt)).reshape(b, 1, nh, hd)
+        k = (h @ layer["wk"].astype(cdt)).reshape(b, 1, nkv, hd)
+        v = (h @ layer["wv"].astype(cdt)).reshape(b, 1, nkv, hd)
+        q = rope(q)
+        k = rope(k)
+        if quant:
+            qk, sk = _quantize_rows(k[:, 0])
+            qv, sv = _quantize_rows(v[:, 0])
+            pk = pk.at[phys, off].set(qk, mode="drop")
+            pv = pv.at[phys, off].set(qv, mode="drop")
+            pks = pks.at[phys, off].set(sk, mode="drop")
+            pvs = pvs.at[phys, off].set(sv, mode="drop")
+            ck = _dequantize_rows(pk[tables], pks[tables], cdt)
+            cv = _dequantize_rows(pv[tables], pvs[tables], cdt)
+        else:
+            pk = pk.at[phys, off].set(k[:, 0].astype(pk.dtype), mode="drop")
+            pv = pv.at[phys, off].set(v[:, 0].astype(pv.dtype), mode="drop")
+            ck, cv = pk[tables], pv[tables]
+        ck = ck.reshape(b, s_view, nkv, hd).astype(cdt)
+        cv = cv.reshape(b, s_view, nkv, hd).astype(cdt)
+
+        qg = q.reshape(b, 1, nkv, g, hd)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck).astype(jnp.float32)
+        scores = scores * scale + mask[:, :, None]
+        probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
+        attn = jnp.einsum("bkgts,bskd->btkgd", probs, cv).reshape(b, 1, nh * hd)
+        x = x + attn @ layer["wo"].astype(cdt)
+
+        x, _aux = mlp_block(cfg, x, layer, valid=token_valid)
+        if quant:
+            return x, (pk, pv, pks, pvs)
+        return x, (pk, pv)
+
+    if quant:
+        scanned = (params["layers"], pool["k"], pool["v"],
+                   pool["ks"], pool["vs"])
+    else:
+        scanned = (params["layers"], pool["k"], pool["v"])
+    x, out = jax.lax.scan(layer_body, x, scanned)
+    x = rms_norm(x[:, -1], params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head", None)
+    if head is None:
+        head = params["embed"].T
+    logits = (x @ head.astype(cdt)).astype(jnp.float32)
+    if quant:
+        pool = {"k": out[0], "v": out[1], "ks": out[2], "vs": out[3]}
+    else:
+        pool = {"k": out[0], "v": out[1]}
+    return logits, pool
+
+
+@functools.lru_cache(maxsize=8)
+def decode_slots_paged_fn(cfg: LlamaConfig, kv_dtype: str | None = None):
+    """Paged twin of ``decode_slots_fn``: jitted ``(params, pool,
+    tables [B, max_blocks] i32, tokens [B], pos [B], key_data [B,2]
+    u32, temperature [B], top_k [B], top_p [B], active [B]) ->
+    (next_tokens [B], pool)`` — one tick advancing every slot through
+    the block arena, sampling fused in."""
+    quant = kv_dtype == "int8"
+
+    def run(params, pool, tables, tokens, pos, key_data,
+            temperature, top_k, top_p, active):
+        logits, pool = _decode_slots_paged_block(
+            params, cfg, tokens, pool, tables, pos, active, quant
+        )
+        keys = jax.random.wrap_key_data(key_data)
+        nxt = _sample_slots(logits, keys, temperature, top_k, top_p)
+        return nxt, pool
 
     return jax.jit(run, donate_argnums=_serve_donate())

@@ -16,6 +16,7 @@ Layers (each usable alone):
   ``GET /healthz``, ``GET /metrics`` (OpenMetrics serve gauges).
 """
 
+from nanodiloco_tpu.serve.block_pool import BlockPool, BlocksExhausted
 from nanodiloco_tpu.serve.client import http_get, http_post_json
 from nanodiloco_tpu.serve.engine import InferenceEngine
 from nanodiloco_tpu.serve.prefix_cache import PrefixCache
@@ -28,6 +29,8 @@ from nanodiloco_tpu.serve.scheduler import (
 from nanodiloco_tpu.serve.server import ServeServer
 
 __all__ = [
+    "BlockPool",
+    "BlocksExhausted",
     "InferenceEngine",
     "http_get",
     "http_post_json",

@@ -1,49 +1,79 @@
 """Slot-based continuous-batching engine over the static-shape KV cache.
 
 Orca-style (Yu et al., OSDI'22) iteration-level scheduling on TPU terms:
-the engine owns ONE preallocated cache ``[L, B, S_max, Hkv, hd]`` whose
-B rows are independent request slots. A request's life:
+the engine owns ONE preallocated cache whose rows are independent
+request slots. Two storage modes share every scheduling surface:
+
+- DENSE (``kv_block_size=0``): one ``[L, B, S_max, Hkv, hd]`` row per
+  slot — a 30-token request reserves worst-case ``S_max`` HBM, so slot
+  count caps concurrency.
+- PAGED (``kv_block_size>0``): one ``[L, num_blocks, block_size, Hkv,
+  hd]`` arena plus a per-slot block table (vLLM's PagedAttention
+  insight, arXiv:2309.06180, on this repo's static-shape terms). A
+  request is admitted with exactly ``ceil((prompt + max_new) /
+  block_size)`` blocks — HBM caps concurrency by tokens RESIDENT, not
+  slots x worst-case — and its blocks return to the free list the
+  moment it retires, expires, or cancels. ``kv_dtype="int8"`` stores
+  the arena quantized (per-row scales, quantize on write, dequantize
+  in the attention read) for ~4x slots per HBM byte vs float32; the fp
+  arena stays bit-identical to solo ``generate()``.
+
+A request's life:
 
 - ``start_prefill(slot, request)`` stages the request into a free slot
-  and, when the prefix cache holds the prompt's leading chunks, copies
-  their K/V rows in so only the suffix needs compute.
+  and, when the prefix cache holds the prompt's leading chunks, reuses
+  them. In dense mode that copies cached K/V rows in; in paged mode it
+  maps the cached chunks' BLOCKS into the slot's table copy-on-write
+  (refcount bump, zero device copies) — "copy" never happens, because
+  a slot only ever writes past its prefix-hit boundary, into blocks it
+  owns exclusively. Paged admission is all-or-nothing: if the pool
+  cannot supply the blocks, ``BlocksExhausted`` is raised with nothing
+  allocated and nothing counted, and the scheduler leaves the request
+  queued (admission gates on free BLOCKS, not just free slots).
 - ``prefill_step(slot)`` runs ONE prefill chunk (Sarathi-Serve,
   arXiv:2403.02310: chunked prefill is what keeps a 4k-token prompt
   from freezing every live decode stream between two ticks). The final
-  chunk samples and returns the first token; earlier chunks return
-  None. Chunk lengths are bucketed to powers of two, so mixed-length
-  traffic compiles a BOUNDED program set — not one prefill executable
-  per prompt length.
+  chunk returns the first token; earlier chunks return None. Chunk
+  lengths are bucketed to powers of two, so mixed-length traffic
+  compiles a BOUNDED program set — not one prefill executable per
+  prompt length. Sampling is FUSED into the chunk program: a final
+  chunk is one dispatch doing attention+sampling, never
+  attention-then-sample.
 - every ``step()`` advances ALL decoding slots one token with a single
   compiled program (per-slot positions, PRNG keys, and sampling params
-  ride as traced arrays) — admitting a new request or retiring a
-  finished one never recompiles and never stops the other streams.
+  ride as traced arrays; sampling fused into the same executable) —
+  admitting a new request or retiring a finished one never recompiles
+  and never stops the other streams.
 - ``release(slot)`` frees the row (mid-prefill or mid-decode). Nothing
   is zeroed: a retired slot's stale K/V is causally unreachable to the
-  next occupant (its prefill overwrites ``[0, P)`` and decode never
-  attends past its own position).
+  next occupant. In paged mode every block the slot referenced is
+  deref'd — shared prefix blocks survive while the prefix cache (or
+  another slot) still holds them; exclusive blocks return to the free
+  list immediately.
 
 Chunking math (why it is exact): K/V at position i depend only on
 ``tokens[:i+1]``, so writing them chunk-by-chunk produces the same cache
 bits as one whole-prompt call; each chunk's queries attend causally over
 everything already written, which is the same reduction the one-shot
-prefill performs row by row. The final chunk is bucketed by RE-FEEDING
-the prompt's last ``bucket`` tokens (recomputing K/V to identical bits)
-so its last row is the true last prompt token — except a single-chunk
-prompt shorter than its bucket, which right-pads instead and passes the
-last REAL index into the program (pad K/V land past the prompt,
-causally unreachable, then overwritten by decode).
+prefill performs row by row. Every chunk starts at the prompt cursor
+(``done``) — a multiple of chunk_size, hence block-aligned — and the
+final chunk right-pads up to its power-of-two bucket, passing the last
+REAL index into the program: pad K/V land past the prompt, causally
+unreachable, then overwritten by decode. (Right-padding, never
+re-feeding earlier tokens, is what makes copy-on-write safe: a slot
+never writes at positions below its prefix-hit boundary, so shared
+blocks are read-only by construction.)
 
-Determinism contract (tested): a request's token stream is exactly the
-stream ``generate()`` produces alone with the same seed and sampling
-params — through chunked admission AND through a prefix-cache hit (the
-cached rows were computed from the same tokens at the same positions
-under the same params). The per-request PRNG schedule is replicated on
-the host at admission — ``key, k0 = split(key(seed))`` for the first
-token, then ``split(key, max_new_tokens - 1)`` for the decode steps
-(the full array is materialized up front because ``split(key, n)[i]``
-depends on ``n`` on this jax) — and each tick feeds every slot its own
-next key.
+Determinism contract (tested, dense AND paged-fp): a request's token
+stream is exactly the stream ``generate()`` produces alone with the
+same seed and sampling params — through chunked admission AND through a
+prefix-cache hit. The per-request PRNG schedule is replicated on the
+host at admission — ``key, k0 = split(key(seed))`` for the first token,
+then ``split(key, max_new_tokens - 1)`` for the decode steps (the full
+array is materialized up front because ``split(key, n)[i]`` depends on
+``n`` on this jax) — and each tick feeds every slot its own next key.
+The int8 arena trades that bit-parity for HBM: its contract is logit
+tolerance + greedy-token parity (tests/test_kv_paging.py), not bits.
 
 Known divergence, inherited from ``generate`` and narrowed here: dense-
 dispatch token-choice MoE sizes expert capacity from the tokens in the
@@ -65,13 +95,24 @@ import numpy as np
 from nanodiloco_tpu.models.config import LlamaConfig
 from nanodiloco_tpu.models.generate import (
     decode_slots_fn,
+    decode_slots_paged_fn,
     extract_chunk_fn,
     init_kv_cache,
+    init_kv_pool,
     insert_chunk_fn,
+    kv_bytes_per_token,
     prefill_chunk_fn,
-    sample_token_fn,
+    prefill_chunk_paged_fn,
 )
+from nanodiloco_tpu.obs.telemetry import Histogram
+from nanodiloco_tpu.serve.block_pool import BlockPool, BlocksExhausted
 from nanodiloco_tpu.serve.prefix_cache import PrefixCache
+
+__all__ = ["InferenceEngine", "BlocksExhausted"]
+
+# blocks-held-per-request histogram bounds (requests, not seconds —
+# powers of two up to a long request's worst case)
+_BLOCK_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 
 def _floor_pow2(n: int) -> int:
@@ -108,6 +149,9 @@ class InferenceEngine:
         max_len: int = 1024,
         chunk_size: int = 64,
         prefix_cache_tokens: int = 0,
+        kv_block_size: int = 0,
+        kv_dtype: str | None = None,
+        kv_pool_blocks: int | None = None,
     ) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1; got {num_slots}")
@@ -120,6 +164,16 @@ class InferenceEngine:
                 "expert-choice routing is training-only (see generate()); "
                 "use router_type='tokens_choose' for serving"
             )
+        if kv_dtype not in (None, "model", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'model' or 'int8'; got {kv_dtype!r}"
+            )
+        self.kv_dtype = None if kv_dtype == "model" else kv_dtype
+        if self.kv_dtype == "int8" and not kv_block_size:
+            raise ValueError(
+                "int8 KV storage requires the paged cache; pass "
+                "kv_block_size > 0"
+            )
         self.params = params
         self.cfg = cfg
         self.num_slots = int(num_slots)
@@ -127,22 +181,70 @@ class InferenceEngine:
         # chunk lengths are bucketed to powers of two; capping the top
         # bucket at the largest power of two <= max_len keeps every
         # bucketed write inside the slot row (a bucket can right-pad a
-        # single-chunk prompt, and dynamic_update_slice would CLAMP an
+        # final chunk, and dynamic_update_slice would CLAMP an
         # out-of-range write backwards over real positions)
         self.chunk_size = _floor_pow2(min(int(chunk_size), self.max_len))
         self.vocab_size = cfg.vocab_size
-        self.cache = init_kv_cache(cfg, self.num_slots, self.max_len)
-        self._chunk = prefill_chunk_fn(cfg)
-        self._sample = sample_token_fn(cfg)
-        self._decode = decode_slots_fn(cfg)
-        self._extract = extract_chunk_fn(cfg)
-        self._insert = insert_chunk_fn(cfg)
+        self.paged = bool(kv_block_size)
+        self._chunk = None
+        self._decode = None
+        self._extract = None
+        self._insert = None
+        b = self.num_slots
+        if self.paged:
+            # block size: a power of two no larger than the chunk size,
+            # so every chunk start (a multiple of chunk_size) is
+            # block-aligned and shared prefix chunks map to whole blocks
+            self.kv_block_size = _floor_pow2(
+                min(int(kv_block_size), self.chunk_size)
+            )
+            bs = self.kv_block_size
+            self.max_blocks = -(-self.max_len // bs)   # allocation bound
+            # the TABLE is one chunk of sentinel entries wider than any
+            # allocation: a right-padded final bucket then always fits
+            # the gathered view (done + bucket <= ceil(max_len/cs)*cs <
+            # view), so the paged path NEVER takes the re-feed fallback
+            # — which would rewrite rows below the prefix-hit boundary,
+            # and in int8 mode re-feed bits are NOT identical (the
+            # original chunk attended its own rows as fresh fp; a
+            # re-feed reads them dequantized), i.e. it would corrupt
+            # shared copy-on-write blocks. Pad writes land on the
+            # sentinel and drop; pad reads are causally masked.
+            self.table_blocks = self.max_blocks + self.chunk_size // bs
+            default_blocks = self.num_slots * self.max_blocks
+            nb = int(kv_pool_blocks) if kv_pool_blocks else default_blocks
+            # a pool SMALLER than one max_len request is legal — it
+            # serves short requests and validate() rejects the long
+            # ones outright (they could never be admitted)
+            self.block_pool = BlockPool(nb, bs)
+            self.pool = init_kv_pool(cfg, nb, bs, self.kv_dtype)
+            self.cache = None
+            self._chunk_paged = prefill_chunk_paged_fn(cfg, self.kv_dtype)
+            self._decode_paged = decode_slots_paged_fn(cfg, self.kv_dtype)
+            # per-slot block tables; the sentinel nb is out of range:
+            # reads clamp to causally-dead garbage, writes drop
+            self._tables = np.full((b, self.table_blocks), nb, np.int32)
+            self._slot_blocks: list[list[int]] = [[] for _ in range(b)]
+            self.kv_block_evictions = 0
+            self.hist_blocks_per_request = Histogram(_BLOCK_BUCKETS)
+        else:
+            self.kv_block_size = 0
+            self.block_pool = None
+            self.pool = None
+            self.cache = init_kv_cache(cfg, self.num_slots, self.max_len)
+            self._chunk = prefill_chunk_fn(cfg)
+            self._decode = decode_slots_fn(cfg)
+            self._extract = extract_chunk_fn(cfg)
+            self._insert = insert_chunk_fn(cfg)
         self.prefix_cache = (
-            PrefixCache(int(prefix_cache_tokens), self.chunk_size)
+            PrefixCache(
+                int(prefix_cache_tokens), self.chunk_size,
+                on_evict=self._evict_prefix_blocks if self.paged else None,
+            )
             if prefix_cache_tokens else None
         )
 
-        b, s = self.num_slots, self.max_len
+        s = self.max_len
         self._tokens = np.zeros(b, np.int32)       # next input token per slot
         self._pos = np.zeros(b, np.int32)          # next cache write position
         self._key_valid = np.zeros((b, s), np.int32)
@@ -157,6 +259,13 @@ class InferenceEngine:
         self._dummy_key = np.asarray(
             jax.random.key_data(jax.random.key(0)), np.uint32
         )
+        # debug probe, OFF by default: when ``capture_prefill_logits``
+        # is set, each final chunk's logits land here as numpy — the
+        # int8 tolerance tests read it. Left off, nothing is copied:
+        # a [1, V] device-to-host transfer per admission is real TTFT
+        # at production vocab sizes
+        self.capture_prefill_logits = False
+        self.last_prefill_logits: np.ndarray | None = None
         # device-resident copies of the slot state that only changes at
         # admit/release (key_valid alone is [B, S_max] — re-uploading it
         # every tick would put an H2D transfer on the per-token path)
@@ -164,9 +273,18 @@ class InferenceEngine:
 
     # -- request validation (shared with the server's 400 path) -------------
 
+    def blocks_for(self, prompt_tokens: int, max_new_tokens: int) -> int:
+        """KV blocks a request occupies for its whole life (paged mode):
+        prompt + completion rows, rounded up to whole blocks. Allocation
+        is up-front and exact, so a request admitted never runs out of
+        cache mid-decode."""
+        return -(-(prompt_tokens + max_new_tokens) // self.kv_block_size)
+
     def validate(self, prompt, max_new_tokens: int) -> None:
         """Raises ValueError when a request cannot be served by this
-        engine's static shapes."""
+        engine's static shapes (including a paged pool it could NEVER
+        fit — transient block shortage is ``BlocksExhausted`` at
+        admission instead, and retryable)."""
         if len(prompt) < 1:
             raise ValueError("prompt must have at least one token")
         if max_new_tokens < 1:
@@ -179,6 +297,14 @@ class InferenceEngine:
                 f"({max_new_tokens}) exceeds the engine's max_len "
                 f"({self.max_len})"
             )
+        if self.paged:
+            need = self.blocks_for(len(prompt), max_new_tokens)
+            if need > self.block_pool.num_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool only "
+                    f"has {self.block_pool.num_blocks} in total — it can "
+                    f"never be admitted"
+                )
         bad = [t for t in prompt if not 0 <= int(t) < self.vocab_size]
         if bad:
             raise ValueError(
@@ -192,14 +318,62 @@ class InferenceEngine:
         """Stage ``request`` into free slot ``slot``: validate, reuse
         any cached shared-prefix K/V, and return the number of prefill
         chunks still to run (>= 1 — the last prompt token always
-        prefills for real, its logits seed the first sample)."""
+        prefills for real, its logits seed the first sample). Paged
+        mode allocates the request's whole block budget here,
+        all-or-nothing: ``BlocksExhausted`` (nothing mutated, nothing
+        counted) tells the scheduler to keep the request queued until
+        blocks free up."""
         ids = [int(t) for t in request.prompt]
         self.validate(ids, request.max_new_tokens)
         done = 0
         use_cache = self.prefix_cache is not None and getattr(
             request, "prefix_cache", True
         )
-        if use_cache:
+        if self.paged:
+            cs, bs = self.chunk_size, self.kv_block_size
+            need = self.blocks_for(len(ids), request.max_new_tokens)
+            # PEEK the prefix cache first: sizing must precede any
+            # side effect so a block-starved admission rolls back to
+            # nothing (no counters, no LRU churn, no refs). Under
+            # pressure, RECLAIM cache-only blocks by evicting LRU
+            # prefixes: cached K/V is a best-effort optimization, and
+            # without this path a cache that swallowed the pool would
+            # livelock admission forever (no prefill can complete, so
+            # insert-side eviction never runs). Each eviction can
+            # invalidate the matched chain, so the peek re-walks.
+            while True:
+                chains = (
+                    self.prefix_cache.match(ids, record=False)
+                    if use_cache else []
+                )
+                shared = [blk for chunk in chains for blk in chunk]
+                own_need = need - len(shared)
+                if own_need <= self.block_pool.free_blocks:
+                    break
+                if (self.prefix_cache is None
+                        or not self.prefix_cache.evict_lru()):
+                    raise BlocksExhausted(
+                        f"request needs {own_need} KV blocks "
+                        f"({need} total, {len(shared)} shared) but only "
+                        f"{self.block_pool.free_blocks}/"
+                        f"{self.block_pool.num_blocks} are free"
+                    )
+            # commit: record the hit/miss for real (same chain —
+            # nothing mutated between the peek and this), take the
+            # references
+            if use_cache:
+                chains = self.prefix_cache.match(ids)
+            own = self.block_pool.alloc(own_need)
+            self.block_pool.ref(shared)
+            blocks = shared + own
+            self._slot_blocks[slot] = blocks
+            row = np.full(self.table_blocks, self.block_pool.num_blocks,
+                          np.int32)
+            row[: len(blocks)] = blocks
+            self._tables[slot] = row
+            self._dev = None
+            done = len(chains) * cs
+        elif use_cache:
             blocks = self.prefix_cache.match(ids)
             for i, (k, v) in enumerate(blocks):
                 self.cache = self._insert(
@@ -210,52 +384,83 @@ class InferenceEngine:
         self._prefills[slot] = _Prefill(request, ids, done)
         return -(-(len(ids) - done) // self.chunk_size)
 
+    def _run_chunk(self, slot: int, chunk, valid, pos: int, last: int,
+                   key_data, temp: float, top_k: int, top_p: float):
+        """Dispatch one (bucketed) chunk through the mode's compiled
+        program; returns (token scalar, logits [1, V])."""
+        args = (
+            jnp.asarray([chunk], jnp.int32), jnp.asarray(valid),
+            jnp.int32(pos), jnp.int32(last),
+            jnp.asarray(key_data, jnp.uint32),
+            jnp.float32(temp), jnp.int32(top_k), jnp.float32(top_p),
+        )
+        if self.paged:
+            tok, logits, self.pool = self._chunk_paged(
+                self.params, self.pool,
+                jnp.asarray(self._tables[slot]), *args,
+            )
+        else:
+            tok, logits, self.cache = self._chunk(
+                self.params, self.cache, args[0], args[1],
+                jnp.int32(slot), *args[2:],
+            )
+        return tok, logits
+
     def prefill_step(self, slot: int) -> int | None:
         """Run ONE prefill chunk for the staged request in ``slot``.
-        Returns None while chunks remain; the final chunk samples and
-        returns the first token, leaving the slot live for ``step()``."""
+        Returns None while chunks remain; the final chunk samples (in
+        the same executable) and returns the first token, leaving the
+        slot live for ``step()``."""
         pf = self._prefills[slot]
         if pf is None:
             raise ValueError(f"slot {slot} has no prefill in flight")
         ids, p = pf.ids, len(pf.ids)
         remaining = p - pf.done
+        dummy = (self._dummy_key, 0.0, 0, 1.0)  # interior chunks: unused
         if remaining > self.chunk_size:
             # full interior chunk: exactly chunk_size real tokens
             lo = pf.done
             chunk = ids[lo:lo + self.chunk_size]
-            _logits, self.cache = self._chunk(
-                self.params, self.cache,
-                jnp.asarray([chunk], jnp.int32),
-                jnp.ones((1, self.chunk_size), jnp.int32),
-                jnp.int32(slot), jnp.int32(lo),
-                jnp.int32(self.chunk_size - 1),
+            self._run_chunk(
+                slot, chunk, np.ones((1, self.chunk_size), np.int32),
+                lo, self.chunk_size - 1, *dummy,
             )
             pf.done += self.chunk_size
             return None
 
-        # final chunk, bucketed to a power of two. Prefer re-feeding the
-        # prompt's last `bucket` real tokens (recomputed K/V bits are
-        # identical, and the last row IS the last prompt token); a
-        # single-chunk prompt shorter than its bucket right-pads instead
-        # and passes the true last index.
+        # final chunk, bucketed to a power of two and right-padded: the
+        # chunk always starts AT the cursor (never re-feeds earlier
+        # positions — which is what makes shared prefix blocks read-only
+        # under paging), pads land past the prompt (causally unreachable,
+        # then overwritten by decode), and the true last-real index rides
+        # into the program as a traced scalar. One exception, DENSE
+        # only: when the padded bucket would poke past the cache view
+        # (max_len not a multiple of the bucket — dynamic_update_slice
+        # would CLAMP the write backwards over real rows), fall back to
+        # RE-FEEDING the prompt's last ``bucket`` tokens: recomputed
+        # fp K/V bits are identical to what those positions already
+        # hold (same tokens, same positions, same params), so the
+        # rewrite is a no-op and the write stays in range. The PAGED
+        # view is a chunk wider than any allocation precisely so this
+        # branch can never trigger there — a paged re-feed would write
+        # below the prefix-hit boundary, and in int8 mode those bits
+        # are NOT a no-op (shared-block corruption).
         bucket = _ceil_pow2(remaining)
-        if p >= bucket:
+        view = (
+            self.table_blocks * self.kv_block_size if self.paged
+            else self.max_len
+        )
+        if pf.done + bucket <= view:
+            lo = pf.done
+            chunk = ids[lo:] + [0] * (bucket - remaining)
+            valid = np.zeros((1, bucket), np.int32)
+            valid[0, :remaining] = 1
+            last = remaining - 1
+        else:  # overflow implies done >= chunk_size >= bucket, so lo >= 0
             lo = p - bucket
             chunk = ids[lo:]
             valid = np.ones((1, bucket), np.int32)
             last = bucket - 1
-        else:  # pf.done == 0 and the whole prompt is shorter than bucket
-            lo = 0
-            chunk = ids + [0] * (bucket - p)
-            valid = np.zeros((1, bucket), np.int32)
-            valid[0, :p] = 1
-            last = p - 1
-        logits, self.cache = self._chunk(
-            self.params, self.cache,
-            jnp.asarray([chunk], jnp.int32), jnp.asarray(valid),
-            jnp.int32(slot), jnp.int32(lo), jnp.int32(last),
-        )
-        pf.done = p
         req = pf.request
         temp = float(req.temperature)
         top_k = min(int(req.top_k), self.vocab_size)
@@ -263,10 +468,15 @@ class InferenceEngine:
         # the one-shot generate()'s exact key schedule, replayed per slot
         key = jax.random.key(int(req.seed))
         karr = jax.random.split(key)  # karr[0] = rest, karr[1] = k0
-        tok0 = int(self._sample(
-            logits, karr[1],
-            jnp.float32(temp), jnp.int32(top_k), jnp.float32(top_p),
-        ))
+        tok, logits = self._run_chunk(
+            slot, chunk, valid, lo, last,
+            np.asarray(jax.random.key_data(karr[1]), np.uint32),
+            temp, top_k, top_p,
+        )
+        tok0 = int(tok)
+        if self.capture_prefill_logits:
+            self.last_prefill_logits = np.asarray(logits)
+        pf.done = p
         n = int(req.max_new_tokens)
         self._keys[slot] = (
             np.asarray(jax.random.key_data(jax.random.split(karr[0], n - 1)),
@@ -290,16 +500,31 @@ class InferenceEngine:
         ):
             # explicit admission: every completed (non-opted-out)
             # prefill offers its whole-chunk prefix; only chunks not
-            # already cached are copied off the slot's rows
+            # already cached are registered
             cs = self.chunk_size
+            n_chunks = (p - 1) // cs
+            if self.paged:
+                # zero-copy: the cache takes a REFERENCE to the slot's
+                # own blocks for each new chunk (bumping their refcount)
+                # — the rows never move, and they outlive the slot
+                cpb = cs // self.kv_block_size
 
-            def extract(i: int):
-                k, v = self._extract(
-                    self.cache, jnp.int32(slot), jnp.int32(i * cs), cs
-                )
-                return k, v
+                def extract(i: int):
+                    blks = tuple(
+                        int(x) for x in
+                        self._tables[slot][i * cpb:(i + 1) * cpb]
+                    )
+                    self.block_pool.ref(blks)
+                    return blks
+            else:
 
-            self.prefix_cache.insert(ids, (p - 1) // cs, extract)
+                def extract(i: int):
+                    k, v = self._extract(
+                        self.cache, jnp.int32(slot), jnp.int32(i * cs), cs
+                    )
+                    return k, v
+
+            self.prefix_cache.insert(ids, n_chunks, extract)
         return tok0
 
     def prefill(self, slot: int, request) -> int:
@@ -313,9 +538,9 @@ class InferenceEngine:
                 return tok
 
     def step(self) -> np.ndarray:
-        """Advance every live slot one token (one compiled tick).
-        Returns the [B] sampled tokens; entries for inactive slots are
-        meaningless."""
+        """Advance every live slot one token (one compiled tick,
+        sampling fused in). Returns the [B] sampled tokens; entries for
+        inactive slots are meaningless."""
         b = self.num_slots
         keys_now = np.empty((b, 2), np.uint32)
         for s in range(b):
@@ -326,19 +551,31 @@ class InferenceEngine:
                 keys_now[s] = self._dummy_key
         if self._dev is None:
             self._dev = {
-                "key_valid": jnp.asarray(self._key_valid),
                 "temp": jnp.asarray(self._temp),
                 "topk": jnp.asarray(self._topk),
                 "topp": jnp.asarray(self._topp),
                 "active": jnp.asarray(self._active),
             }
-        nxt, self.cache = self._decode(
-            self.params, self.cache,
-            jnp.asarray(self._tokens), jnp.asarray(self._pos),
-            self._dev["key_valid"], jnp.asarray(keys_now),
-            self._dev["temp"], self._dev["topk"],
-            self._dev["topp"], self._dev["active"],
-        )
+            if self.paged:
+                self._dev["tables"] = jnp.asarray(self._tables)
+            else:
+                self._dev["key_valid"] = jnp.asarray(self._key_valid)
+        if self.paged:
+            nxt, self.pool = self._decode_paged(
+                self.params, self.pool, self._dev["tables"],
+                jnp.asarray(self._tokens), jnp.asarray(self._pos),
+                jnp.asarray(keys_now),
+                self._dev["temp"], self._dev["topk"],
+                self._dev["topp"], self._dev["active"],
+            )
+        else:
+            nxt, self.cache = self._decode(
+                self.params, self.cache,
+                jnp.asarray(self._tokens), jnp.asarray(self._pos),
+                self._dev["key_valid"], jnp.asarray(keys_now),
+                self._dev["temp"], self._dev["topk"],
+                self._dev["topp"], self._dev["active"],
+            )
         nxt = np.asarray(nxt)
         for s in range(b):
             if self._active[s]:
@@ -354,7 +591,21 @@ class InferenceEngine:
         self._pos[slot] = 0
         self._tokens[slot] = 0
         self._prefills[slot] = None
+        if self.paged:
+            blocks = self._slot_blocks[slot]
+            if blocks:
+                self.hist_blocks_per_request.observe(len(blocks))
+                self.block_pool.deref(blocks)
+            self._slot_blocks[slot] = []
+            self._tables[slot] = self.block_pool.num_blocks
         self._dev = None
+
+    def _evict_prefix_blocks(self, blocks) -> None:
+        """Prefix-cache LRU eviction hook (paged): drop the cache's
+        references; blocks still mapped into a live slot survive until
+        that slot releases them."""
+        self.block_pool.deref(blocks)
+        self.kv_block_evictions += len(blocks)
 
     # -- observability -------------------------------------------------------
 
@@ -363,20 +614,44 @@ class InferenceEngine:
         cache is disabled)."""
         return None if self.prefix_cache is None else self.prefix_cache.stats()
 
+    def kv_stats(self) -> dict | None:
+        """Block-pool gauges for /metrics and the stats JSONL (None in
+        dense mode). ``kv_bytes`` is the arena's true HBM footprint;
+        ``hist_blocks_per_request`` is the blocks-held distribution
+        observed at release."""
+        if not self.paged:
+            return None
+        ps = self.block_pool.stats()
+        return {
+            **ps,
+            "kv_dtype": self.kv_dtype or str(self.cfg.dtype),
+            "block_evictions": self.kv_block_evictions,
+            "kv_bytes": int(
+                self.block_pool.num_blocks * self.kv_block_size
+                * kv_bytes_per_token(self.cfg, self.kv_dtype)
+            ),
+            "hist_blocks_per_request": self.hist_blocks_per_request.snapshot(),
+        }
+
     def compile_counts(self) -> dict:
         """Compiled-executable counts per program — the bounded-compile
         contract is testable, not folklore: chunk programs are capped by
-        the power-of-two bucket set, decode/sample/copy by 1 each."""
+        the power-of-two bucket set, decode/copy by 1 each (sampling is
+        fused into chunk and decode, so there is no separate sample
+        program to count)."""
         def size(fn):
+            if fn is None:
+                return None
             try:
                 return fn._cache_size()
             except Exception:  # pragma: no cover - older/newer jit internals
                 return None
 
         return {
-            "prefill_chunk": size(self._chunk),
-            "decode": size(self._decode),
-            "sample": size(self._sample),
+            "prefill_chunk": size(
+                self._chunk_paged if self.paged else self._chunk
+            ),
+            "decode": size(self._decode_paged if self.paged else self._decode),
             "extract": size(self._extract),
             "insert": size(self._insert),
         }

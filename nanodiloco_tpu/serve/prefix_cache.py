@@ -38,7 +38,8 @@ class PrefixCache:
     opaque to this class (the engine stores ``(k, v)`` device arrays),
     so every policy decision is testable without a model."""
 
-    def __init__(self, capacity_tokens: int, chunk_tokens: int) -> None:
+    def __init__(self, capacity_tokens: int, chunk_tokens: int,
+                 on_evict=None) -> None:
         if chunk_tokens < 1:
             raise ValueError(f"chunk_tokens must be >= 1; got {chunk_tokens}")
         if capacity_tokens < chunk_tokens:
@@ -48,6 +49,10 @@ class PrefixCache:
             )
         self.chunk_tokens = int(chunk_tokens)
         self.capacity_tokens = int(capacity_tokens)
+        # eviction hook, called with the evicted block value: the paged
+        # engine derefs the chunk's KV blocks here (dense mode needs
+        # nothing — dropping the device arrays frees them)
+        self.on_evict = on_evict
         # prefix token tuple (whole chunks) -> block; move_to_end = LRU
         self._blocks: collections.OrderedDict[tuple, object] = (
             collections.OrderedDict()
@@ -62,11 +67,14 @@ class PrefixCache:
     def cached_tokens(self) -> int:
         return len(self._blocks) * self.chunk_tokens
 
-    def match(self, prompt) -> list:
+    def match(self, prompt, record: bool = True) -> list:
         """Longest chain of cached whole-chunk prefixes of ``prompt``
         (capped so at least one prompt token is left to prefill).
         Returns the blocks in chunk order ([] = miss); bumps LRU on
-        every chunk of the hit path."""
+        every chunk of the hit path. ``record=False`` is a pure PEEK —
+        no counters, no LRU movement — for admission paths that must
+        size an allocation BEFORE committing to the hit (a rolled-back
+        admission must not look like cache traffic)."""
         cs = self.chunk_tokens
         prompt = tuple(prompt)
         max_chunks = (len(prompt) - 1) // cs
@@ -76,8 +84,11 @@ class PrefixCache:
             block = self._blocks.get(key)
             if block is None:
                 break
-            self._blocks.move_to_end(key)
+            if record:
+                self._blocks.move_to_end(key)
             blocks.append(block)
+        if not record:
+            return blocks
         if blocks:
             self.hits += 1
             self.hit_tokens += len(blocks) * cs
@@ -112,9 +123,25 @@ class PrefixCache:
                 # (lookup walks from chunk 0 and stops at the gap) until
                 # LRU drains them too — bounded staleness, zero extra
                 # bookkeeping, and never a wrong hit.
-                self._blocks.popitem(last=False)
+                _key, evicted = self._blocks.popitem(last=False)
                 self.evictions += 1
+                if self.on_evict is not None:
+                    self.on_evict(evicted)
         return inserted
+
+    def evict_lru(self) -> bool:
+        """Evict exactly the LRU entry (False when empty) — the paged
+        engine's reclaim-under-pressure path: cached blocks are a
+        best-effort optimization, and admission starving behind them
+        would be a livelock (the only other eviction trigger is
+        ``insert``, which needs a prefill to COMPLETE first)."""
+        if not self._blocks:
+            return False
+        _key, evicted = self._blocks.popitem(last=False)
+        self.evictions += 1
+        if self.on_evict is not None:
+            self.on_evict(evicted)
+        return True
 
     def stats(self) -> dict:
         return {

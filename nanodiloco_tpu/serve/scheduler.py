@@ -38,7 +38,10 @@ Tick anatomy (one call, strictly ordered, deterministic):
 2. expire/cancel requests mid-prefill — a deadline can pass between
    chunks; the slot is released with the usual empty-result expiry;
 3. admit from the queue into free slots in SLO order (above) — staging
-   only, no model compute yet;
+   only, no model compute yet; a paged backend may refuse for lack of
+   free KV BLOCKS (``BlocksExhausted``), which leaves the request
+   queued head-of-line with nothing allocated — admission gates on
+   blocks as well as slots, and the stall is counted per cause;
 4. run ONE prefill chunk for the neediest mid-prefill slot; a final
    chunk yields the request's first token (it may also finish it
    outright: stop token or ``max_new_tokens == 1``);
@@ -64,11 +67,14 @@ from typing import Callable
 
 from nanodiloco_tpu.obs import flightrec
 from nanodiloco_tpu.obs.telemetry import Histogram, nearest_rank_percentile
+from nanodiloco_tpu.serve.block_pool import BlocksExhausted
 
 
 class QueueFull(RuntimeError):
     """Raised by ``submit`` when the admission queue is at capacity —
-    the server's 429 backpressure signal."""
+    the server's 429 backpressure signal. The message names WHAT the
+    queue is stuck behind (no free slot vs no free KV blocks) so a 429
+    distinguishes slot-bound from HBM-bound saturation."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -218,6 +224,13 @@ class Scheduler:
         self._expired = 0
         self._cancelled = 0
         self._errors = 0
+        # admission-stall accounting: ticks on which the next queued
+        # request could not be admitted, split by WHY — every slot
+        # occupied ("no_slot") vs the backend's KV block pool unable to
+        # hold the request right now ("no_blocks"). The split is what
+        # tells an operator whether to add slots or HBM.
+        self._blocked_no_slot = 0
+        self._blocked_no_blocks = 0
         self._tokens_out = 0
         self._decode_tokens = 0
         self._decode_s = 0.0
@@ -241,7 +254,8 @@ class Scheduler:
             if len(self._queue) >= self.max_queue:
                 self._rejected += 1
                 raise QueueFull(
-                    f"admission queue is full ({self.max_queue} waiting)"
+                    f"admission queue is full ({self.max_queue} waiting"
+                    f"{self._saturation_detail()})"
                 )
             ticket = Ticket(self._next_rid)
             self._next_rid += 1
@@ -308,18 +322,25 @@ class Scheduler:
         # 3. admit into free slots in SLO order (priority class, EDF
         # within it, starvation bound on top) — staging only; the model
         # work happens one chunk per tick in step 4. A cancelled or
-        # invalid pop retries the SAME free slot with the next queued
+        # invalid PEEK retries the SAME free slot with the next queued
         # request: a dud at the queue head must not cost a viable
-        # request its admission tick.
+        # request its admission tick. Admission gates on KV BLOCKS as
+        # well as slots: a backend that cannot currently hold the
+        # request's cache raises ``BlocksExhausted`` having allocated
+        # NOTHING — the request is left queued (head-of-line, so SLO
+        # order is preserved; blocks free as live requests retire) and
+        # the stall is counted under its own reason.
         slot = 0
+        blocked_on_blocks = False
         while slot < len(self._slots):
             if self._slots[slot] is not None:
                 slot += 1
                 continue
-            q = self._pick_queued()
+            q = self._peek_queued()
             if q is None:
                 break
-            if q.ticket.cancelled:  # cancelled between sweep and pop
+            if q.ticket.cancelled:  # cancelled between sweep and peek
+                self._dequeue(q)
                 self._cancelled += 1
                 now2 = self._clock()
                 self._span("queued", q.submitted_at, now2,
@@ -332,11 +353,19 @@ class Scheduler:
             t_admit = self._clock()
             try:
                 chunks = int(self.backend.start_prefill(slot, q.request))
+            except BlocksExhausted:
+                # nothing was allocated (the pool's alloc is
+                # all-or-nothing) and the request stays exactly where
+                # it was in the queue — retried next tick
+                blocked_on_blocks = True
+                self._blocked_no_blocks += 1
+                break
             except ValueError as e:
                 # a bad REQUEST must not kill the loop; anything else
                 # (OOM, a donated-then-deleted cache) propagates and
                 # kills the tick loop — a broken engine must flip
                 # /healthz to 503, not limp along half-alive
+                self._dequeue(q)
                 self._errors += 1
                 self._span("queued", q.submitted_at, t_admit, rid_str,
                            outcome="error")
@@ -344,6 +373,7 @@ class Scheduler:
                              q.submitted_at, None, None, self._clock(),
                              error=str(e))
                 continue
+            self._dequeue(q)
             wait = t_admit - q.submitted_at
             self.hist_queue_wait.observe(wait)
             self._priority_hist(q.request.priority).observe(wait)
@@ -354,6 +384,9 @@ class Scheduler:
                 t_admit, chunks,
             )
             slot += 1
+        if (not blocked_on_blocks and self.queue_depth() > 0
+                and all(s is not None for s in self._slots)):
+            self._blocked_no_slot += 1
 
         # 4. ONE prefill chunk, to the fewest-chunks-remaining slot
         # (shortest-remaining-first bounds short-request TTFT while a
@@ -439,13 +472,15 @@ class Scheduler:
                     self._retire(run, reason, t1)
         return sum(1 for s in self._slots if s is not None)
 
-    def _pick_queued(self) -> _Queued | None:
-        """Pop the next request to admit. Starvation bound first: when
-        the OLDEST queued request (FIFO head) has waited past
-        ``starvation_s``, it goes next no matter its class. Otherwise
-        lowest priority number wins; within a class, earliest deadline
-        (EDF; deadline-less requests last); submit order breaks ties
-        (rids are issued in submit order)."""
+    def _peek_queued(self) -> _Queued | None:
+        """The next request to admit, WITHOUT removing it (removal is
+        ``_dequeue``, called only once admission commits — a
+        block-starved request must stay queued in place). Starvation
+        bound first: when the OLDEST queued request (FIFO head) has
+        waited past ``starvation_s``, it goes next no matter its class.
+        Otherwise lowest priority number wins; within a class, earliest
+        deadline (EDF; deadline-less requests last); submit order breaks
+        ties (rids are issued in submit order)."""
         now = self._clock()
         with self._lock:
             if not self._queue:
@@ -454,14 +489,39 @@ class Scheduler:
                 self.starvation_s is not None
                 and now - self._queue[0].submitted_at >= self.starvation_s
             ):
-                return self._queue.popleft()
-            best = min(self._queue, key=lambda q: (
+                return self._queue[0]
+            return min(self._queue, key=lambda q: (
                 q.request.priority,
                 q.deadline_at if q.deadline_at is not None else float("inf"),
                 q.ticket.rid,
             ))
-            self._queue.remove(best)
-            return best
+
+    def _dequeue(self, q: _Queued) -> None:
+        """Commit a peeked request's removal (only the tick thread ever
+        removes, so the element is still present)."""
+        with self._lock:
+            try:
+                self._queue.remove(q)
+            except ValueError:  # pragma: no cover - single remover
+                pass
+
+    def _saturation_detail(self) -> str:
+        """Why the system is not draining, for the 429 message: KV
+        block availability when the backend pages its cache ('' for
+        dense backends) — a client/operator reading the error learns
+        whether the ceiling is slots or HBM."""
+        kv_stats = getattr(self.backend, "kv_stats", None)
+        if kv_stats is None:
+            return ""
+        try:
+            kv = kv_stats()
+        except Exception:  # pragma: no cover - defensive: message only
+            return ""
+        if not kv:
+            return ""
+        return (
+            f"; KV blocks {kv['blocks_free']}/{kv['num_blocks']} free"
+        )
 
     def _priority_hist(self, priority: int) -> Histogram:
         h = self.hist_queue_wait_by_priority.get(int(priority))
@@ -590,6 +650,11 @@ class Scheduler:
             "expired": self._expired,
             "cancelled": self._cancelled,
             "errors": self._errors,
+            # admission stalls split by cause: slots exhausted vs the
+            # paged backend's KV block pool exhausted — the 429/backlog
+            # diagnosis gauge pair
+            "admission_blocked_no_slot": self._blocked_no_slot,
+            "admission_blocked_no_blocks": self._blocked_no_blocks,
             "tokens_out": self._tokens_out,
             "decode_s": self._decode_s,
             "decode_tokens_per_sec": (
@@ -613,4 +678,9 @@ class Scheduler:
             ps = prefix_stats()
             if ps is not None:
                 out["prefix_cache"] = ps
+        kv_stats = getattr(self.backend, "kv_stats", None)
+        if kv_stats is not None:
+            kv = kv_stats()
+            if kv is not None:
+                out["kv_pool"] = kv
         return out

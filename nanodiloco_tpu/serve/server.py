@@ -390,6 +390,46 @@ class ServeServer:
             "prefill chunks run (one per tick interleave slot)",
             [(None, s.get("prefill_chunks_total", 0))],
         ))
+        if s.get("admission_blocked_no_slot") is not None:
+            families.append((
+                "nanodiloco_serve_admission_blocked", "counter",
+                "ticks the next queued request could not be admitted, "
+                "by cause (no_slot = slots exhausted, no_blocks = KV "
+                "block pool exhausted)",
+                [({"reason": "no_slot"}, s["admission_blocked_no_slot"]),
+                 ({"reason": "no_blocks"},
+                  s["admission_blocked_no_blocks"])],
+            ))
+        # paged KV block pool: the gauges that turn "how many more
+        # requests fit this chip" from folklore into a scrape
+        kv = s.get("kv_pool")
+        if kv is not None:
+            families.append((
+                "nanodiloco_kv_blocks_free", "gauge",
+                "KV cache blocks available for admission",
+                [(None, kv["blocks_free"])],
+            ))
+            families.append((
+                "nanodiloco_kv_blocks_used", "gauge",
+                "KV cache blocks held by live slots and cached prefixes",
+                [(None, kv["blocks_used"])],
+            ))
+            families.append((
+                "nanodiloco_kv_block_evictions", "counter",
+                "prefix-cache KV blocks dereferenced by LRU eviction",
+                [(None, kv["block_evictions"])],
+            ))
+            families.append((
+                "nanodiloco_kv_block_size_tokens", "gauge",
+                "token rows per KV block", [(None, kv["block_size"])],
+            ))
+            hist = kv.get("hist_blocks_per_request")
+            if hist is not None:
+                families.append((
+                    "nanodiloco_kv_blocks_per_request", "histogram",
+                    "KV blocks a request held over its life (observed "
+                    "at release)", hist,
+                ))
         # shared-prefix KV cache: the counters that tell an operator
         # whether the system-prompt traffic is actually being reused
         pc = s.get("prefix_cache")

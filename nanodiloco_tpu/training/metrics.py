@@ -325,6 +325,20 @@ def summarize_run(path: str) -> dict[str, Any]:
                 out["prefix_cache_hit_rate"] = round(
                     (pc.get("hits") or 0) / looked, 4
                 )
+        # paged KV block pool (kv_block_size > 0 serves): the same keys
+        # the /metrics gauges export — absent from older JSONLs, whose
+        # summaries are unchanged
+        kv = last.get("kv_pool")
+        if isinstance(kv, dict):
+            out["kv_blocks_free"] = kv.get("blocks_free")
+            out["kv_blocks_used"] = kv.get("blocks_used")
+            out["kv_block_evictions"] = kv.get("block_evictions")
+            if kv.get("block_size") is not None:
+                out["kv_block_size"] = kv.get("block_size")
+        for key in ("admission_blocked_no_slot",
+                    "admission_blocked_no_blocks"):
+            if last.get(key) is not None:
+                out[f"serve_{key}"] = last[key]
     # goodput ledger (obs/goodput): stitch the per-lifetime snapshots —
     # a supervised crash-loopy run appends several lifetimes to ONE
     # JSONL, and the honest number is the merged fraction including the
@@ -390,6 +404,12 @@ _COMPARE_METRICS = [
     ("short_ttft_p95_s", True),
     ("decode_tokens_per_sec", False),
     ("client_tokens_per_sec", False),
+    # paged-KV capacity keys (serve_bench --workload capacity): the two
+    # directions of the same contract — a candidate must not spend more
+    # HBM per resident token NOR fit fewer concurrent requests at the
+    # fixed budget. Gated only when both summaries carry them.
+    ("kv_hbm_bytes_per_token", True),
+    ("max_concurrent_slots", False),
     # sync-vs-async outer-sync shares from the overlap bench differencing
     # (scripts/streaming_overlap.py / bench.py BENCH_ASYNC): the fraction
     # of a warm round the outer boundary costs in each mode. Shares are

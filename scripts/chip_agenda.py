@@ -38,6 +38,12 @@ ceremony:
      must stay bounded while the long prefill is in flight, the shared
      prefix must hit the cache, and the chunk/prefix/priority gauges
      are scraped — the PR-6 serving tier proven on the chip.
+  8. a paged-KV drill: the `serve` CLI on a TINY block pool
+     (oversubscribed vs the dense footprint) — concurrent + sequential
+     traffic recycles blocks through the free list, a shared prefix
+     takes copy-on-write hits, the block-pool gauges scrape over the
+     wire, and an fp-paged stream is replayed through solo
+     ``generate()`` on the same backend for bit-parity.
 
 Usage (each phase also runs alone):
     python scripts/chip_agenda.py               # everything
@@ -1079,6 +1085,224 @@ def phase_serve_interference() -> None:
                 proc.kill()
 
 
+def phase_kv_paging() -> None:
+    """Paged-KV serving drill on this backend: launch the `serve` CLI
+    with a TINY block pool (oversubscribed vs the dense footprint),
+    drive enough concurrent + sequential requests to exercise block
+    recycling and one copy-on-write shared-prefix hit, scrape the
+    block-pool gauges off /metrics over the wire, then — after the
+    server releases the chip — replay one fp-paged stream through solo
+    ``generate()`` on the SAME backend and assert bit-parity. The CPU
+    tests pin all of this too; this phase proves the block-table
+    programs compile and hold parity on the real accelerator."""
+    import socket
+    import tempfile
+    import threading
+
+    from nanodiloco_tpu.obs.telemetry import parse_metrics_text
+    from nanodiloco_tpu.serve.client import http_get, http_post_json
+
+    tmp = tempfile.mkdtemp(prefix="nanodiloco-kv-paging-")
+    ckpt = os.path.join(tmp, "ckpt")
+    model_cfg = os.path.join(tmp, "model.json")
+    with open(model_cfg, "w") as f:
+        json.dump({
+            "vocab_size": 2048, "hidden_size": 128, "intermediate_size": 256,
+            "num_attention_heads": 4, "num_hidden_layers": 2,
+            "max_position_embeddings": 256,
+        }, f)
+    budget = float(os.environ.get("NANODILOCO_AGENDA_TIMEOUT_KV_PAGING",
+                                  "900"))
+    train = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu",
+         "--total-steps", "4", "--inner-steps", "2",
+         "--batch-size", "8", "--per-device-batch-size", "4",
+         "--seq-length", "256", "--warmup-steps", "2",
+         "--llama-config-file", model_cfg, "--no-measure-comm",
+         "--no-cost-analysis", "--quiet",
+         "--checkpoint-dir", ckpt, "--log-dir", tmp,
+         "--run-name", "kv-paging-probe"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=budget * 0.4,
+    )
+    if train.returncode != 0:
+        record({"phase": "kv_paging",
+                "error": (train.stderr or train.stdout)[-400:]})
+        raise SystemExit(1)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    # 14 blocks x 16 tokens = 224 cached tokens, vs the dense footprint
+    # of 4 slots x 96 = 384: the pool is the binding resource on purpose
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nanodiloco_tpu", "serve",
+         "--checkpoint-dir", ckpt, "--port", str(port),
+         "--host", "127.0.0.1", "--slots", "4", "--max-len", "96",
+         "--max-new-tokens-cap", "64", "--chunk-size", "16",
+         "--kv-block-size", "16", "--kv-pool-blocks", "14",
+         "--prefix-cache-tokens", "64"],
+        cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+    def get(path):
+        return http_get(f"http://127.0.0.1:{port}{path}", timeout=5)
+
+    def post(doc, timeout=300):
+        return http_post_json(
+            f"http://127.0.0.1:{port}/v1/generate", doc, timeout=timeout
+        )
+
+    parity_doc = {
+        # token ids stay under 256: the trained checkpoint's vocab
+        # snaps to the tokenizer's size
+        "token_ids": [(i * 13 + 3) % 256 for i in range(18)],
+        "max_new_tokens": 12, "temperature": 0.8, "top_k": 20,
+        "seed": 7, "stop": False, "prefix_cache": False,
+    }
+    try:
+        deadline = time.time() + budget * 0.3
+        up = False
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                up = get("/healthz")[0] == 200
+            except OSError:
+                up = False
+            if up:
+                break
+            time.sleep(0.3)
+        if not up:
+            record({"phase": "kv_paging",
+                    "error": "server never answered /healthz"})
+            raise SystemExit(1)
+        # warm both chunk buckets + decode outside the measured window
+        for warm in (
+            {"token_ids": list(range(2, 20)), "max_new_tokens": 2,
+             "stop": False, "prefix_cache": False},
+        ):
+            code, out = post(warm)
+            if code != 200:
+                record({"phase": "kv_paging",
+                        "error": f"warmup failed {code}: {out.get('error')}"})
+                raise SystemExit(1)
+        # prime the shared prefix (one whole 16-token chunk), then a
+        # concurrent burst that must take copy-on-write hits on it
+        shared = [int(t) for t in range(100, 116)]
+        code, out = post({"token_ids": shared + [3, 4],
+                          "max_new_tokens": 2, "stop": False, "seed": 99})
+        if code != 200:
+            record({"phase": "kv_paging",
+                    "error": f"prefix prime failed {code}: {out.get('error')}"})
+            raise SystemExit(1)
+        results: dict[str, tuple] = {}
+
+        def fire(name, doc):
+            results[name] = post(doc)
+
+        burst = {
+            f"cow{i}": {"token_ids": shared + [7 + i, 9 + i],
+                        "max_new_tokens": 8, "stop": False, "seed": 10 + i}
+            for i in range(3)
+        }
+        threads = [threading.Thread(target=fire, args=(n, d))
+                   for n, d in burst.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=budget * 0.2)
+        # two sequential waves through the tiny pool: every wave's
+        # blocks must be the previous wave's, recycled
+        for w in range(4):
+            fire(f"wave{w}", {
+                "token_ids": [(w * 17 + i * 5 + 1) % 256 for i in range(20)],
+                "max_new_tokens": 8, "stop": False,
+                "prefix_cache": False, "seed": 200 + w,
+            })
+        fire("parity", parity_doc)
+        bad = {n: r for n, r in results.items() if r[0] != 200}
+        if bad or len(results) < 8:
+            record({"phase": "kv_paging",
+                    "error": f"requests failed: {bad or 'client hung'}"})
+            raise SystemExit(1)
+        m = parse_metrics_text(get("/metrics")[1])
+        hits = m.get(
+            'nanodiloco_serve_prefix_cache_lookups_total{result="hit"}', 0
+        )
+        free = m.get("nanodiloco_kv_blocks_free")
+        used = m.get("nanodiloco_kv_blocks_used")
+        held = m.get("nanodiloco_kv_blocks_per_request_count", 0)
+        # the contract: with every request drained, the ONLY blocks
+        # still held are the primed shared-prefix chunk's (one 16-token
+        # chunk = 1 block) — anything more is a leak on some release
+        # path; blocks were recycled (more requests completed than the
+        # pool could ever hold at once); the shared prefix took CoW hits
+        if (free is None or used is None or (free, used) != (13, 1)
+                or held < 8 or hits < 2):
+            record({"phase": "kv_paging",
+                    "error": "block-pool gauges missing or inconsistent",
+                    "blocks_free": free, "blocks_used": used,
+                    "blocks_held_count": held, "prefix_hits": hits})
+            raise SystemExit(1)
+        scraped = {
+            k: m[k] for k in (
+                "nanodiloco_kv_blocks_free",
+                "nanodiloco_kv_blocks_used",
+                "nanodiloco_kv_block_evictions_total",
+                "nanodiloco_kv_blocks_per_request_count",
+                "nanodiloco_kv_block_size_tokens",
+                'nanodiloco_serve_prefix_cache_lookups_total{result="hit"}',
+                'nanodiloco_serve_admission_blocked_total{reason="no_blocks"}',
+                'nanodiloco_serve_admission_blocked_total{reason="no_slot"}',
+            ) if k in m
+        }
+        served_stream = results["parity"][1]["token_ids"]
+    finally:
+        import signal as _signal
+
+        if proc.poll() is None:
+            proc.send_signal(_signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    # bit-parity leg: the server has released the chip; replay the same
+    # request through solo generate() on the same backend, same seed
+    probe = subprocess.run(
+        [sys.executable, "-c", (
+            "import json, sys\n"
+            "import jax, jax.numpy as jnp, numpy as np\n"
+            "from nanodiloco_tpu.cli import _load_checkpoint_snapshot\n"
+            "from nanodiloco_tpu.models import generate\n"
+            "doc = json.loads(sys.argv[1])\n"
+            "cfg, _sc, params = _load_checkpoint_snapshot(sys.argv[2], None)\n"
+            "out = generate(params, jnp.asarray([doc['token_ids']],"
+            " jnp.int32), cfg, doc['max_new_tokens'],"
+            " temperature=doc['temperature'], top_k=doc['top_k'],"
+            " key=jax.random.key(doc['seed']))\n"
+            "print(json.dumps(np.asarray(out[0]).tolist()))\n"
+        ), json.dumps(parity_doc), ckpt],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=budget * 0.3,
+    )
+    if probe.returncode != 0:
+        record({"phase": "kv_paging",
+                "error": f"solo generate probe failed: {probe.stdout[-200:]}"
+                         f"{probe.stderr[-200:]}"})
+        raise SystemExit(1)
+    solo = json.loads(probe.stdout.strip().splitlines()[-1])
+    if served_stream != solo:
+        record({"phase": "kv_paging",
+                "error": "paged-fp stream diverged from solo generate()",
+                "served": served_stream, "solo": solo})
+        raise SystemExit(1)
+    record({
+        "phase": "kv_paging",
+        "paged_fp_bit_parity": True,
+        "parity_tokens": len(served_stream),
+        "scraped": scraped,
+    })
+
+
 PHASES = {
     "bench": phase_bench,
     "sweep": phase_sweep,
@@ -1091,6 +1315,7 @@ PHASES = {
     "goodput": phase_goodput,
     "serve": phase_serve,
     "serve_interference": phase_serve_interference,
+    "kv_paging": phase_kv_paging,
 }
 
 
@@ -1134,6 +1359,7 @@ PHASE_TIMEOUT_S = {
     "goodput": 1200,
     "serve": 900,
     "serve_interference": 900,
+    "kv_paging": 900,
 }
 
 

@@ -10,6 +10,18 @@ line on stdout.
 Workloads:
 - ``uniform`` (default): every client cycles through ``--prompt-lens``
   with unique random prompts — the PR-4 throughput shape.
+- ``capacity``: the paged-KV economics sweep. At a FIXED KV HBM budget
+  (``--kv-hbm-budget-mb``) it sizes three engines — dense per-slot
+  rows, paged-fp, and paged-int8 — admits identical requests
+  (``--capacity-prompt-len`` + ``--max-new-tokens`` tokens) until
+  admission refuses, then measures aggregate decode tok/s with every
+  admitted slot live. The admission count is MEASURED (the engine
+  really holds that many concurrent requests in that much cache), and
+  ``kv_hbm_bytes_per_token`` = allocated KV bytes / resident real
+  tokens at capacity. Headline keys ``max_concurrent_slots`` /
+  ``kv_hbm_bytes_per_token`` are the paged-int8 numbers and gate in
+  ``report compare`` (both directions: slots must not drop, bytes per
+  token must not grow).
 - ``mixed``: the interference + shared-prefix scenario the chunked-
   prefill/prefix-cache engine exists for. ``--long-clients`` clients
   stream ``--long-prompt-len``-token prompts (unique content, prefix
@@ -62,11 +74,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "random-init tiny model (throughput-shaped, "
                         "content-free)")
     p.add_argument("--step", type=int, default=None)
-    p.add_argument("--workload", choices=("uniform", "mixed"),
+    p.add_argument("--workload", choices=("uniform", "mixed", "capacity"),
                    default="uniform",
                    help="uniform: every client cycles --prompt-lens; "
                         "mixed: long-prompt interference + shared-prefix "
-                        "short traffic (see module docstring)")
+                        "short traffic; capacity: fixed-HBM-budget sweep "
+                        "over dense/paged-fp/paged-int8 KV (see module "
+                        "docstring)")
     p.add_argument("--slots", type=int, default=4)
     p.add_argument("--max-len", type=int, default=256)
     p.add_argument("--max-queue", type=int, default=256)
@@ -104,6 +118,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--top-k", type=int, default=20)
     p.add_argument("--seed", type=int, default=0)
+    # paged-KV engine knobs (any workload) + the capacity sweep's shape
+    p.add_argument("--kv-block-size", type=int, default=0,
+                   help="page the KV cache into blocks of this many "
+                        "token rows (0 = dense per-slot rows; the "
+                        "capacity workload ignores this and uses "
+                        "--capacity-block-size for its paged modes)")
+    p.add_argument("--kv-dtype", choices=("model", "int8"), default="model",
+                   help="KV storage dtype (int8 requires paging)")
+    p.add_argument("--kv-pool-blocks", type=int, default=None,
+                   help="paged pool size in blocks (default: the dense "
+                        "footprint)")
+    p.add_argument("--kv-hbm-budget-mb", type=float, default=2.0,
+                   help="[capacity] fixed KV HBM budget each mode must "
+                        "live inside")
+    p.add_argument("--capacity-block-size", type=int, default=16,
+                   help="[capacity] block size for the paged modes")
+    p.add_argument("--capacity-prompt-len", type=int, default=64,
+                   help="[capacity] prompt length of every admitted "
+                        "request (completion length is "
+                        "--max-new-tokens)")
+    p.add_argument("--capacity-decode-ticks", type=int, default=12,
+                   help="[capacity] timed decode ticks per mode (after "
+                        "one warmup tick)")
     # tiny-model shape knobs (ignored with --checkpoint-dir)
     p.add_argument("--hidden", type=int, default=128)
     p.add_argument("--layers", type=int, default=4)
@@ -118,6 +155,151 @@ def _pct(sorted_vals: list[float], p: float) -> float | None:
     from nanodiloco_tpu.obs.telemetry import nearest_rank_percentile
 
     return nearest_rank_percentile(sorted_vals, p)
+
+
+def _capacity_mode(args, cfg, params, mode: str, budget_bytes: int) -> dict:
+    """Size ONE engine variant to the fixed KV HBM budget, admit
+    identical requests until admission refuses (slots exhausted for
+    dense, blocks exhausted for paged — both MEASURED, not computed),
+    then time decode ticks with every admitted slot live."""
+    from nanodiloco_tpu.models.generate import kv_bytes_per_token
+    from nanodiloco_tpu.serve import (
+        BlocksExhausted,
+        GenRequest,
+        InferenceEngine,
+    )
+
+    prompt_len = int(args.capacity_prompt_len)
+    new_tokens = int(args.max_new_tokens)
+    req_tokens = prompt_len + new_tokens
+    max_len = min(args.max_len, cfg.max_position_embeddings)
+    if req_tokens > max_len:
+        raise SystemExit(
+            f"--capacity-prompt-len {prompt_len} + --max-new-tokens "
+            f"{new_tokens} exceeds max_len {max_len}"
+        )
+    bs = int(args.capacity_block_size)
+    if mode == "dense":
+        per_slot = max_len * kv_bytes_per_token(cfg)
+        slots = max(1, int(budget_bytes // per_slot))
+        eng = InferenceEngine(
+            params, cfg, num_slots=slots, max_len=max_len,
+            chunk_size=args.chunk_size,
+        )
+        kv_bytes = int(eng.cache["k"].nbytes + eng.cache["v"].nbytes)
+    else:
+        kv_dtype = "int8" if mode == "paged-int8" else "model"
+        tok_bytes = kv_bytes_per_token(
+            cfg, None if kv_dtype == "model" else kv_dtype
+        )
+        nb = max(1, int(budget_bytes // (bs * tok_bytes)))
+        blocks_per_req = -(-req_tokens // bs)
+        # one MORE slot than the pool can hold, so the binding limit is
+        # provably blocks, not the slot count
+        slots = max(1, min(nb // blocks_per_req + 1, 512))
+        eng = InferenceEngine(
+            params, cfg, num_slots=slots, max_len=max_len,
+            chunk_size=args.chunk_size, kv_block_size=bs,
+            kv_dtype=kv_dtype, kv_pool_blocks=nb,
+        )
+        kv_bytes = int(eng.kv_stats()["kv_bytes"])
+    rng = __import__("random").Random(args.seed)
+    admitted = 0
+    for slot in range(eng.num_slots):
+        prompt = tuple(rng.randrange(cfg.vocab_size)
+                       for _ in range(prompt_len))
+        req = GenRequest(prompt=prompt, max_new_tokens=new_tokens,
+                         temperature=float(args.temperature),
+                         top_k=int(args.top_k), seed=slot)
+        try:
+            eng.prefill(slot, req)
+        except (BlocksExhausted, ValueError):
+            break
+        admitted += 1
+    slot_bound = mode != "dense" and admitted == eng.num_slots
+    if slot_bound:
+        # the paged number must be BLOCK-bound to mean anything: hitting
+        # the engine's slot count (the 512 safety cap, or a rounding
+        # corner) silently understates capacity — say so loudly
+        print(
+            f"# WARNING: {mode} admitted == engine slots ({admitted}); "
+            "the measurement is slot-bound, not block-bound — raise the "
+            "slot cap or shrink --kv-hbm-budget-mb",
+            file=sys.stderr, flush=True,
+        )
+    eng.step()  # warmup: compile the decode tick outside the window
+    # stay inside each request's exact block allocation: after the
+    # warmup tick, only max_new - 2 more decode steps write at
+    # positions the admission budget covers — timing past that would
+    # measure attention over sentinel-clamped garbage rows, not the
+    # steady state the record claims
+    avail = max(1, int(args.max_new_tokens) - 2)
+    ticks = min(max(1, int(args.capacity_decode_ticks)), avail)
+    if ticks < int(args.capacity_decode_ticks):
+        print(
+            f"# note: decode window clamped to {ticks} ticks to stay "
+            "inside the per-request KV allocation (raise "
+            "--max-new-tokens for a longer window)",
+            file=sys.stderr, flush=True,
+        )
+    t0 = time.monotonic()
+    for _ in range(ticks):
+        eng.step()
+    dt = time.monotonic() - t0
+    return {
+        "mode": mode,
+        "max_concurrent_slots": admitted,
+        **({"slot_bound": True} if slot_bound else {}),
+        "engine_slots": eng.num_slots,
+        "kv_bytes": kv_bytes,
+        "kv_hbm_bytes_per_token": (
+            round(kv_bytes / (admitted * req_tokens), 1) if admitted else None
+        ),
+        "decode_tokens_per_sec": round(admitted * ticks / dt, 1) if dt else None,
+        **({"kv_pool_blocks": eng.block_pool.num_blocks,
+            "kv_block_size": eng.kv_block_size} if eng.paged else {}),
+    }
+
+
+def run_capacity(args, cfg, params, jax) -> None:
+    """The fixed-HBM capacity sweep: dense vs paged-fp vs paged-int8 at
+    one budget, one ``BENCH_SERVE`` record. Headline gated keys are the
+    paged-int8 numbers; every mode's breakdown rides under
+    ``capacity_modes``."""
+    budget_bytes = int(args.kv_hbm_budget_mb * 2**20)
+    modes = {}
+    for mode in ("dense", "paged-fp", "paged-int8"):
+        modes[mode] = _capacity_mode(args, cfg, params, mode, budget_bytes)
+        print(f"# {mode}: {modes[mode]}", file=sys.stderr, flush=True)
+    int8 = modes["paged-int8"]
+    dense = modes["dense"]
+    rec = {
+        "metric": "BENCH_SERVE",
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "model": f"random-init llama (hidden {cfg.hidden_size} x "
+                 f"{cfg.num_hidden_layers}L, vocab {cfg.vocab_size})",
+        "workload": "capacity",
+        "kv_hbm_budget_mb": args.kv_hbm_budget_mb,
+        "capacity_prompt_len": args.capacity_prompt_len,
+        "max_new_tokens": args.max_new_tokens,
+        "capacity_block_size": args.capacity_block_size,
+        "capacity_modes": modes,
+        # the gated contract: paged-int8 at the fixed budget
+        "max_concurrent_slots": int8["max_concurrent_slots"],
+        "kv_hbm_bytes_per_token": int8["kv_hbm_bytes_per_token"],
+        "capacity_ratio_int8_vs_dense": (
+            round(int8["max_concurrent_slots"]
+                  / dense["max_concurrent_slots"], 2)
+            if dense["max_concurrent_slots"] else None
+        ),
+        "capacity_ratio_fp_vs_dense": (
+            round(modes["paged-fp"]["max_concurrent_slots"]
+                  / dense["max_concurrent_slots"], 2)
+            if dense["max_concurrent_slots"] else None
+        ),
+    }
+    print(json.dumps(rec), flush=True)
 
 
 def main() -> None:
@@ -148,11 +330,18 @@ def main() -> None:
         )
         params = init_params(jax.random.key(args.seed), cfg)
 
+    if args.workload == "capacity":
+        run_capacity(args, cfg, params, jax)
+        return
+
     engine = InferenceEngine(
         params, cfg, num_slots=args.slots,
         max_len=min(args.max_len, cfg.max_position_embeddings),
         chunk_size=args.chunk_size,
         prefix_cache_tokens=args.prefix_cache_tokens,
+        kv_block_size=args.kv_block_size,
+        kv_dtype=args.kv_dtype,
+        kv_pool_blocks=args.kv_pool_blocks,
     )
     server = ServeServer(
         Scheduler(engine, max_queue=args.max_queue),
@@ -286,6 +475,8 @@ def main() -> None:
         "workload": args.workload,
         "slots": args.slots,
         "chunk_size": engine.chunk_size,
+        "kv_block_size": engine.kv_block_size,
+        "kv_dtype": args.kv_dtype,
         "prefix_cache_tokens": args.prefix_cache_tokens,
         "clients": args.clients,
         "requests": len(results),

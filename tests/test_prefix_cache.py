@@ -100,6 +100,39 @@ def test_chain_longer_than_capacity_not_inserted():
     assert c.stats()["evictions"] == 0
 
 
+def test_on_evict_callback_receives_evicted_blocks():
+    """The paged engine's deref hook: every LRU eviction hands the
+    evicted VALUE to on_evict, exactly once."""
+    evicted = []
+    c = PrefixCache(capacity_tokens=8, chunk_tokens=4,
+                    on_evict=evicted.append)
+    a, b, d = [1] * 4, [2] * 4, [3] * 4
+    _fill(c, a, 1)
+    _fill(c, b, 1)
+    assert evicted == []
+    _fill(c, d, 1)                        # evicts a (LRU)
+    assert evicted == [("blk", (1, 1, 1, 1), 0)]
+    _fill(c, [4] * 4, 1)                  # evicts b
+    assert len(evicted) == 2 and c.stats()["evictions"] == 2
+
+
+def test_match_peek_has_no_side_effects():
+    """``record=False`` sizes an admission without polluting counters
+    or LRU order — a rolled-back admission must not look like traffic."""
+    c = PrefixCache(capacity_tokens=16, chunk_tokens=4)
+    prompt = list(range(8))
+    _fill(c, prompt, 2)
+    order_before = list(c._blocks.keys())
+    blocks = c.match(prompt + [9], record=False)
+    assert [b[2] for b in blocks] == [0, 1]
+    s = c.stats()
+    assert s["hits"] == 0 and s["misses"] == 0 and s["hit_tokens"] == 0
+    assert list(c._blocks.keys()) == order_before
+    # the committing match still records as before
+    c.match(prompt + [9])
+    assert c.stats()["hits"] == 1
+
+
 def test_stats_shape():
     c = PrefixCache(capacity_tokens=16, chunk_tokens=4)
     s = c.stats()
