@@ -122,6 +122,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--outer-delay", type=int, default=1,
                    help="rounds between an async outer launch and its "
                         "apply (the staleness bound; with --async-outer)")
+    p.add_argument("--inner-steps-per-worker", type=str, default=None,
+                   metavar="H0,H1,...",
+                   help="elastic DiLoCo: per-worker inner-step budgets "
+                        "(comma list, one entry per worker, each in "
+                        "[1, --inner-steps]). A worker freezes past its "
+                        "budget each round and its pseudo-gradient enters "
+                        "the outer merge weighted by its realized step "
+                        "share — a slow island degrades its own "
+                        "contribution instead of stalling the sync. "
+                        "Unset keeps the uniform-H program bit-identical "
+                        "to classic DiLoCo (classic rounds only)")
+    p.add_argument("--straggler-factor", type=float, default=0.0,
+                   help="elastic DiLoCo straggler policy: demote a "
+                        "worker's inner-step budget when its per-step "
+                        "round seconds exceed this factor x the fleet "
+                        "median (restored on recovery; must be > 1). "
+                        "Every decision is an `elastic` JSONL record and "
+                        "the measured wait is booked as straggler_wait "
+                        "in the goodput ledger. 0 disables")
+    p.add_argument("--straggler-min-steps", type=int, default=1,
+                   help="floor for straggler demotions: a demoted worker "
+                        "never runs fewer inner steps than this")
     p.add_argument("--outer-comm-dtype", type=str, default=None,
                    help="quantization of the outer-sync pseudo-gradient: "
                         "a float dtype casts (bfloat16), a signed-int "
@@ -251,10 +273,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fault-plan", type=str, default=None, metavar="JSON",
                    help="schedule-driven fault injection "
                         "(resilience/faults.py): a JSON plan of step-keyed "
-                        "faults (nan_params/io_error/stall/crash) fired "
-                        "through the real loop/checkpoint/feed hook points "
-                        "— deterministic by step, for proving recovery "
-                        "paths; unset = hooks are free no-ops")
+                        "faults (nan_params/io_error/stall/crash/"
+                        "straggler/resize) fired through the real "
+                        "loop/checkpoint/feed hook points — deterministic "
+                        "by step, for proving recovery paths; unset = "
+                        "hooks are free no-ops")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="write a jax.profiler trace to this directory: one "
                         "whole warm round under fused dispatch (the "
@@ -332,6 +355,12 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         merge_alpha=args.merge_alpha,
         async_outer=args.async_outer,
         outer_delay=args.outer_delay,
+        inner_steps_per_worker=(
+            tuple(int(h) for h in args.inner_steps_per_worker.split(","))
+            if args.inner_steps_per_worker else None
+        ),
+        straggler_factor=args.straggler_factor,
+        straggler_min_steps=args.straggler_min_steps,
         outer_comm_dtype=args.outer_comm_dtype,
         outer_wire_collective=args.outer_wire_collective,
         model=model,
@@ -1015,6 +1044,17 @@ def report_faults_main(argv: list[str]) -> None:
         elif r.get("preempt"):
             events.append({"event": "preempt", "reason": r["preempt"],
                            **{k: v for k, v in r.items() if k != "preempt"}})
+        elif r.get("elastic"):
+            # elastic DiLoCo decisions: straggler demote/restore, a
+            # width change absorbed at resume, an H-schedule reset
+            events.append({"event": "elastic", "kind": r["elastic"],
+                           **{k: v for k, v in r.items() if k != "elastic"}})
+        elif r.get("event") in ("scale_up", "scale_down"):
+            # a supervisor --events-jsonl passed here directly: the
+            # symmetric width-change events read like any other
+            # resilience event (the other supervisor events keep their
+            # own stream semantics)
+            events.append(dict(r))
     if args.json:
         print(json.dumps(events))
         return

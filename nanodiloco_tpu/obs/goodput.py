@@ -55,6 +55,7 @@ CAUSES = (
     "eval",               # held-out eval + MoE probes
     "resume_restore",     # checkpoint restore at startup
     "stall",              # watchdog-attributed dead time
+    "straggler_wait",     # measured wait on a slow worker (elastic DiLoCo)
     "restart_downtime",   # supervisor relaunch gap (no process existed)
     "other",              # startup/logging/unattributed residual
 )
@@ -69,6 +70,11 @@ PHASE_CAUSE = {
     "eval": "eval",
     "restore": "resume_restore",
     "comm_probe": "compile_warmup",  # extra compile + throwaway rounds
+    # the per-round straggler wait the train loop splits OUT of the
+    # inner span (t_straggler in the round budget): healthy workers'
+    # seconds spent on the slowest island, attributed — never silently
+    # inflating compute or outer_sync
+    "straggler": "straggler_wait",
     "cost_analysis": "other",
     "log": "other",
 }

@@ -74,6 +74,13 @@ _GAUGE_KEYS = {
     "quarantined_workers": (
         "nanodiloco_quarantined_workers", "workers masked out of the last sync"
     ),
+    # elastic DiLoCo (training/elastic.py): the live fleet width and
+    # per-worker realized inner steps — the scrapeable view of
+    # join/shrink and straggler demotions
+    "workers_active": (
+        "nanodiloco_workers_active",
+        "workers contributing to the last outer sync",
+    ),
     # DiLoCo dynamics metrics (parallel/diloco.py::_sync_dynamics):
     # drift, momentum, and update-alignment — the quantities quantized
     # outer comm needs to stay tame (arXiv:2501.18512)
@@ -201,6 +208,8 @@ class TelemetryServer:
         self._lock = threading.Lock()
         self._gauges: dict[str, float] = {}
         self._worker_pg: dict[int, float] = {}  # worker -> last pg norm
+        self._worker_h: dict[int, float] = {}   # worker -> realized H
+        self._elastic: dict[str, int] = {}      # elastic records by kind
         self._phases: dict[str, float] = {}
         self._badput: dict[str, float] = {}  # cause -> cumulative seconds
         self._alarms: dict[str, int] = {}
@@ -302,6 +311,21 @@ class TelemetryServer:
                     for w, nv in enumerate(v):
                         if isinstance(nv, (int, float)):
                             self._worker_pg[w] = float(nv)
+                elif k == "elastic":
+                    # elastic DiLoCo decisions by kind (straggler
+                    # demote/restore, resize absorbed at resume) — the
+                    # demotion total is its own headline counter
+                    self._elastic[str(v)] = self._elastic.get(str(v), 0) + 1
+                elif k == "inner_steps_realized" and isinstance(
+                    v, (list, tuple)
+                ):
+                    # a resize drops/adds workers: the realized-H gauge
+                    # family must track the CURRENT fleet, not keep
+                    # ghost series for departed workers
+                    self._worker_h = {
+                        w: float(nv) for w, nv in enumerate(v)
+                        if isinstance(nv, (int, float))
+                    }
                 elif k == "goodput" and isinstance(v, dict):
                     # goodput ledger snapshot (obs/goodput): the
                     # fraction as a gauge, every badput cause's
@@ -336,6 +360,8 @@ class TelemetryServer:
         with self._lock:
             gauges = dict(self._gauges)
             worker_pg = dict(self._worker_pg)
+            worker_h = dict(self._worker_h)
+            elastic = dict(self._elastic)
             phases = dict(self._phases)
             badput = dict(self._badput)
             alarms = dict(self._alarms)
@@ -367,6 +393,29 @@ class TelemetryServer:
                 "per-worker pseudo-gradient norm at the last outer sync",
                 [({"worker": str(w)}, worker_pg[w])
                  for w in sorted(worker_pg)],
+            ))
+        if worker_h:
+            families.append((
+                "nanodiloco_inner_steps_realized", "gauge",
+                "per-worker realized inner steps in the last round "
+                "(elastic DiLoCo heterogeneous H)",
+                [({"worker": str(w)}, worker_h[w])
+                 for w in sorted(worker_h)],
+            ))
+        if elastic:
+            families.append((
+                "nanodiloco_straggler_demotions", "counter",
+                "straggler-policy demotions observed (elastic records "
+                "of kind straggler_demote)",
+                [(None, elastic.get("straggler_demote", 0))],
+            ))
+            families.append((
+                "nanodiloco_elastic_events", "counter",
+                "elastic DiLoCo records by kind (straggler "
+                "demote/restore, resize absorbed at resume, schedule "
+                "reset)",
+                [({"kind": k}, elastic[k]) for k in sorted(elastic)]
+                + [(None, sum(elastic.values()))],
             ))
         if phases:
             families.append((

@@ -142,6 +142,26 @@ class DilocoConfig:
     # staleness bound; each apply's actual lateness is surfaced as the
     # ``outer_staleness`` JSONL key / telemetry gauge)
     outer_delay: int = 1
+    # Heterogeneous per-worker H (elastic DiLoCo): worker w applies
+    # inner updates only on the first ``inner_steps_per_worker[w]``
+    # steps of each round (its replica freezes for the remainder, Adam
+    # moments and schedule count included — a worker that did fewer
+    # steps also warmed up less), and its pseudo-gradient enters the
+    # outer merge weighted by its REALIZED step share
+    # (``sum_w H_w * delta_w / sum_w H_w`` — equal budgets reduce to
+    # the exact worker mean). This is the straggler story: a slow
+    # island degrades its own contribution instead of stalling the
+    # sync. None (the default) keeps the uniform-H program bit-identical
+    # to classic DiLoCo — no masking ops are ever traced. The tuple here
+    # is the INITIAL schedule; ``Diloco.set_inner_budget`` retargets it
+    # between rounds (a runtime [W] program input, no recompile), which
+    # is how the train loop's straggler policy demotes/restores. The
+    # PR-5 drift metrics keep the exact worker-mean math either way
+    # (``_sync_dynamics`` recomputes the true mean itself). vmap inner
+    # path only (sp/pp manual regions unsupported); incompatible with
+    # ``outer_wire_collective`` (the integer wire's psum carries
+    # unweighted payloads).
+    inner_steps_per_worker: tuple[int, ...] | None = None
 
 
 def _wire_accumulator_dtype(num_workers: int, q_max: float):
@@ -311,6 +331,38 @@ class Diloco:
                     f"num_workers={cfg.num_workers} with wire {wire.name} "
                     "overflows the int32 psum accumulator"
                 )
+        if cfg.inner_steps_per_worker is not None:
+            hs = tuple(int(h) for h in cfg.inner_steps_per_worker)
+            if len(hs) != cfg.num_workers:
+                raise ValueError(
+                    f"inner_steps_per_worker has {len(hs)} entries but "
+                    f"num_workers is {cfg.num_workers}"
+                )
+            if any(h < 1 or h > cfg.inner_steps for h in hs):
+                raise ValueError(
+                    f"inner_steps_per_worker entries must be in "
+                    f"[1, inner_steps={cfg.inner_steps}]; got {hs}"
+                )
+            if self.sp > 1 or self.pp > 1:
+                raise ValueError(
+                    "inner_steps_per_worker requires the vmap inner path "
+                    "(sp=1, pp=1): the manual shard_map regions run every "
+                    "worker's shard group in lockstep"
+                )
+            if cfg.outer_wire_collective:
+                raise ValueError(
+                    "inner_steps_per_worker is incompatible with "
+                    "outer_wire_collective: the integer-collective psum "
+                    "carries unweighted payloads (a shared scale cannot "
+                    "express per-worker step-share weights)"
+                )
+            self._h_budget = np.asarray(hs, np.int32)
+        else:
+            self._h_budget = None
+        # budgets the most recent fused async round dispatched under —
+        # the weights its deferred boundary must merge with (see the
+        # async_round_step entry)
+        self._h_budget_prev: np.ndarray | None = None
         if cfg.async_outer:
             if cfg.outer_delay < 0:
                 raise ValueError(f"outer_delay must be >= 0, got {cfg.outer_delay}")
@@ -388,22 +440,40 @@ class Diloco:
         # dispatch side effects, so the probe never touches state)
         self._inner_jit = jax.jit(self._inner_step, donate_argnums=(0,))
         _inner_call = self._with_mesh(self._inner_jit)
-        self.inner_step = lambda state, *a: _inner_call(self._fetch(state), *a)
+        self.inner_step = lambda state, tokens, mask: _inner_call(
+            self._fetch(state), tokens, mask, *self._hb()
+        )
         _outer_jit = self._with_mesh(
             jax.jit(self._outer_step_state, donate_argnums=(0,))
         )
-        self.outer_step = lambda state, *a: _outer_jit(self._fetch(state), *a)
+        self.outer_step = lambda state, worker_mask=None: _outer_jit(
+            self._fetch(state), worker_mask, *self._hb()
+        )
         self._round_jit = jax.jit(self._round_step, donate_argnums=(0,))
         _round_call = self._with_mesh(self._round_jit)
-        self.round_step = lambda state, *a: _round_call(self._fetch(state), *a)
+        self.round_step = lambda state, tokens, mask: _round_call(
+            self._fetch(state), tokens, mask, *self._hb()
+        )
         # H inner steps with NO outer sync: same dispatch count as
         # round_step, so differencing the two isolates the outer
         # all-reduce's true wall clock even in fused mode (the metric the
         # reference stubbed, ref diloco.py:23-24,62-64). Used by bench.py
         # and the train loop's fused-mode comm_share estimate.
-        self.inner_round_step = self._with_mesh(
+        _inner_round_call = self._with_mesh(
             jax.jit(self._inner_round_step, donate_argnums=(0,))
         )
+
+        def _inner_round_step_entry(state, tokens, mask):
+            out = _inner_round_call(state, tokens, mask, *self._hb())
+            if self._h_budget is not None:
+                # record this round-scan's budget: the async fused
+                # loop's FIRST program is this inner-only scan, and the
+                # next program's deferred boundary must merge its delta
+                # with the budget it actually ran under
+                self._h_budget_prev = np.array(self._h_budget)
+            return out
+
+        self.inner_round_step = _inner_round_step_entry
         if cfg.async_outer:
             # boundary-first fused round (launch + apply, THEN the H-step
             # scan — the collective's consumers all live one program
@@ -412,12 +482,41 @@ class Diloco:
             self._async_round_jit = jax.jit(
                 self._async_round_step, donate_argnums=(0,)
             )
-            self.async_round_step = self._with_mesh(self._async_round_jit)
-            self.async_boundary = self._with_mesh(
+            _async_round_call = self._with_mesh(self._async_round_jit)
+
+            def _async_round_step_entry(state, tokens, mask):
+                if self._h_budget is None:
+                    return _async_round_call(state, tokens, mask)
+                # the fused program's boundary merges the PREVIOUS
+                # round's delta: weight it with the budgets that round
+                # dispatched under, while the scan runs the current ones
+                # (they differ for exactly one round after every
+                # straggler-policy retarget; a fresh session has no
+                # previous dispatch and falls back to the current —
+                # also the resume approximation, where the sidecar
+                # budget stands in for the interrupted round's)
+                cur = np.array(self._h_budget)
+                prev = (
+                    cur if self._h_budget_prev is None
+                    else self._h_budget_prev
+                )
+                self._h_budget_prev = cur
+                return _async_round_call(
+                    state, tokens, mask, jnp.asarray(cur), jnp.asarray(prev)
+                )
+
+            self.async_round_step = _async_round_step_entry
+            _async_boundary_call = self._with_mesh(
                 jax.jit(self._async_boundary, donate_argnums=(0,))
             )
-            self.async_flush = self._with_mesh(
+            self.async_boundary = lambda state: _async_boundary_call(
+                state, *self._hb()
+            )
+            _async_flush_call = self._with_mesh(
                 jax.jit(self._async_flush, donate_argnums=(0,))
+            )
+            self.async_flush = lambda state: _async_flush_call(
+                state, *self._hb()
             )
             self.async_drain = self._with_mesh(
                 jax.jit(self._async_drain, donate_argnums=(0,))
@@ -437,6 +536,50 @@ class Diloco:
                 return fn(*args, **kwargs)
 
         return call
+
+    # -- heterogeneous per-worker H (elastic DiLoCo) -------------------------
+
+    def _hb(self) -> tuple:
+        """Extra jit argument carrying the live per-worker step budget —
+        EMPTY when heterogeneous H is off, so the uniform path's traced
+        programs stay byte-identical to classic DiLoCo (the smoke-gate
+        bit-exactness contract). When on, the [W] int32 array is a plain
+        runtime input: retargeting budgets between rounds never
+        recompiles."""
+        if self._h_budget is None:
+            return ()
+        return (jnp.asarray(self._h_budget),)
+
+    def set_inner_budget(self, budgets) -> None:
+        """Retarget the per-worker inner-step budgets for SUBSEQUENT
+        dispatches (the straggler policy's demote/restore lever). Only
+        valid when the instance was built with ``inner_steps_per_worker``
+        — the budget is a program input only the hetero trace consumes."""
+        if self._h_budget is None:
+            raise RuntimeError(
+                "heterogeneous H is not enabled: build Diloco with "
+                "DilocoConfig.inner_steps_per_worker to get a runtime "
+                "step budget"
+            )
+        hs = np.asarray([int(h) for h in budgets], np.int32)
+        if hs.shape != (self.cfg.num_workers,):
+            raise ValueError(
+                f"budget must have one entry per worker "
+                f"({self.cfg.num_workers}); got shape {hs.shape}"
+            )
+        if (hs < 1).any() or (hs > self.cfg.inner_steps).any():
+            raise ValueError(
+                f"budget entries must be in [1, inner_steps="
+                f"{self.cfg.inner_steps}]; got {hs.tolist()}"
+            )
+        self._h_budget = hs
+
+    @property
+    def inner_budget(self) -> tuple[int, ...] | None:
+        """Current per-worker step budgets (None = uniform-H classic)."""
+        if self._h_budget is None:
+            return None
+        return tuple(int(h) for h in self._h_budget)
 
     def _constrain(self, tree: Any, worker_axis: bool) -> Any:
         """Apply sharding constraints when ``tree`` is the model's param
@@ -527,13 +670,30 @@ class Diloco:
 
     # -- inner step (H of these between syncs; zero cross-worker comms) -----
 
-    def _inner_step(self, state: DilocoState, tokens: jax.Array, loss_mask: jax.Array):
+    def _inner_step(
+        self,
+        state: DilocoState,
+        tokens: jax.Array,
+        loss_mask: jax.Array,
+        h_budget: jax.Array | None = None,
+    ):
         """tokens/loss_mask: [W, accum, B, S]. One optimizer update per
         worker from ``accum`` accumulated microbatch gradients. Unlike the
         reference (which backpropped the undivided loss, ref
         nanodiloco/main.py:110-111), accumulation here is an exact
         token-weighted mean: microbatch gradients are weighted by their
-        real-token counts when the loss provides ``n_tokens`` aux."""
+        real-token counts when the loss provides ``n_tokens`` aux.
+
+        ``h_budget`` ([W] int32, hetero-H only): worker w applies this
+        update only when its position within the round
+        (``inner_step_count % H``) is below its budget; past it the
+        replica AND its optimizer state freeze (a worker that ran fewer
+        steps also advanced its schedule less). The vmapped compute
+        still runs for frozen workers — in this stacked single-program
+        representation the wall-clock saving belongs to a real
+        multi-island deployment; what CPU pins is the MATH (freeze +
+        weighted merge). The per-step loss of a frozen worker is still
+        the real loss of its (frozen) replica on the step's batch."""
         if tokens.ndim != 4:
             raise ValueError(f"tokens must be [W, accum, B, S]; got shape {tokens.shape}")
         if tokens.shape[0] != self.cfg.num_workers:
@@ -590,6 +750,18 @@ class Diloco:
         else:
             params, inner_opt_state, loss = jax.vmap(worker_update)(
                 state.params, state.inner_opt_state, tokens, loss_mask
+            )
+        if h_budget is not None:
+            pos = jnp.mod(state.inner_step_count, self.cfg.inner_steps)
+            active = pos < h_budget  # [W]
+
+            def keep(new, old):
+                k = active.reshape((-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(k, new, old)
+
+            params = jax.tree.map(keep, params, state.params)
+            inner_opt_state = jax.tree.map(
+                keep, inner_opt_state, state.inner_opt_state
             )
         params = self._constrain(params, worker_axis=True)
         state = state.replace(
@@ -848,13 +1020,18 @@ class Diloco:
         then the mean accumulates in float32 so rounding error does not
         grow with worker count beyond the intended quantization.
 
-        ``worker_mask`` ([W], bool/0-1) restricts the mean to SURVIVING
-        workers: a dead worker's stale replica contributes nothing and the
-        denominator shrinks to the survivor count — DiLoCo's natural
-        fault story, which the reference cannot express (a dead rank
-        kills its NCCL all-reduce outright, SURVEY §5). All-dead is
-        guarded to a zero pseudo-gradient (denominator clamped to 1), so
-        the outer step degenerates to momentum-only rather than NaN."""
+        ``worker_mask`` ([W], bool/0-1 — or nonnegative float WEIGHTS
+        under heterogeneous H, where each worker's weight is its
+        realized step count) restricts the mean to SURVIVING workers:
+        a dead (zero-weight) worker's stale replica contributes nothing
+        and the denominator shrinks to the surviving weight total —
+        DiLoCo's natural fault story, which the reference cannot
+        express (a dead rank kills its NCCL all-reduce outright,
+        SURVEY §5). With float weights the result is the weighted
+        average ``sum_w w_w * delta_w / sum_w w_w`` — equal weights
+        reduce to the plain worker mean. All-dead is guarded to a zero
+        pseudo-gradient (denominator clamped to 1), so the outer step
+        degenerates to momentum-only rather than NaN."""
         if self.cfg.outer_wire_collective:
             return self._pseudograd_integer_wire(
                 snapshot, params_w, worker_mask
@@ -1318,7 +1495,10 @@ class Diloco:
         }
 
     def _outer_step(
-        self, state: DilocoState, worker_mask: jax.Array | None = None
+        self,
+        state: DilocoState,
+        worker_mask: jax.Array | None = None,
+        h_budget: jax.Array | None = None,
     ) -> tuple[DilocoState, jax.Array]:
         """Returns ``(state, effective_mask, dynamics)``: the [W] bool
         mask of workers that actually contributed to the outer mean —
@@ -1327,7 +1507,13 @@ class Diloco:
         quarantine count instead of re-deriving a loss-only
         approximation (round-4 advisor finding); all-ones when
         quarantine is off. ``dynamics`` is the ``_sync_dynamics``
-        readout dict when ``dynamics_metrics`` is on, else None."""
+        readout dict when ``dynamics_metrics`` is on, else None.
+
+        ``h_budget`` (hetero-H): each worker's delta enters the merge
+        weighted by its realized step count — the weighted outer
+        average ``sum_w H_w * delta_w / sum_w H_w``. A quarantined
+        worker's weight is zeroed (mask AND weights compose by
+        multiplication)."""
         W = self.cfg.num_workers
         inner_opt_state = state.inner_opt_state
         old_snapshot = state.snapshot
@@ -1343,8 +1529,15 @@ class Diloco:
             inner_opt_state = self._heal_inner_opt(
                 inner_opt_state, worker_mask, state.params
             )
+        weights = worker_mask
+        if h_budget is not None:
+            share = h_budget.astype(jnp.float32)
+            weights = (
+                share if worker_mask is None
+                else share * worker_mask.astype(jnp.float32)
+            )
         # pseudo-gradient, pre-averaged (ref diloco.py:48-49)
-        delta = self._pseudograd(old_snapshot, state.params, worker_mask)
+        delta = self._pseudograd(old_snapshot, state.params, weights)
         delta = self._constrain(delta, worker_axis=False)
         updates, outer_opt_state = self.outer_tx.update(
             delta, state.outer_opt_state, old_snapshot
@@ -1366,8 +1559,8 @@ class Diloco:
         )
         params = self._constrain(params, worker_axis=True)
         eff = (
-            jnp.ones((W,), bool) if worker_mask is None
-            else worker_mask.astype(bool)
+            jnp.ones((W,), bool) if weights is None
+            else weights.astype(bool)
         )
         return state.replace(
             params=params, snapshot=snapshot,
@@ -1376,7 +1569,10 @@ class Diloco:
         ), eff, dyn
 
     def _outer_step_state(
-        self, state: DilocoState, worker_mask: jax.Array | None = None
+        self,
+        state: DilocoState,
+        worker_mask: jax.Array | None = None,
+        h_budget: jax.Array | None = None,
     ):
         """Public stepwise entry: the new state (the stepwise train loop
         derives the exact quarantine count itself — pre-reset params are
@@ -1384,10 +1580,16 @@ class Diloco:
         dynamics dict as a second element when ``dynamics_metrics`` is
         on (the return arity is a per-config constant, so every compiled
         program has a fixed output structure)."""
-        new, _, dyn = self._outer_step(state, worker_mask)
+        new, _, dyn = self._outer_step(state, worker_mask, h_budget)
         return (new, dyn) if self.cfg.dynamics_metrics else new
 
-    def _round_step(self, state: DilocoState, tokens: jax.Array, loss_mask: jax.Array):
+    def _round_step(
+        self,
+        state: DilocoState,
+        tokens: jax.Array,
+        loss_mask: jax.Array,
+        h_budget: jax.Array | None = None,
+    ):
         """One FULL DiLoCo round — ``inner_steps`` inner updates
         (``lax.scan``) plus the outer sync — as a single XLA executable.
         tokens/loss_mask: [H, W, accum, B, S]. Returns (state, [H, W]
@@ -1408,7 +1610,7 @@ class Diloco:
             )
 
         def one(s, batch):
-            s, loss = self._inner_step(s, batch[0], batch[1])
+            s, loss = self._inner_step(s, batch[0], batch[1], h_budget)
             return s, loss
 
         state, losses = jax.lax.scan(one, state, (tokens, loss_mask))
@@ -1419,12 +1621,15 @@ class Diloco:
             # finiteness, which also catches a blow-up on the round's
             # final update) is applied inside _outer_step
             wmask = jnp.all(jnp.isfinite(losses), axis=0)
-        state, eff, dyn = self._outer_step(state, wmask)
+        state, eff, dyn = self._outer_step(state, wmask, h_budget)
         if self.cfg.dynamics_metrics:
             return state, losses, eff, dyn
         return state, losses, eff
 
-    def _inner_round_step(self, state: DilocoState, tokens, loss_mask):
+    def _inner_round_step(
+        self, state: DilocoState, tokens, loss_mask,
+        h_budget: jax.Array | None = None,
+    ):
         """``_round_step`` minus the outer sync — the differencing baseline
         for measuring the fused outer step's marginal cost. Same first
         three outputs as ``_round_step`` (the all-ones mask stands in) so
@@ -1433,7 +1638,7 @@ class Diloco:
         (tiny) cost is honestly billed to the sync by the differencing."""
 
         def one(s, batch):
-            s, loss = self._inner_step(s, batch[0], batch[1])
+            s, loss = self._inner_step(s, batch[0], batch[1], h_budget)
             return s, loss
 
         state, losses = jax.lax.scan(one, state, (tokens, loss_mask))
@@ -1463,7 +1668,9 @@ class Diloco:
 
     # -- async delayed-apply outer step (DilocoConfig.async_outer) -----------
 
-    def _async_boundary(self, state: AsyncDilocoState):
+    def _async_boundary(
+        self, state: AsyncDilocoState, h_budget: jax.Array | None = None
+    ):
         """The uniform round-boundary program of the async outer path:
         LAUNCH this round's outer update and APPLY the oldest pending
         merge, in one traced region.
@@ -1495,7 +1702,10 @@ class Diloco:
         replicated for pod-safe host fetches."""
         W = self.cfg.num_workers
         d = self.cfg.outer_delay
-        delta = self._pseudograd(state.snapshot, state.params)
+        # hetero-H: the launch's merge weights each worker's delta by
+        # its realized step count, same math as the synchronous path
+        weights = None if h_budget is None else h_budget.astype(jnp.float32)
+        delta = self._pseudograd(state.snapshot, state.params, weights)
         delta = self._constrain(delta, worker_axis=False)
         head = state.pending[-1] if d > 0 else state.snapshot
         updates, outer_opt = self.outer_tx.update(
@@ -1549,7 +1759,11 @@ class Diloco:
             launched_round=rnd,
         ), aux
 
-    def _async_round_step(self, state: AsyncDilocoState, tokens, loss_mask):
+    def _async_round_step(
+        self, state: AsyncDilocoState, tokens, loss_mask,
+        h_budget: jax.Array | None = None,
+        boundary_h_budget: jax.Array | None = None,
+    ):
         """One steady-state async round as a SINGLE XLA program, boundary
         FIRST: [launch round N's outer update + apply the pending merge]
         then [round N+1's H-step inner scan]. The scan depends only on
@@ -1564,10 +1778,16 @@ class Diloco:
                 f"round tokens must be [inner_steps={self.cfg.inner_steps}, "
                 f"W, accum, B, S]; got {tokens.shape}"
             )
-        state, aux = self._async_boundary(state)
+        # the boundary at the top of this program launches the PREVIOUS
+        # round's delta — its merge weights must be the budgets that
+        # round actually ran under (boundary_h_budget), not the possibly
+        # just-retargeted budgets this round's scan uses (h_budget)
+        state, aux = self._async_boundary(
+            state, h_budget if boundary_h_budget is None else boundary_h_budget
+        )
 
         def one(s, batch):
-            s, loss = self._inner_step(s, batch[0], batch[1])
+            s, loss = self._inner_step(s, batch[0], batch[1], h_budget)
             return s, loss
 
         state, losses = jax.lax.scan(one, state, (tokens, loss_mask))
@@ -1599,19 +1819,21 @@ class Diloco:
             pending_round=jnp.zeros_like(state.pending_round),
         )
 
-    def _async_flush(self, state: AsyncDilocoState):
+    def _async_flush(
+        self, state: AsyncDilocoState, h_budget: jax.Array | None = None
+    ):
         """Final round boundary + drain: launch the last round's outer
         update, apply it (and any older pendings) immediately. Run once
         after the last round's inner scan; with ``outer_delay=0`` the
         drain is a no-op and this IS the ordinary boundary."""
-        state, aux = self._async_boundary(state)
+        state, aux = self._async_boundary(state, h_budget)
         return self._async_drain(state), aux
 
     def async_round_cost_analysis(self, state, tokens, loss_mask):
         """Cost analysis of the fused ASYNC round program (boundary +
         H-step scan) — the executable an async fused run dispatches."""
         return self._jit_cost_analysis(
-            self._async_round_jit, state, tokens, loss_mask
+            self._async_round_jit, state, tokens, loss_mask, *self._hb()
         )
 
     # -- XLA cost analytics (obs/costs) --------------------------------------
@@ -1644,14 +1866,18 @@ class Diloco:
         outer sync as one executable) — the program a fused training
         run actually dispatches, so its FLOPs are the honest numerator
         for analytic MFU."""
-        return self._jit_cost_analysis(self._round_jit, state, tokens, loss_mask)
+        return self._jit_cost_analysis(
+            self._round_jit, state, tokens, loss_mask, *self._hb()
+        )
 
     def inner_cost_analysis(self, state: DilocoState, tokens, loss_mask):
         """Cost analysis of one inner step — the stepwise path's unit of
         dispatch (the outer sync's FLOPs are a rounding error next to
         H steps of fwd+bwd, so per-token numbers match the fused
         program's)."""
-        return self._jit_cost_analysis(self._inner_jit, state, tokens, loss_mask)
+        return self._jit_cost_analysis(
+            self._inner_jit, state, tokens, loss_mask, *self._hb()
+        )
 
     def microbatch_cost_analysis(self, state: DilocoState, batch_shape):
         """Per-token-normalizable cost analysis: ONE microbatch's

@@ -163,6 +163,14 @@ class StreamingDiloco(Diloco):
                 "double-defer the same merges. Use streaming_delay for "
                 "the staleness bound here"
             )
+        if cfg.inner_steps_per_worker is not None:
+            raise ValueError(
+                "inner_steps_per_worker is classic-DiLoCo-only: streaming's "
+                "per-fragment launch cadence is derived from the uniform "
+                "inner-step index, so a worker that freezes mid-round would "
+                "contribute stale fragments on the stagger schedule; run "
+                "classic rounds (sync or async) for heterogeneous H"
+            )
         if cfg.offload_snapshot:
             raise ValueError(
                 "offload_snapshot is classic-DiLoCo-only: streaming's "

@@ -42,6 +42,20 @@ restore; steps >= 1 fire inside the training loop:
   exercises checkpoint resume under the supervisor. ``"raise": true``
   raises ``InjectedCrash`` instead, for in-process tests that must
   survive the "crash".
+- ``straggler``: for the next ``rounds`` rounds at/after ``step``, the
+  driver's per-round straggler hook sleeps ``seconds`` attributed to
+  worker ``worker`` — a REAL wall-clock delay through the real loop, so
+  the straggler policy's demote/restore and the goodput ledger's
+  ``straggler_wait`` attribution are exercised end to end (in the
+  stacked single-program harness this injected skew is the only source
+  of per-worker duration spread — a real multi-island deployment gets
+  it from per-island timing).
+- ``resize``: write ``workers`` into the supervisor's on-disk
+  ``workers.target`` control file (``"file"``, defaulting to
+  ``$NANODILOCO_WORKERS_TARGET`` — the env the supervisor exports) and
+  request a clean preempt exit at the next round boundary, so the
+  supervisor relaunches the child at the new width through the SAME
+  control-plane path an operator's write takes.
 
 Hook contract: every hook is a module function that returns immediately
 when no plan is installed (one ``is None`` check — the smoke gate
@@ -58,7 +72,7 @@ import threading
 import time
 from typing import Any
 
-KINDS = ("nan_params", "io_error", "stall", "crash")
+KINDS = ("nan_params", "io_error", "stall", "crash", "straggler", "resize")
 IO_OPS = ("save", "restore", "fetch")
 
 #: Exit code of an injected crash — distinct from the preempt (75) and
@@ -147,10 +161,39 @@ class FaultPlan:
             elif kind == "crash":
                 f["code"] = int(f.get("code", CRASH_EXIT_CODE))
                 f["raise"] = bool(f.get("raise", False))
+            elif kind == "straggler":
+                if not isinstance(f.get("worker"), int) or f["worker"] < 0:
+                    raise ValueError(
+                        f"straggler fault #{i} needs an integer worker >= 0"
+                    )
+                f["seconds"] = float(f.get("seconds", 1.0))
+                if f["seconds"] <= 0:
+                    raise ValueError(
+                        f"straggler fault #{i} seconds must be > 0"
+                    )
+                f["rounds"] = int(f.get("rounds", 1))
+                if f["rounds"] < 1:
+                    raise ValueError(
+                        f"straggler fault #{i} rounds must be >= 1"
+                    )
+                f["_rounds_left"] = f["rounds"]
+            elif kind == "resize":
+                if not isinstance(f.get("workers"), int) or f["workers"] < 1:
+                    raise ValueError(
+                        f"resize fault #{i} needs an integer workers >= 1"
+                    )
+                if f.get("file") is not None and not isinstance(
+                    f["file"], str
+                ):
+                    raise ValueError(
+                        f"resize fault #{i} file must be a path string"
+                    )
             f["_idx"] = i
             f["_fired"] = i in already
             if f["_fired"] and kind == "io_error":
                 f["count"] = 0  # fully spent in a previous process life
+            if f["_fired"] and kind == "straggler":
+                f["_rounds_left"] = 0  # spent in a previous process life
             self.faults.append(f)
 
     @classmethod
@@ -250,6 +293,27 @@ class FaultPlan:
                     return f["seconds"]
         return 0.0
 
+    def straggle_due(self) -> dict[int, float]:
+        """Per-worker straggler seconds for the CURRENT round
+        (``{worker: seconds}``; empty = no due straggler). Each due
+        straggler fault contributes its ``seconds`` once per round for
+        ``rounds`` consecutive calls — the driver calls this exactly
+        once per round."""
+        out: dict[int, float] = {}
+        with self._lock:
+            for f in self.faults:
+                if (
+                    f["kind"] == "straggler"
+                    and f["step"] <= self._cursor
+                    and f.get("_rounds_left", 0) > 0
+                ):
+                    f["_rounds_left"] -= 1
+                    if not f["_fired"]:
+                        self._mark(f)
+                    w = int(f["worker"])
+                    out[w] = out.get(w, 0.0) + f["seconds"]
+        return out
+
 
 # -- module-level installation (the zero-cost-when-absent contract) ---------
 
@@ -288,6 +352,21 @@ def maybe_stall() -> None:
     s = _PLAN.stall_seconds()
     if s > 0:
         time.sleep(s)
+
+
+def maybe_straggle() -> dict[int, float]:
+    """straggler hook (train-loop round body, once per round): sleep the
+    due per-worker straggler seconds ON the round's clock — a real
+    wall-clock delay the round time, straggler policy, and goodput
+    ledger all observe — and return the ``{worker: seconds}``
+    attribution. One ``is None`` check on the fault-free path."""
+    if _PLAN is None:
+        return {}
+    due = _PLAN.straggle_due()
+    total = sum(due.values())
+    if total > 0:
+        time.sleep(total)
+    return due
 
 
 def fire_crash(fault: dict[str, Any]) -> None:

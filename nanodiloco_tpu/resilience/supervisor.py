@@ -68,6 +68,14 @@ RESTART_ENV = "NANODILOCO_RESTART"
 #: JSONL stream and the stitched end-to-end goodput fraction is honest.
 DOWNTIME_ENV = "NANODILOCO_DOWNTIME_S"
 
+#: Environment variable the supervisor sets for the child: the path of
+#: the on-disk ``workers.target`` control file the supervisor re-reads
+#: between child lifetimes. The child never resizes itself — but its
+#: ``resize`` fault kind (resilience/faults.py) writes the requested
+#: width here and preempt-exits, so an injected capacity change flows
+#: through the REAL control-plane path end to end.
+WORKERS_TARGET_ENV = "NANODILOCO_WORKERS_TARGET"
+
 
 def find_blackbox_dump(
     log_dir: str | None, since_unix: float, child_pid: int | None = None
@@ -140,6 +148,20 @@ class SupervisorConfig:
     # where the child writes its flight-recorder black box — the crash
     # event attaches the newest dump found here (None = don't look)
     log_dir: str | None = None
+    # -- elastic scale-UP (capacity is additive, not only degradable) --
+    # consecutive progress-making child lifetimes (preempt resumes or
+    # crashes that advanced the checkpoint) before DOUBLING
+    # --num-workers, capped at max_workers; 0 disables the automatic
+    # path. The train loop's restore_elastic widens the run: new
+    # replicas seed from the synchronized snapshot, inner moments fresh.
+    scale_up_after: int = 0
+    max_workers: int | None = None
+    # on-disk control file re-read between child lifetimes: an integer
+    # worker-count target written by an operator (or the child's
+    # injected ``resize`` fault, via WORKERS_TARGET_ENV). An explicit
+    # target beats the automatic doubling and moves in BOTH directions
+    # (clamped to [min_workers, max_workers]).
+    workers_target_file: str | None = None
 
 
 class Supervisor:
@@ -162,6 +184,12 @@ class Supervisor:
     ) -> None:
         self.command = list(command)
         self.cfg = cfg or SupervisorConfig()
+        if self.cfg.scale_up_after > 0 and self.cfg.max_workers is None:
+            raise ValueError(
+                "scale_up_after requires max_workers: automatic doubling "
+                "needs a ceiling (a silent no-op here would look like the "
+                "feature is broken)"
+            )
         self._raw_emit = emit or (lambda rec: None)
         self._popen = popen
         self._sleep = sleep
@@ -173,6 +201,10 @@ class Supervisor:
         self._wall = wall
         self._child: subprocess.Popen | None = None
         self._terminating = False
+        # last control-file target acted on: only a NEW value retargets,
+        # so a stale workers.target left on disk cannot fight a later
+        # crash_degrade back up forever
+        self._target_seen: int | None = None
         self.restarts = 0            # launches after the first, any class
         self.budget_used = 0         # crash budget consumed
         self.downtime_total_s = 0.0  # relaunch gaps accumulated (crash+preempt)
@@ -208,6 +240,75 @@ class Supervisor:
             argv += ["--num-workers", str(n)]
         self.workers = n
 
+    # -- elastic resize (scale_up / scale_down) ------------------------------
+
+    def _resize(self, new_w: int, reason: str) -> None:
+        """Retarget the child's width and emit the symmetric scale event
+        (``scale_up``/``scale_down`` with ``workers_from``/``workers_to``
+        — the crash-loop ``degrade`` halving reports through the same
+        event family, so every width change in the run's history reads
+        from one place)."""
+        if new_w == self.workers:
+            return
+        self._emit({
+            "event": "scale_up" if new_w > self.workers else "scale_down",
+            "reason": reason,
+            "workers_from": self.workers,
+            "workers_to": new_w,
+        })
+        self._set_workers(new_w)
+
+    def _read_target_file(self) -> int | None:
+        """Integer worker target from the control file, or None when the
+        file is absent/unreadable/garbage (a torn write must never crash
+        the supervisor — the next lifetime boundary re-reads)."""
+        path = self.cfg.workers_target_file
+        if not path:
+            return None
+        try:
+            with open(path) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def _clamp_workers(self, n: int) -> int:
+        n = max(n, self.cfg.min_workers, 1)
+        if self.cfg.max_workers is not None:
+            n = min(n, self.cfg.max_workers)
+        return n
+
+    def _take_new_target(self) -> int | None:
+        """Control-file target, only when it CHANGED since the last one
+        acted on — a stale value left on disk must not re-apply after a
+        crash_degrade moved the width away from it."""
+        target = self._read_target_file()
+        if target is None or target == self._target_seen:
+            return None
+        self._target_seen = target
+        return target
+
+    def _apply_resize_requests(self, consecutive_progress: int) -> bool:
+        """Between-lifetimes resize check, explicit target first: the
+        control file (both directions) beats the automatic doubling
+        (``scale_up_after`` progress-making lifetimes → 2x, capped at
+        ``max_workers``). Returns True when the automatic path consumed
+        the progress streak (the caller resets its counter)."""
+        target = self._take_new_target()
+        if target is not None:
+            self._resize(self._clamp_workers(target), "control_file")
+            return False
+        if (
+            self.cfg.scale_up_after > 0
+            and self.cfg.max_workers is not None
+            and consecutive_progress >= self.cfg.scale_up_after
+            and self.workers < self.cfg.max_workers
+        ):
+            self._resize(
+                min(self.cfg.max_workers, self.workers * 2), "scale_up_after"
+            )
+            return True
+        return False
+
     # -- signal forwarding ---------------------------------------------------
 
     def _forward(self, signum, frame) -> None:
@@ -228,6 +329,9 @@ class Supervisor:
             for sig in (signal.SIGTERM, signal.SIGINT):
                 prev_handlers[sig] = signal.signal(sig, self._forward)
         consecutive_no_progress = 0
+        # progress-making lifetimes since the last crash/resize — the
+        # automatic scale-up path's health streak
+        consecutive_progress = 0
         progress = latest_checkpoint_step(cfg.checkpoint_dir)
         # downtime accounting: the gap between a child's exit and the
         # next launch (backoff + spawn overhead) is wall-clock the RUN
@@ -247,6 +351,11 @@ class Supervisor:
                     **self._env,
                     RESTART_ENV: str(self.restarts),
                     DOWNTIME_ENV: f"{downtime_s:.3f}",
+                    # the resize fault's write target (see faults.py):
+                    # a child-requested width change flows through the
+                    # same control file an operator would write
+                    **({WORKERS_TARGET_ENV: cfg.workers_target_file}
+                       if cfg.workers_target_file else {}),
                 }
                 self._emit({
                     "event": "launch", "restart": self.restarts,
@@ -295,8 +404,17 @@ class Supervisor:
                     })
                     progress = new_progress
                     consecutive_no_progress = 0
+                    # elastic resize between lifetimes: an explicit
+                    # workers.target beats the automatic doubling earned
+                    # by `scale_up_after` consecutive healthy lifetimes
+                    consecutive_progress = (
+                        consecutive_progress + 1 if advanced else 0
+                    )
+                    if self._apply_resize_requests(consecutive_progress):
+                        consecutive_progress = 0
                     continue
                 # crash class (injected crash, watchdog exit, OOM, bug)
+                consecutive_progress = 0  # instability pauses scale-up
                 cost = 1 if advanced else 2  # no forward progress counts double
                 self.budget_used += cost
                 self.restarts += 1
@@ -326,13 +444,22 @@ class Supervisor:
                     consecutive_no_progress >= cfg.degrade_after
                     and self.workers > cfg.min_workers
                 ):
-                    new_w = max(cfg.min_workers, self.workers // 2)
-                    self._emit({
-                        "event": "degrade", "workers_from": self.workers,
-                        "workers_to": new_w,
-                    })
-                    self._set_workers(new_w)
+                    # crash-loop degradation reports through the same
+                    # symmetric scale event family as every other width
+                    # change (was a bespoke silent `degrade` event)
+                    self._resize(
+                        max(cfg.min_workers, self.workers // 2),
+                        "crash_degrade",
+                    )
                     consecutive_no_progress = 0
+                else:
+                    # an operator may retarget width mid-crash-loop: the
+                    # control file is re-read between EVERY pair of
+                    # lifetimes, not only on healthy resumes
+                    target = self._take_new_target()
+                    if target is not None:
+                        self._resize(self._clamp_workers(target),
+                                     "control_file")
                 delay = jittered_backoff(
                     consecutive_no_progress - 1,
                     cfg.backoff_base_s, cfg.backoff_max_s, self._rng,
@@ -379,6 +506,24 @@ def supervise_main(argv: list[str]) -> None:
                         "--num-workers (elastic resume restores the "
                         "snapshot exactly at the new width)")
     p.add_argument("--min-workers", type=int, default=1)
+    p.add_argument("--scale-up-after", type=int, default=0,
+                   help="consecutive progress-making child lifetimes "
+                        "(preempt resumes / crashes that advanced the "
+                        "checkpoint) before DOUBLING --num-workers, capped "
+                        "at --max-workers (0 disables; elastic resume "
+                        "seeds the new replicas from the snapshot)")
+    p.add_argument("--max-workers", type=int, default=None,
+                   help="worker-count ceiling for scale-up (required for "
+                        "--scale-up-after; also clamps control-file "
+                        "targets)")
+    p.add_argument("--workers-target-file", type=str, default=None,
+                   metavar="FILE",
+                   help="on-disk workers.target control file re-read "
+                        "between child lifetimes: write an integer worker "
+                        "count to retarget the next relaunch's width in "
+                        "EITHER direction (scale_up/scale_down events; "
+                        "exported to the child as $" + WORKERS_TARGET_ENV +
+                        " so the `resize` fault kind can request it)")
     p.add_argument("--checkpoint-dir", type=str, default=None,
                    help="progress-detection dir; default: the --checkpoint-dir "
                         "in the train flags")
@@ -422,6 +567,9 @@ def supervise_main(argv: list[str]) -> None:
         backoff_max_s=args.backoff_max,
         degrade_after=args.degrade_after,
         min_workers=args.min_workers,
+        scale_up_after=args.scale_up_after,
+        max_workers=args.max_workers,
+        workers_target_file=args.workers_target_file,
         checkpoint_dir=ckpt,
         log_dir=log_dir,
     )

@@ -226,6 +226,33 @@ def summarize_run(path: str) -> dict[str, Any]:
     if quar:
         out["quarantine_events"] = int(sum(1 for q in quar if q > 0))
         out["max_quarantined_workers"] = int(max(quar))
+    # elastic DiLoCo (training/elastic.py): width timeline, straggler
+    # demotions, per-worker realized H — keys appear only when the run
+    # logged elastic records (older JSONLs summarize unchanged)
+    active = series("workers_active")
+    if active:
+        out["workers_active_last"] = int(active[-1])
+        if int(min(active)) != int(max(active)):
+            out["workers_active_min"] = int(min(active))
+            out["workers_active_max"] = int(max(active))
+    elastic = [r for r in recs if r.get("elastic")]
+    if elastic:
+        out["elastic_events"] = len(elastic)
+        ekinds: dict[str, int] = {}
+        for e in elastic:
+            ekinds[e["elastic"]] = ekinds.get(e["elastic"], 0) + 1
+        out["elastic_kinds"] = ekinds
+        if ekinds.get("straggler_demote"):
+            out["straggler_demotions"] = ekinds["straggler_demote"]
+    realized = series("inner_steps_realized")
+    if realized:
+        last = realized[-1]
+        if isinstance(last, list) and last:
+            out["inner_steps_realized_last"] = [int(h) for h in last]
+            out["hetero_h_rounds"] = int(sum(
+                1 for v in realized
+                if isinstance(v, list) and len(set(v)) > 1
+            ))
     hbm = series("hbm_peak_bytes")
     if hbm:
         out["hbm_peak_gib"] = round(max(hbm) / 2**30, 3)

@@ -48,6 +48,12 @@ from nanodiloco_tpu.resilience.supervisor import (
     PREEMPT_EXIT_CODE,
     RESTART_ENV,
     WATCHDOG_EXIT_CODE,
+    WORKERS_TARGET_ENV,
+)
+from nanodiloco_tpu.training.elastic import (
+    StragglerPolicy,
+    resume_budgets,
+    save_schedule,
 )
 from nanodiloco_tpu.training.metrics import MetricsLogger, SyncTimer
 from nanodiloco_tpu.training.optim import warmup_cosine_schedule
@@ -165,6 +171,24 @@ class TrainConfig:
     # the delayed path through the same dynamics records.
     async_outer: bool = False
     outer_delay: int = 1
+    # --- elastic DiLoCo: heterogeneous per-worker H + straggler policy ---
+    # initial per-worker inner-step budgets (DilocoConfig
+    # .inner_steps_per_worker): worker w applies updates on the first
+    # H_w steps of each round and its pseudo-gradient enters the merge
+    # weighted by its realized step share. None (+ straggler_factor 0)
+    # keeps the uniform program bit-identical to classic DiLoCo.
+    inner_steps_per_worker: tuple[int, ...] | None = None
+    # straggler policy (training/elastic.py): a worker whose per-step
+    # round seconds exceed straggler_factor x the fleet median gets its
+    # H lowered for subsequent rounds (restored on recovery); every
+    # decision is an `elastic` JSONL record and the measured wait lands
+    # in the goodput ledger as straggler_wait. 0 disables. >0 implies
+    # heterogeneous H (uniform initial budgets unless
+    # inner_steps_per_worker says otherwise). Classic rounds only.
+    straggler_factor: float = 0.0
+    # floor for straggler demotions — a demoted worker never runs fewer
+    # inner steps than this (its merge weight must stay nonzero)
+    straggler_min_steps: int = 1
     model: LlamaConfig = dataclasses.field(default_factory=LlamaConfig)
     # initialize weights from an HF Llama checkpoint directory (sharded
     # or single-file safetensors) — continued pretraining. Streams
@@ -356,9 +380,12 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     if cfg.fault_plan:
         fault_plan = _faults.FaultPlan.load(cfg.fault_plan)
         for f in fault_plan.faults:
-            if f["kind"] == "nan_params" and f["worker"] >= cfg.num_workers:
+            if (
+                f["kind"] in ("nan_params", "straggler")
+                and f["worker"] >= cfg.num_workers
+            ):
                 raise ValueError(
-                    f"fault plan poisons worker {f['worker']} but the run "
+                    f"fault plan targets worker {f['worker']} but the run "
                     f"has only {cfg.num_workers} worker(s)"
                 )
         fault_plan.advance(0)  # step-0 faults are due from startup on
@@ -488,6 +515,23 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             "second round-granularity delay would double-defer the same "
             "merges"
         )
+    # heterogeneous per-worker H (elastic DiLoCo): on when an explicit
+    # schedule was given OR the straggler policy needs the runtime
+    # budget lever; both are classic-rounds-only
+    hetero_on = (
+        cfg.inner_steps_per_worker is not None or cfg.straggler_factor > 0
+    )
+    if hetero_on and cfg.streaming_fragments > 0:
+        raise ValueError(
+            "--inner-steps-per-worker / --straggler-factor are "
+            "classic-rounds-only: streaming's fragment cadence assumes "
+            "the uniform inner-step index (see StreamingDiloco)"
+        )
+    hetero_budgets = (
+        list(cfg.inner_steps_per_worker)
+        if cfg.inner_steps_per_worker is not None
+        else [cfg.inner_steps] * cfg.num_workers
+    ) if hetero_on else None
     dcfg = DilocoConfig(
         num_workers=cfg.num_workers,
         inner_steps=cfg.inner_steps,
@@ -504,6 +548,9 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         dynamics_metrics=dynamics_on,
         async_outer=cfg.async_outer,
         outer_delay=cfg.outer_delay,
+        inner_steps_per_worker=(
+            tuple(hetero_budgets) if hetero_on else None
+        ),
     )
 
     tokenizer = get_tokenizer(cfg.tokenizer)
@@ -658,6 +705,10 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     ckpt = None
     logger: MetricsLogger | None = None
     resume_rec: dict | None = None
+    # elastic records decided before the logger exists (a width change
+    # at resume, an H-schedule reset) — flushed once it does, so every
+    # capacity/schedule decision lands in the one JSONL timeline
+    elastic_pending: list[dict] = []
     # retry events from the STARTUP restore fire before the logger
     # exists — buffer them and flush once it does, so a flaky restore
     # shows in the run's fault timeline like any other IO event
@@ -740,6 +791,43 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 "restart_count": restart_count,
                 "t_restore": round(_t_restore, 6),
             }
+            if saved_w != cfg.num_workers:
+                # the width change as a first-class elastic record: the
+                # join (or shrink) is part of the run's one timeline,
+                # not only a boolean on the resume record
+                elastic_pending.append({
+                    "elastic": (
+                        "resize_widen" if cfg.num_workers > saved_w
+                        else "resize_shrink"
+                    ),
+                    "workers_from": int(saved_w),
+                    "workers_to": cfg.num_workers,
+                })
+
+    # heterogeneous-H schedule carrying: resume the live per-worker
+    # budgets from the checkpoint-side sidecar at unchanged width
+    # (bit-exact resume keeps its schedule too); a width change resets
+    # to the configured schedule — worker identity is not preserved
+    # across a resize (every replica reseeds from the snapshot)
+    straggler_policy: StragglerPolicy | None = None
+    if hetero_on:
+        budgets, demotions0, sched_reset = resume_budgets(
+            cfg.checkpoint_dir, cfg.num_workers, cfg.inner_steps,
+            hetero_budgets,
+        )
+        if sched_reset:
+            elastic_pending.append({
+                "elastic": "h_schedule_reset",
+                "workers_to": cfg.num_workers,
+                "inner_steps_per_worker": list(budgets),
+            })
+        dl.set_inner_budget(budgets)
+        if cfg.straggler_factor > 0:
+            straggler_policy = StragglerPolicy(
+                cfg.inner_steps, cfg.num_workers, cfg.straggler_factor,
+                cfg.straggler_min_steps, initial=budgets,
+            )
+            straggler_policy.demotions_total = demotions0
 
     # resolve_run_name broadcasts process 0's name so a pod produces ONE
     # run identity (an explicit --run-name is already identical on all
@@ -763,6 +851,12 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
     pre_logger_events.clear()
     if resume_rec is not None:
         logger.log(resume_rec, step=resume_rec["resume"])
+    for rec in elastic_pending:
+        logger.log(
+            {**rec, "t_unix": round(time.time(), 3)},
+            step=resume_rec["resume"] if resume_rec else 0,
+        )
+    elastic_pending.clear()
     sync_timer = SyncTimer()
 
     # --- observability: span tracer + watchdog (nanodiloco_tpu/obs) ---------
@@ -978,6 +1072,21 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         fault_plan.advance(cursor_step)
         for f in fault_plan.take_due("nan_params"):
             state = _faults.poison_worker_params(state, f["worker"])
+        for f in fault_plan.take_due("resize"):
+            # width-change request through the REAL control plane: write
+            # the target into the supervisor's workers.target file and
+            # preempt-exit at the next round boundary — the supervisor
+            # re-reads the file between lifetimes and relaunches wider
+            # (or narrower); restore_elastic does the rest
+            target_path = f.get("file") or os.environ.get(
+                WORKERS_TARGET_ENV, ""
+            )
+            if target_path:
+                tmp = target_path + ".tmp"
+                with open(tmp, "w") as fh:
+                    fh.write(str(f["workers"]))
+                os.replace(tmp, target_path)
+            _request_stop("resize", PREEMPT_EXIT_CODE)
         crash = fault_plan.take_due("crash")
         for rec in fault_plan.drain_fired():
             # the record keeps the fault's SCHEDULED step; fired_at_step
@@ -1011,6 +1120,20 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         try:
             with trace_span("ckpt"):
                 ckpt.save(step_, state_, force=force)
+            if hetero_on and logger.is_writer and cfg.checkpoint_dir:
+                # the H schedule rides next to every committed save: a
+                # same-width resume continues the demoted/restored
+                # budgets exactly (width itself is carried by the orbax
+                # state's stacked leading dim)
+                try:
+                    save_schedule(
+                        cfg.checkpoint_dir, step_, cfg.num_workers,
+                        list(dl.inner_budget),
+                        straggler_policy.demotions_total
+                        if straggler_policy else 0,
+                    )
+                except OSError:
+                    pass  # a sidecar blip must not fail a good save
         except Exception as e:
             watchdog.alarm(
                 "ckpt_save_failed", step_,
@@ -1050,6 +1173,61 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 flush=True,
             )
         raise _EmergencyExit(code, reason)
+
+    def _absorb_straggle(
+        round_budget: dict, round_wall_s: float,
+        straggle_extras: dict[int, float], real_step: int,
+    ) -> list[int] | None:
+        """ONE straggler-round epilogue for both dispatch loops: split
+        the measured wait out of the inner span (``t_straggler`` → the
+        ledger's ``straggler_wait`` cause — attributed badput, never
+        inflating compute or outer_sync), model per-worker durations as
+        a real multi-island deployment would report them (shared round
+        wall-clock scaled by each worker's realized step share — the
+        only genuine per-worker skew in this stacked single-program
+        harness is the attributed extras — plus those extras), run the
+        policy, and persist the post-decision schedule sidecar so a
+        resume runs exactly the budgets the live run would have (this
+        round's checkpoint may have written the pre-decision sidecar
+        already — the rewrite here repairs it in both loop orders).
+        Returns the budgets the OBSERVED round realized (None when
+        heterogeneous H is off)."""
+        straggler_s = sum(straggle_extras.values())
+        if straggler_s > 0:
+            round_budget["t_straggler"] = round(straggler_s, 6)
+            if "t_inner" in round_budget:
+                round_budget["t_inner"] = round(
+                    max(0.0, round_budget["t_inner"] - straggler_s), 6
+                )
+        realized = (
+            list(straggler_policy.budgets) if straggler_policy
+            else (list(dl.inner_budget) if hetero_on else None)
+        )
+        if straggler_policy is not None:
+            shared_s = max(0.0, round_wall_s - straggler_s)
+            worker_seconds = [
+                shared_s * (realized[w] / cfg.inner_steps)
+                + straggle_extras.get(w, 0.0)
+                for w in range(cfg.num_workers)
+            ]
+            decisions = straggler_policy.observe(worker_seconds)
+            for d in decisions:
+                logger.log(
+                    {**d, "t_unix": round(time.time(), 3)}, step=real_step
+                )
+            if decisions:
+                dl.set_inner_budget(straggler_policy.budgets)
+                if ckpt is not None and cfg.checkpoint_dir \
+                        and logger.is_writer:
+                    try:
+                        save_schedule(
+                            cfg.checkpoint_dir, real_step, cfg.num_workers,
+                            list(straggler_policy.budgets),
+                            straggler_policy.demotions_total,
+                        )
+                    except OSError:
+                        pass
+        return realized
 
     completed = False
     emergency: _EmergencyExit | None = None
@@ -1281,6 +1459,13 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                                 state, losses, eff_mask = out[0], out[1], out[2]
                                 round_dyn = out[3] if dynamics_on else None
                             jax.block_until_ready(losses)
+                            # straggler fault hook, ON the round's clock
+                            # (once per round): the sleep lands in this
+                            # round's measured wall time exactly like a
+                            # slow island would, and the returned
+                            # {worker: seconds} attribution feeds the
+                            # straggler policy + goodput ledger below
+                            straggle_extras = _faults.maybe_straggle()
                             round_s = time.perf_counter() - t0
                     finally:
                         # a failing traced round must still flush/stop the
@@ -1418,6 +1603,12 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         round_budget["t_inner"] = round(
                             max(0.0, round_budget["t_inner"] - sync_est), 6
                         )
+                    # straggler epilogue (shared helper): wait split out
+                    # of the inner span, policy demote/restore for
+                    # subsequent rounds, post-decision sidecar
+                    realized_budgets = _absorb_straggle(
+                        round_budget, round_s, straggle_extras, real_step
+                    )
                     # goodput attribution from the SAME budget the JSONL
                     # carries (t_inner/t_sync after the differenced
                     # split, comm_probe, ckpt, data, eval): the first
@@ -1428,6 +1619,17 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         round_budget, warmup=(rnd == first_round)
                     )
                     ledger.add_tokens(cfg.inner_steps * tokens_per_step)
+                    elastic_extras: dict[str, Any] = {
+                        "workers_active": int(
+                            cfg.num_workers
+                            - quarantine_metrics.get(
+                                "quarantined_workers", 0)
+                        ),
+                    }
+                    if realized_budgets is not None:
+                        elastic_extras["inner_steps_realized"] = (
+                            realized_budgets
+                        )
                     wire_bytes_total += wire_rec["wire_bytes_per_sync"]
                     # dynamics readout (host fetch AFTER the timing
                     # fences): per-worker pg norms, drift, momentum,
@@ -1472,7 +1674,8 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                                     **(
                                         {**wire_metrics,
                                          "wire_bytes_total": wire_bytes_total,
-                                         **dyn_metrics, **mode_extras}
+                                         **dyn_metrics, **mode_extras,
+                                         **elastic_extras}
                                         if i == cfg.inner_steps - 1 else {}
                                     ),
                                 },
@@ -1536,6 +1739,9 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
             # fault hook per dispatch unit (one inner step here): a
             # scheduled fault fires at exactly its step
             state = _pump_faults(real_step, state)
+            # per-round straggler attribution ({worker: seconds}), fired
+            # once per round at its sync step below
+            straggle_extras: dict[int, float] = {}
             if cfg.profile_dir and real_step == profile_start:
                 # same exclusive-profiler contract as the fused path: a
                 # live /debug/profile capture must not crash this
@@ -1566,6 +1772,8 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     )
                     synced = real_step % cfg.inner_steps == 0
                     jax.block_until_ready(loss)
+                    if synced:
+                        straggle_extras = _faults.maybe_straggle()
                     compute_time += time.perf_counter() - t0
                 if synced:
                     state = dl._offload(state)
@@ -1592,6 +1800,11 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     jax.block_until_ready(
                         state.params if (synced and not async_on) else loss
                     )
+                    if synced:
+                        # straggler fault hook on the round's clock (same
+                        # placement contract as the fused loop: the sleep
+                        # lands inside the round's measured compute time)
+                        straggle_extras = _faults.maybe_straggle()
                     compute_time += time.perf_counter() - t0
                 if synced and async_on:
                     if pending_baux is not None:
@@ -1711,6 +1924,15 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     f"t_{k}": round(v, 6)
                     for k, v in tracer.phase_totals().items()
                 }
+                # straggler epilogue (the SAME helper as the fused loop:
+                # wait split, policy, post-decision sidecar). The
+                # stepwise async boundary above already launched with
+                # the round's realized budgets — retargeting here only
+                # affects subsequent rounds, same contract as fused.
+                realized_step_budgets = _absorb_straggle(
+                    round_budget, time.perf_counter() - round_t0,
+                    straggle_extras, real_step,
+                )
                 # goodput attribution, per round at the sync boundary.
                 # Async mode books ONLY the residual apply-wait (the
                 # `sync` span around block_until_ready(state.pending))
@@ -1745,6 +1967,19 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     cfg.inner_steps * tokens_per_step / max(now - round_t0, 1e-9),
                 )
                 round_t0 = now
+                # elastic sync keys: the fleet width and the budgets the
+                # round that just synced realized
+                if not streaming:
+                    sync_extras["workers_active"] = int(
+                        cfg.num_workers - (
+                            quarantined_last_round
+                            if cfg.quarantine_nonfinite else 0
+                        )
+                    )
+                if realized_step_budgets is not None:
+                    sync_extras["inner_steps_realized"] = (
+                        realized_step_budgets
+                    )
             # same phase name as the fused path: the logging tail is real
             # per-step wall clock and must show in the trace/round budget,
             # not as an unattributed gap (its seconds land in the NEXT
@@ -1933,6 +2168,12 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         "steps": cfg.total_steps,
         **({"async_outer": True, "outer_delay": cfg.outer_delay}
            if async_on else {}),
+        **({"inner_steps_per_worker": list(dl.inner_budget),
+            "straggler_demotions": (
+                straggler_policy.demotions_total
+                if straggler_policy is not None else 0
+            )}
+           if hetero_on else {}),
         **sync_summary,
         **wire_metrics,
         "wire_bytes_total": wire_bytes_total,

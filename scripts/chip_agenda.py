@@ -771,6 +771,127 @@ def phase_goodput() -> None:
     })
 
 
+def phase_elastic() -> None:
+    """The elastic-DiLoCo drill against a REAL (short) supervised run on
+    this backend: a 2-worker run whose injected `resize` fault writes 4
+    into the supervisor's workers.target control file and preempt-exits
+    at a round boundary; the supervisor emits a scale_up and relaunches
+    wide (restore_elastic seeds the join replicas from the snapshot);
+    an injected `straggler` fault is then demoted into weighted-merge
+    rounds with unequal realized H and restored, with the wait
+    attributed as straggler_wait in the stitched goodput ledger. What
+    CPU pins is the control-plane math; this phase confirms the same
+    path end to end on the chip's wall clock."""
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="nanodiloco-elastic-")
+    ckpt = os.path.join(tmp, "ckpt")
+    target = os.path.join(tmp, "workers.target")
+    events_jsonl = os.path.join(tmp, "supervise.jsonl")
+    model_cfg = os.path.join(tmp, "model.json")
+    with open(model_cfg, "w") as f:
+        json.dump({
+            "vocab_size": 2048, "hidden_size": 128, "intermediate_size": 256,
+            "num_attention_heads": 4, "num_hidden_layers": 2,
+            "max_position_embeddings": 256,
+        }, f)
+    plan = os.path.join(tmp, "plan.json")
+    with open(plan, "w") as f:
+        # resize at step 4 (round 2 of H=2): control-file scale-up 2->4
+        # at the boundary; straggler at step 13 (two rounds after the
+        # wide resume's compile rounds) for one round
+        json.dump({"faults": [
+            {"kind": "resize", "step": 4, "workers": 4},
+            {"kind": "straggler", "step": 13, "worker": 1,
+             "seconds": 3.0, "rounds": 1},
+        ]}, f)
+    args = [
+        "--total-steps", "20", "--inner-steps", "2",
+        "--batch-size", "8", "--per-device-batch-size", "4",
+        "--seq-length", "256", "--warmup-steps", "2",
+        "--llama-config-file", model_cfg, "--no-measure-comm",
+        "--no-cost-analysis", "--quiet",
+        "--num-workers", "2", "--straggler-factor", "2.0",
+        "--checkpoint-dir", ckpt, "--log-dir", tmp,
+        "--run-name", "elastic-probe", "--fault-plan", plan,
+        # the widened run needs a 4-way diloco mesh: real devices on the
+        # chip; a virtual mesh when this phase is drive-verified with a
+        # CPU-pinned environment (the control-plane math is identical)
+        *(["--force-cpu-devices", "8"]
+          if os.environ.get("JAX_PLATFORMS") == "cpu" else []),
+    ]
+    budget = float(os.environ.get("NANODILOCO_AGENDA_TIMEOUT_ELASTIC", "1200"))
+    sup = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu", "supervise",
+         "--max-restarts", "3", "--max-workers", "4",
+         "--workers-target-file", target,
+         "--events-jsonl", events_jsonl, "--", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=budget * 0.8,
+    )
+    if sup.returncode != 0:
+        record({"phase": "elastic",
+                "error": f"supervised run exit {sup.returncode}",
+                "tail": (sup.stdout or "")[-400:]})
+        raise SystemExit(1)
+    sup_events = []
+    with open(events_jsonl) as f:
+        for ln in f:
+            try:
+                sup_events.append(json.loads(ln))
+            except ValueError:
+                continue
+    ups = [e for e in sup_events if e.get("event") == "scale_up"]
+    lines = []
+    with open(os.path.join(tmp, "elastic-probe.jsonl")) as f:
+        for ln in f:
+            try:
+                lines.append(json.loads(ln))
+            except ValueError:
+                continue
+    demotions = [l for l in lines if l.get("elastic") == "straggler_demote"]
+    widens = [l for l in lines if l.get("elastic") == "resize_widen"]
+    realized = [tuple(l["inner_steps_realized"]) for l in lines
+                if l.get("inner_steps_realized")]
+    weighted_rounds = sum(1 for r in realized if len(set(r)) > 1)
+    post_join_drift = [l.get("drift_max") for l in lines
+                       if l.get("outer_synced") and l.get("step", 0) > 4
+                       and l.get("drift_max") is not None]
+    gp = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu", "report", "goodput",
+         os.path.join(tmp, "elastic-probe.jsonl"), "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    ledger = json.loads(gp.stdout) if gp.returncode == 0 else {}
+    ok = (
+        bool(ups) and ups[0].get("workers_from") == 2
+        and ups[0].get("workers_to") == 4
+        and bool(widens) and bool(demotions)
+        and weighted_rounds >= 1
+        and bool(post_join_drift)
+        and (ledger.get("straggler_wait_s") or 0) > 0
+    )
+    if not ok:
+        record({"phase": "elastic",
+                "error": "elastic contract not met",
+                "scale_up_events": ups[-2:],
+                "widen_records": widens[-2:],
+                "demotions": demotions[-2:],
+                "weighted_rounds": weighted_rounds,
+                "ledger": ledger})
+        raise SystemExit(1)
+    record({
+        "phase": "elastic",
+        "scale_up": [ups[0]["workers_from"], ups[0]["workers_to"]],
+        "join_resume_step": widens[0].get("step"),
+        "first_post_join_drift_max": post_join_drift[0],
+        "straggler_demotions": len(demotions),
+        "weighted_merge_rounds": weighted_rounds,
+        "straggler_wait_s": ledger.get("straggler_wait_s"),
+        "goodput_fraction": ledger.get("goodput_fraction"),
+        "lifetimes": ledger.get("lifetimes"),
+    })
+
+
 def phase_serve() -> None:
     """The serving path on this backend end to end: train a tiny REAL
     checkpoint, launch the `serve` CLI on it, drive TWO overlapping
@@ -1313,6 +1434,7 @@ PHASES = {
     "live_profile": phase_live_profile,
     "resilience": phase_resilience,
     "goodput": phase_goodput,
+    "elastic": phase_elastic,
     "serve": phase_serve,
     "serve_interference": phase_serve_interference,
     "kv_paging": phase_kv_paging,
@@ -1357,6 +1479,7 @@ PHASE_TIMEOUT_S = {
     "live_profile": 900,
     "resilience": 1200,
     "goodput": 1200,
+    "elastic": 1200,
     "serve": 900,
     "serve_interference": 900,
     "kv_paging": 900,

@@ -698,7 +698,9 @@ def test_supervisor_watchdog_exit_counts_as_crash(tmp_path):
 def test_supervisor_degrades_worker_count(tmp_path, monkeypatch):
     """After degrade_after consecutive no-progress crashes, the child is
     relaunched with --num-workers halved (elastic resume restores the
-    snapshot at the new width), floored at min_workers."""
+    snapshot at the new width), floored at min_workers — reported
+    through the symmetric scale_down event (reason crash_degrade) with
+    workers_from/workers_to and a t_unix stamp."""
     argv_log = str(tmp_path / "argv.log")
     monkeypatch.setenv("CHILD_ARGV_LOG", argv_log)
     events = []
@@ -708,13 +710,96 @@ def test_supervisor_degrades_worker_count(tmp_path, monkeypatch):
         emit=events.append, sleep=lambda s: None,
     )
     assert sup.run() == 9
-    degrades = [(e["workers_from"], e["workers_to"])
-                for e in events if e["event"] == "degrade"]
-    assert degrades == [(4, 2), (2, 1)]
+    downs = [e for e in events if e["event"] == "scale_down"]
+    assert [(e["workers_from"], e["workers_to"]) for e in downs] == \
+        [(4, 2), (2, 1)]
+    assert all(e["reason"] == "crash_degrade" for e in downs)
+    assert all(isinstance(e.get("t_unix"), float) for e in downs)
     assert sup.workers == 1
     launches = open(argv_log).read().splitlines()
     assert "--num-workers 4" in launches[0]
     assert "--num-workers 1" in launches[-1]
+
+
+def test_supervisor_control_file_scales_up_and_down(tmp_path, monkeypatch):
+    """The on-disk workers.target control file is re-read between child
+    lifetimes: an operator (or the resize fault, via the exported env)
+    retargets the next relaunch's width in either direction, clamped to
+    [min_workers, max_workers], with symmetric scale events."""
+    argv_log = str(tmp_path / "argv.log")
+    monkeypatch.setenv("CHILD_ARGV_LOG", argv_log)
+    target = tmp_path / "workers.target"
+    target.write_text("8")  # asks for 8; max_workers clamps to 4
+    events = []
+    sup = Supervisor(
+        child_cmd(
+            tmp_path, f"{PREEMPT_EXIT_CODE},{PREEMPT_EXIT_CODE},0",
+            extra=("--num-workers", "2"),
+        ),
+        SupervisorConfig(max_restarts=0, max_workers=4,
+                         workers_target_file=str(target)),
+        emit=events.append, sleep=lambda s: None,
+    )
+    # second lifetime's boundary: rewrite the target downward
+    orig_popen = subprocess.Popen
+    seen = {"n": 0}
+
+    def popen(cmd, **kw):
+        seen["n"] += 1
+        if seen["n"] == 2:
+            target.write_text("1")
+        # the control-file path must be exported to the child so the
+        # resize fault can write a supervisor-visible request
+        assert kw["env"]["NANODILOCO_WORKERS_TARGET"] == str(target)
+        return orig_popen(cmd, **kw)
+
+    sup._popen = popen
+    assert sup.run() == 0
+    kinds = [(e["event"], e.get("workers_from"), e.get("workers_to"))
+             for e in events if e["event"] in ("scale_up", "scale_down")]
+    assert kinds == [("scale_up", 2, 4), ("scale_down", 4, 1)]
+    assert all(e["reason"] == "control_file" for e in events
+               if e["event"] in ("scale_up", "scale_down"))
+    launches = open(argv_log).read().splitlines()
+    assert "--num-workers 2" in launches[0]
+    assert "--num-workers 4" in launches[1]
+    assert "--num-workers 1" in launches[2]
+
+
+def test_supervisor_scale_up_after_requires_max_workers(tmp_path):
+    """--scale-up-after without a ceiling would be a silent no-op (the
+    doubling condition checks max_workers) — fail loudly instead."""
+    with pytest.raises(ValueError, match="requires max_workers"):
+        Supervisor(
+            child_cmd(tmp_path, "0"),
+            SupervisorConfig(scale_up_after=2),
+        )
+
+
+def test_supervisor_auto_scale_up_after_healthy_lifetimes(tmp_path):
+    """--scale-up-after N: after N consecutive progress-making preempt
+    resumes the supervisor doubles --num-workers (capped at
+    --max-workers) — capacity is additive, not only degradable."""
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+    events = []
+    sup = Supervisor(
+        child_cmd(
+            tmp_path,
+            ",".join([str(PREEMPT_EXIT_CODE)] * 4 + ["0"]),
+            ckpt=str(ck), extra=("--num-workers", "1"),
+        ),
+        SupervisorConfig(max_restarts=0, scale_up_after=2, max_workers=4,
+                         checkpoint_dir=str(ck)),
+        emit=events.append, sleep=lambda s: None,
+    )
+    assert sup.run() == 0
+    ups = [(e["workers_from"], e["workers_to"])
+           for e in events if e["event"] == "scale_up"]
+    assert ups == [(1, 2), (2, 4)]
+    assert all(e["reason"] == "scale_up_after" for e in events
+               if e["event"] == "scale_up")
+    assert sup.workers == 4
 
 
 def test_latest_checkpoint_step_reads_committed_dirs_only(tmp_path):

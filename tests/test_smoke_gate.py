@@ -86,6 +86,9 @@ def test_smoke_gate_under_noop_fault_plan(tmp_path):
             {"kind": "stall", "step": 10_000_000, "seconds": 1.0},
             {"kind": "io_error", "step": 10_000_000, "op": "save"},
             {"kind": "nan_params", "step": 10_000_000, "worker": 0},
+            {"kind": "straggler", "step": 10_000_000, "worker": 0,
+             "seconds": 1.0, "rounds": 2},
+            {"kind": "resize", "step": 10_000_000, "workers": 4},
         ]}, f)
     bare = _run_smoke(str(tmp_path / "bare"))
     hooked = _run_smoke(str(tmp_path / "hooked"), fault_plan=plan)

@@ -24,9 +24,10 @@ def _metric_lines(path):
     timeline's ``resume``/``fault``/``retry``/``preempt``/``alarm``
     records — are not step lines and would break step-count/index
     assertions. The per-round ``goodput`` ledger snapshots
-    (obs/goodput) are the same class."""
+    (obs/goodput) and the ``elastic`` decision records
+    (training/elastic.py) are the same class."""
     meta_keys = ("cost_analysis", "resume", "fault", "retry", "preempt",
-                 "alarm", "goodput")
+                 "alarm", "goodput", "elastic")
     return [
         r for r in (json.loads(l) for l in open(path))
         if not any(k in r for k in meta_keys)
