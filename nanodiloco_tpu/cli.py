@@ -545,6 +545,16 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "slots x ceil(max_len/block) — the dense "
                         "footprint, oversubscribable downward because "
                         "short requests only hold what they use")
+    p.add_argument("--spec-k", type=int, default=0, metavar="K",
+                   help="speculative decoding: verify up to K "
+                        "prompt-lookup draft tokens per slot per tick "
+                        "(one batched forward over K+1 positions; "
+                        "greedy AND sampled streams stay bit-identical "
+                        "to solo generate); 0 (default) disables")
+    p.add_argument("--spec-ngram", type=int, default=3,
+                   help="longest n-gram the prompt-lookup proposer "
+                        "matches over prompt + emitted output (it "
+                        "backs off to shorter grams)")
     p.add_argument("--starvation-s", type=float, default=30.0,
                    help="starvation bound for priority admission: a "
                         "queued request older than this is admitted next "
@@ -611,7 +621,14 @@ def serve_main(argv: list[str]) -> None:
         kv_block_size=args.kv_block_size,
         kv_dtype=args.kv_dtype,
         kv_pool_blocks=args.kv_pool_blocks,
+        spec_k=args.spec_k,
+        spec_ngram=args.spec_ngram,
     )
+    if args.spec_k:
+        # compile the verify buckets before traffic: the adaptive-k ramp
+        # reaches them data-dependently, and a first-request compile
+        # stall is exactly the TTFT spike chunked prefill exists to kill
+        engine.warm_spec()
     tracer = None
     if args.trace_out:
         from nanodiloco_tpu.obs import SpanTracer
@@ -692,12 +709,14 @@ def _append_serve_stats(path: str, scheduler) -> None:
         "serve_stats": True,
         **{k: v for k, v in s.items() if not k.startswith("hist_")},
     }
-    if isinstance(rec.get("kv_pool"), dict):
-        # same scalars-only rule for the nested block-pool snapshot
-        rec["kv_pool"] = {
-            k: v for k, v in rec["kv_pool"].items()
-            if not k.startswith("hist_")
-        }
+    for nested in ("kv_pool", "spec"):
+        if isinstance(rec.get(nested), dict):
+            # same scalars-only rule for nested snapshots (block pool,
+            # speculation): histograms stay on /metrics
+            rec[nested] = {
+                k: v for k, v in rec[nested].items()
+                if not k.startswith("hist_")
+            }
     _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "a") as f:
         f.write(json.dumps(rec) + "\n")

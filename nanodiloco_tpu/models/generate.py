@@ -509,106 +509,71 @@ def _sample_slots(logits, keys, temperature, top_k, top_p):
     (division, k-th-largest cut, nucleus threshold over the top_k
     survivors, categorical), with the static Python gates replaced by
     no-op thresholds (-inf) so every row shares one traced program:
-    temperature 0 = greedy, top_k 0 = no cut, top_p >= 1 = no nucleus."""
+    temperature 0 = greedy, top_k 0 = no cut, top_p >= 1 = no nucleus.
+
+    The no-op gates are also SKIPPED at runtime (``lax.cond`` on the
+    whole batch): an all-greedy tick runs argmax alone, and a sampled
+    tick without top_k/top_p skips the two full-vocab sorts — measured
+    at >80% of a decode/verify dispatch on CPU for a [B, 2048] vocab.
+    Bit-exact by construction: a skipped filter is one whose thresholds
+    were -inf (an identity ``where``), and a skipped categorical is one
+    whose draw the final ``temperature > 0`` select would discard."""
     v = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    t = temperature[:, None]
-    scaled = logits / jnp.where(t > 0.0, t, 1.0)
-    # k-th largest of the scaled logits == lax.top_k(...)[0][..., -1:]
-    sl = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
-    kth = jnp.take_along_axis(
-        sl, jnp.clip(top_k[:, None] - 1, 0, v - 1), axis=-1
+
+    def stochastic(operands):
+        logits, key_data, temperature, top_k, top_p = operands
+        t = temperature[:, None]
+        scaled = logits / jnp.where(t > 0.0, t, 1.0)
+
+        def filtered(scaled):
+            # k-th largest of the scaled logits == lax.top_k(...)[0][..., -1:]
+            sl = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
+            kth = jnp.take_along_axis(
+                sl, jnp.clip(top_k[:, None] - 1, 0, v - 1), axis=-1
+            )
+            kth = jnp.where(top_k[:, None] > 0, kth, -jnp.inf)
+            filt = jnp.where(scaled < kth, MASK_VALUE, scaled)
+            # nucleus over the top_k-filtered logits (same composition
+            # order and same keep rule as _sample: mass strictly BEFORE
+            # a token < p)
+            sl2 = jnp.flip(jnp.sort(filt, axis=-1), axis=-1)
+            probs = jax.nn.softmax(sl2, axis=-1)
+            keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p[:, None]
+            thresh = jnp.min(
+                jnp.where(keep, sl2, jnp.inf), axis=-1, keepdims=True
+            )
+            thresh = jnp.where(top_p[:, None] < 1.0, thresh, -jnp.inf)
+            return jnp.where(filt < thresh, MASK_VALUE, filt)
+
+        filt = jax.lax.cond(
+            jnp.any(top_k > 0) | jnp.any(top_p < 1.0),
+            filtered, lambda s: s, scaled,
+        )
+        keys = jax.random.wrap_key_data(key_data)
+        return jax.vmap(jax.random.categorical)(keys, filt).astype(jnp.int32)
+
+    drawn = jax.lax.cond(
+        jnp.any(temperature > 0.0),
+        stochastic, lambda operands: greedy,
+        (logits, jax.random.key_data(keys), temperature, top_k, top_p),
     )
-    kth = jnp.where(top_k[:, None] > 0, kth, -jnp.inf)
-    filt = jnp.where(scaled < kth, MASK_VALUE, scaled)
-    # nucleus over the top_k-filtered logits (same composition order and
-    # same keep rule as _sample: mass strictly BEFORE a token < p)
-    sl2 = jnp.flip(jnp.sort(filt, axis=-1), axis=-1)
-    probs = jax.nn.softmax(sl2, axis=-1)
-    keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p[:, None]
-    thresh = jnp.min(jnp.where(keep, sl2, jnp.inf), axis=-1, keepdims=True)
-    thresh = jnp.where(top_p[:, None] < 1.0, thresh, -jnp.inf)
-    filt = jnp.where(filt < thresh, MASK_VALUE, filt)
-    drawn = jax.vmap(jax.random.categorical)(keys, filt).astype(jnp.int32)
     return jnp.where(temperature > 0.0, drawn, greedy)
 
 
 def _decode_slots_block(params, cfg: LlamaConfig, tokens, cache, pos,
                         key_valid, active):
-    """One decode step for B independent slots: ``tokens`` [B] at PER-SLOT
-    positions ``pos`` [B]. The math is ``_cached_block`` with T=1 except
-    the scalar write offset becomes a per-row one: RoPE phases come from
-    each row's own position and the cache write is a per-row masked
-    select at ``pos[b]`` (same values ``dynamic_update_slice`` would
-    write). ``active`` [B] zeroes dead slots out of MoE routing so a
-    free slot never spends expert capacity. Returns
-    (logits [B, V] float32, updated cache)."""
-    cdt = jnp.dtype(cfg.dtype)
-    b = tokens.shape[0]
-    s_max = cache["k"].shape[2]
-    nh, nkv, hd = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
-    g = nh // nkv
-    scale = 1.0 / math.sqrt(hd)
-
-    x = params["embed"].astype(cdt)[tokens[:, None]]  # [B, 1, d]
-
-    # per-slot RoPE at global position pos[b] (rope_tables' formula with a
-    # per-row offset; float32 tables cast to compute dtype at application,
-    # exactly as apply_rope does)
-    inv_freq = 1.0 / (
-        cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
+    """One decode step for B independent slots: ``tokens`` [B] at
+    PER-SLOT positions ``pos`` [B] — the T=1 special case of the
+    speculative verify block, delegated so the per-slot-position
+    transformer step (per-row RoPE phases, causal+valid mask, masked
+    dead-slot-safe cache writes, layer scan, head) has ONE
+    implementation the tick and its verify widening can never drift
+    between. Returns (logits [B, V] float32, updated cache)."""
+    logits, cache = _verify_slots_block(
+        params, cfg, tokens[:, None], cache, pos, key_valid, active
     )
-    freqs = pos.astype(jnp.float32)[:, None] * inv_freq[None, :]  # [B, hd/2]
-    emb = jnp.concatenate([freqs, freqs], axis=-1)                # [B, hd]
-    cos = jnp.cos(emb)[:, None, None, :].astype(cdt)              # [B,1,1,hd]
-    sin = jnp.sin(emb)[:, None, None, :].astype(cdt)
-
-    def rope(t):  # [B, 1, H, hd] rotate-half with per-row phases
-        half = t.shape[-1] // 2
-        t1, t2 = t[..., :half], t[..., half:]
-        return t * cos + jnp.concatenate([-t2, t1], axis=-1) * sin
-
-    ki = jnp.arange(s_max)
-    ok = (ki[None, None, :] <= pos[:, None, None]) & (key_valid[:, None, :] > 0)
-    mask = jnp.where(ok, 0.0, MASK_VALUE)[:, None]        # [B, 1, T=1, S]
-    # dead slots must not write: a slot mid-chunked-prefill shares the
-    # tick with decoding neighbours, and an unmasked write would stamp
-    # garbage K/V at its position 0 between two of its prefill chunks
-    write = (
-        (ki[None, :] == pos[:, None]) & (active[:, None] > 0)
-    )[:, :, None, None]                                    # [B, S, 1, 1]
-    token_valid = active[:, None]                          # [B, 1]
-
-    def layer_body(x, scanned):
-        layer, ck, cv = scanned
-        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-        q = (h @ layer["wq"].astype(cdt)).reshape(b, 1, nh, hd)
-        k = (h @ layer["wk"].astype(cdt)).reshape(b, 1, nkv, hd)
-        v = (h @ layer["wv"].astype(cdt)).reshape(b, 1, nkv, hd)
-        q = rope(q)
-        k = rope(k)
-        ck = jnp.where(write, k[:, 0][:, None], ck)
-        cv = jnp.where(write, v[:, 0][:, None], cv)
-
-        qg = q.reshape(b, 1, nkv, g, hd)
-        scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck).astype(jnp.float32)
-        scores = scores * scale + mask[:, :, None]
-        probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
-        attn = jnp.einsum("bkgts,bskd->btkgd", probs, cv).reshape(b, 1, nh * hd)
-        x = x + attn @ layer["wo"].astype(cdt)
-
-        x, _aux = mlp_block(cfg, x, layer, valid=token_valid)
-        return x, (ck, cv)
-
-    x, (ck, cv) = jax.lax.scan(
-        layer_body, x, (params["layers"], cache["k"], cache["v"])
-    )
-    x = rms_norm(x[:, -1], params["final_norm"], cfg.rms_norm_eps)
-    head = params.get("lm_head", None)
-    if head is None:
-        head = params["embed"].T
-    logits = (x @ head.astype(cdt)).astype(jnp.float32)
-    return logits, {"k": ck, "v": cv}
+    return logits[:, 0], cache
 
 
 def _serve_donate():
@@ -806,111 +771,16 @@ def decode_slots_fn(cfg: LlamaConfig):
 
 def _decode_slots_paged_block(params, cfg: LlamaConfig, tokens, pool,
                               tables, pos, active, quant: bool):
-    """``_decode_slots_block`` over the block arena: per-slot positions
-    resolve to a physical (block, row) through each slot's block table.
-    Each layer's K/V is gathered through the tables INSIDE the layer
-    scan — the dense working view exists one layer at a time, not as an
-    [L, B, S] resident tensor — and the new row is written by scatter
-    at its physical address BEFORE the gather, so a slot attends to its
-    own fresh token exactly as the dense path does. Inactive slots'
-    writes are redirected out of range and dropped (the paged analogue
-    of the dense path's masked select); their attention output is
-    garbage over causally-bounded finite rows and is discarded. Mask is
-    purely causal (``ki <= pos``): the serve path never left-pads, and
-    positions past a slot's live prefix — including stale rows behind
-    clamped sentinel table entries — are causally unreachable."""
-    cdt = jnp.dtype(cfg.dtype)
-    b = tokens.shape[0]
-    _l, nb, bs, nkv, hd = pool["k"].shape
-    mb = tables.shape[1]
-    s_view = mb * bs
-    nh = cfg.num_attention_heads
-    g = nh // nkv
-    scale = 1.0 / math.sqrt(hd)
-
-    x = params["embed"].astype(cdt)[tokens[:, None]]  # [B, 1, d]
-
-    # per-slot RoPE at global position pos[b] — op-for-op the dense
-    # decode tick's tables
-    inv_freq = 1.0 / (
-        cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
+    """``_decode_slots_block`` over the block arena — the T=1 special
+    case of the paged verify block (per-layer in-scan gather through
+    the tables, physical (block, row) scatter BEFORE the gather,
+    inactive slots redirected to the out-of-range sentinel and
+    dropped), delegated for the same single-implementation reason as
+    the dense path."""
+    logits, pool = _verify_slots_paged_block(
+        params, cfg, tokens[:, None], pool, tables, pos, active, quant
     )
-    freqs = pos.astype(jnp.float32)[:, None] * inv_freq[None, :]
-    emb = jnp.concatenate([freqs, freqs], axis=-1)
-    cos = jnp.cos(emb)[:, None, None, :].astype(cdt)
-    sin = jnp.sin(emb)[:, None, None, :].astype(cdt)
-
-    def rope(t):
-        half = t.shape[-1] // 2
-        t1, t2 = t[..., :half], t[..., half:]
-        return t * cos + jnp.concatenate([-t2, t1], axis=-1) * sin
-
-    ki = jnp.arange(s_view)
-    ok = ki[None, None, :] <= pos[:, None, None]
-    mask = jnp.where(ok, 0.0, MASK_VALUE)[:, None]        # [B, 1, T=1, S]
-    # physical write address per slot: table[pos // bs] row pos % bs;
-    # inactive slots aim past the arena and the scatter drops them
-    bi = jnp.clip(pos // bs, 0, mb - 1)
-    off = pos % bs
-    phys = jnp.take_along_axis(tables, bi[:, None], axis=1)[:, 0]
-    phys = jnp.where(active > 0, phys, nb)
-    token_valid = active[:, None]
-
-    def layer_body(x, scanned):
-        if quant:
-            layer, pk, pv, pks, pvs = scanned
-        else:
-            layer, pk, pv = scanned
-        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-        q = (h @ layer["wq"].astype(cdt)).reshape(b, 1, nh, hd)
-        k = (h @ layer["wk"].astype(cdt)).reshape(b, 1, nkv, hd)
-        v = (h @ layer["wv"].astype(cdt)).reshape(b, 1, nkv, hd)
-        q = rope(q)
-        k = rope(k)
-        if quant:
-            qk, sk = _quantize_rows(k[:, 0])
-            qv, sv = _quantize_rows(v[:, 0])
-            pk = pk.at[phys, off].set(qk, mode="drop")
-            pv = pv.at[phys, off].set(qv, mode="drop")
-            pks = pks.at[phys, off].set(sk, mode="drop")
-            pvs = pvs.at[phys, off].set(sv, mode="drop")
-            ck = _dequantize_rows(pk[tables], pks[tables], cdt)
-            cv = _dequantize_rows(pv[tables], pvs[tables], cdt)
-        else:
-            pk = pk.at[phys, off].set(k[:, 0].astype(pk.dtype), mode="drop")
-            pv = pv.at[phys, off].set(v[:, 0].astype(pv.dtype), mode="drop")
-            ck, cv = pk[tables], pv[tables]
-        ck = ck.reshape(b, s_view, nkv, hd).astype(cdt)
-        cv = cv.reshape(b, s_view, nkv, hd).astype(cdt)
-
-        qg = q.reshape(b, 1, nkv, g, hd)
-        scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck).astype(jnp.float32)
-        scores = scores * scale + mask[:, :, None]
-        probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
-        attn = jnp.einsum("bkgts,bskd->btkgd", probs, cv).reshape(b, 1, nh * hd)
-        x = x + attn @ layer["wo"].astype(cdt)
-
-        x, _aux = mlp_block(cfg, x, layer, valid=token_valid)
-        if quant:
-            return x, (pk, pv, pks, pvs)
-        return x, (pk, pv)
-
-    if quant:
-        scanned = (params["layers"], pool["k"], pool["v"],
-                   pool["ks"], pool["vs"])
-    else:
-        scanned = (params["layers"], pool["k"], pool["v"])
-    x, out = jax.lax.scan(layer_body, x, scanned)
-    x = rms_norm(x[:, -1], params["final_norm"], cfg.rms_norm_eps)
-    head = params.get("lm_head", None)
-    if head is None:
-        head = params["embed"].T
-    logits = (x @ head.astype(cdt)).astype(jnp.float32)
-    if quant:
-        pool = {"k": out[0], "v": out[1], "ks": out[2], "vs": out[3]}
-    else:
-        pool = {"k": out[0], "v": out[1]}
-    return logits, pool
+    return logits[:, 0], pool
 
 
 @functools.lru_cache(maxsize=8)
@@ -930,5 +800,299 @@ def decode_slots_paged_fn(cfg: LlamaConfig, kv_dtype: str | None = None):
         keys = jax.random.wrap_key_data(key_data)
         nxt = _sample_slots(logits, keys, temperature, top_k, top_p)
         return nxt, pool
+
+    return jax.jit(run, donate_argnums=_serve_donate())
+
+
+# ---------------------------------------------------------------------------
+# Speculative-decoding verification (serve/speculation.py proposes drafts)
+#
+# One compiled forward verifies up to k host-proposed draft tokens per
+# slot per tick: the inputs are [cur_token, d_0..d_{k-1}] at per-slot
+# positions pos..pos+k (the same shape as a prefill chunk — the paged
+# gather/scatter machinery is already built), the program computes
+# logits at ALL k+1 positions, samples each position with the SAME
+# per-step PRNG key schedule the plain tick would have used, and
+# accepts the longest draft prefix whose tokens equal the sampled
+# targets. For a DETERMINISTIC proposal (prompt-lookup is a point mass)
+# this exact-match rule IS rejection sampling: accept d with
+# probability p(d), and on mismatch the emitted token is the target
+# sample conditioned on != d — exactly the residual distribution — so
+# sampled streams are not merely distributionally correct, they are
+# BIT-IDENTICAL to the non-speculative stream (and greedy acceptance
+# is its temperature-0 special case). A tick therefore always emits
+# m+1 tokens per slot (m accepted drafts + the one verified target):
+# all-reject still makes one token of forward progress, and there is
+# no acceptance/parity trade anywhere.
+#
+# Rollback on rejection is cursor arithmetic, not block surgery: K/V
+# rows written for rejected/pad positions land PAST the advanced
+# cursor, inside the slot's own up-front block allocation (or drop at
+# the out-of-range sentinel), and every future tick REWRITES its
+# window [cursor, cursor+T) before any query can read it — a garbage
+# row is overwritten before it is ever causally reachable, the same
+# argument that makes retired-slot rows safe (PR-6 lesson). Blocks are
+# never freed or reallocated mid-request, so rejection cannot leak.
+# ---------------------------------------------------------------------------
+
+
+def _sample_slots_multi(logits, key_data, temperature, top_k, top_p):
+    """``_sample_slots`` over [B, T, V] logits with per-(slot, position)
+    keys [B, T, 2]: rows flatten to B*T and run the IDENTICAL per-row op
+    sequence (every row's sample depends only on its own logits and
+    key), so position j of slot b samples exactly what the plain tick at
+    that step would."""
+    b, t, v = logits.shape
+    keys = jax.random.wrap_key_data(key_data.reshape(b * t, 2))
+    rep = lambda a: jnp.repeat(a, t, axis=0)  # [B] -> [B*T], b-major
+    flat = _sample_slots(
+        logits.reshape(b * t, v), keys, rep(temperature), rep(top_k),
+        rep(top_p),
+    )
+    return flat.reshape(b, t)
+
+
+def _accept_prefix(tokens, sampled, draft_len):
+    """Longest-accepted-prefix + emission count: drafts are
+    ``tokens[:, 1:]`` (position j's draft), targets are
+    ``sampled[:, :-1]`` (the verified token AT position j). ``m`` =
+    leading positions where they agree (pad positions beyond
+    ``draft_len`` never match); the tick emits ``m + 1`` tokens —
+    ``sampled[:, :m]`` (== the accepted drafts) plus ``sampled[:, m]``,
+    the bonus/correction target. Never zero: forward progress every
+    tick."""
+    k = tokens.shape[1] - 1
+    match = (tokens[:, 1:] == sampled[:, :-1]) & (
+        jnp.arange(k)[None, :] < draft_len[:, None]
+    )
+    m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    return m + 1
+
+
+def _verify_slots_block(params, cfg: LlamaConfig, tokens, cache, pos,
+                        key_valid, active):
+    """``_decode_slots_block`` widened to T = k+1 positions per slot:
+    ``tokens`` [B, T] write at per-slot positions ``pos..pos+T-1`` and
+    logits come back for EVERY position (each query's attention is the
+    same reduction the T=1 tick performs — rows past its own position
+    are causally masked, so a T-wide call is bit-identical per row to T
+    single-token ticks over the same cache bits, the chunked-prefill
+    property re-used). Returns (logits [B, T, V] float32, cache)."""
+    cdt = jnp.dtype(cfg.dtype)
+    b, t = tokens.shape
+    s_max = cache["k"].shape[2]
+    nh, nkv, hd = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    g = nh // nkv
+    scale = 1.0 / math.sqrt(hd)
+
+    x = params["embed"].astype(cdt)[tokens]  # [B, T, d]
+
+    qpos = pos[:, None] + jnp.arange(t)[None, :]  # [B, T] global positions
+    inv_freq = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
+    )
+    freqs = qpos.astype(jnp.float32)[..., None] * inv_freq  # [B, T, hd/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)          # [B, T, hd]
+    cos = jnp.cos(emb)[:, :, None, :].astype(cdt)           # [B, T, 1, hd]
+    sin = jnp.sin(emb)[:, :, None, :].astype(cdt)
+
+    def rope(a):  # [B, T, H, hd] rotate-half with per-(slot, position) phases
+        half = a.shape[-1] // 2
+        a1, a2 = a[..., :half], a[..., half:]
+        return a * cos + jnp.concatenate([-a2, a1], axis=-1) * sin
+
+    ki = jnp.arange(s_max)
+    ok = (ki[None, None, :] <= qpos[:, :, None]) & (key_valid[:, None, :] > 0)
+    mask = jnp.where(ok, 0.0, MASK_VALUE)[:, None]          # [B, 1, T, S]
+    token_valid = jnp.broadcast_to(active[:, None], (b, t))
+
+    def layer_body(x, scanned):
+        layer, ck, cv = scanned
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ layer["wq"].astype(cdt)).reshape(b, t, nh, hd)
+        k = (h @ layer["wk"].astype(cdt)).reshape(b, t, nkv, hd)
+        v = (h @ layer["wv"].astype(cdt)).reshape(b, t, nkv, hd)
+        q = rope(q)
+        k = rope(k)
+        # per-row masked writes, one position at a time (T is small and
+        # static): the exact values dynamic_update_slice would write,
+        # dead slots dropped — mid-prefill neighbours must not be
+        # stamped with garbage K/V (the PR-6 inactive-slot lesson)
+        for j in range(t):
+            wr = (
+                (ki[None, :] == (pos + j)[:, None]) & (active[:, None] > 0)
+            )[:, :, None, None]
+            ck = jnp.where(wr, k[:, j][:, None], ck)
+            cv = jnp.where(wr, v[:, j][:, None], cv)
+
+        qg = q.reshape(b, t, nkv, g, hd)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck).astype(jnp.float32)
+        scores = scores * scale + mask[:, :, None]
+        probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
+        attn = jnp.einsum("bkgts,bskd->btkgd", probs, cv).reshape(b, t, nh * hd)
+        x = x + attn @ layer["wo"].astype(cdt)
+
+        x, _aux = mlp_block(cfg, x, layer, valid=token_valid)
+        return x, (ck, cv)
+
+    x, (ck, cv) = jax.lax.scan(
+        layer_body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)  # [B, T, d]
+    head = params.get("lm_head", None)
+    if head is None:
+        head = params["embed"].T
+    logits = (x @ head.astype(cdt)).astype(jnp.float32)      # [B, T, V]
+    return logits, {"k": ck, "v": cv}
+
+
+@functools.lru_cache(maxsize=4)
+def verify_slots_fn(cfg: LlamaConfig):
+    """Jitted ``(params, cache, tokens [B,T], pos [B], draft_len [B],
+    key_valid [B,S], key_data [B,T,2] u32, temperature [B], top_k [B],
+    top_p [B], active [B]) -> (sampled [B,T], counts [B], cache)``: one
+    speculative tick. ``tokens`` = [current token, draft_0..draft_{k-1}]
+    per slot (pads beyond ``draft_len`` are ignored by acceptance);
+    ``counts[b]`` tokens of ``sampled[b]`` are the slot's emission this
+    tick. Retraces once per draft-width bucket T — the engine buckets
+    draft lengths to powers of two, so the compile count stays bounded
+    exactly like the prefill chunk programs."""
+
+    def run(params, cache, tokens, pos, draft_len, key_valid, key_data,
+            temperature, top_k, top_p, active):
+        logits, cache = _verify_slots_block(
+            params, cfg, tokens, cache, pos, key_valid, active
+        )
+        sampled = _sample_slots_multi(
+            logits, key_data, temperature, top_k, top_p
+        )
+        counts = _accept_prefix(tokens, sampled, draft_len)
+        return sampled, counts, cache
+
+    return jax.jit(run, donate_argnums=_serve_donate())
+
+
+def _verify_slots_paged_block(params, cfg: LlamaConfig, tokens, pool,
+                              tables, pos, active, quant: bool):
+    """``_decode_slots_paged_block`` widened to T positions per slot:
+    each of the T new rows scatters at its own physical (block, row)
+    address — a verify window may CROSS a block boundary, so addresses
+    are resolved per position — before the gather, all inside the layer
+    scan. Positions past a slot's allocation hit the sentinel table
+    entry and drop; rejected/pad rows inside the allocation are
+    overwritten by a later tick before the cursor can ever expose them
+    (see the section note above)."""
+    cdt = jnp.dtype(cfg.dtype)
+    b, t = tokens.shape
+    _l, nb, bs, nkv, hd = pool["k"].shape
+    mb = tables.shape[1]
+    s_view = mb * bs
+    nh = cfg.num_attention_heads
+    g = nh // nkv
+    scale = 1.0 / math.sqrt(hd)
+
+    x = params["embed"].astype(cdt)[tokens]  # [B, T, d]
+
+    qpos = pos[:, None] + jnp.arange(t)[None, :]
+    inv_freq = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
+    )
+    freqs = qpos.astype(jnp.float32)[..., None] * inv_freq
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    cos = jnp.cos(emb)[:, :, None, :].astype(cdt)
+    sin = jnp.sin(emb)[:, :, None, :].astype(cdt)
+
+    def rope(a):
+        half = a.shape[-1] // 2
+        a1, a2 = a[..., :half], a[..., half:]
+        return a * cos + jnp.concatenate([-a2, a1], axis=-1) * sin
+
+    ki = jnp.arange(s_view)
+    ok = ki[None, None, :] <= qpos[:, :, None]
+    mask = jnp.where(ok, 0.0, MASK_VALUE)[:, None]          # [B, 1, T, S]
+    # per-(slot, position) physical addresses; inactive slots redirect
+    # past the arena and drop, exactly like the T=1 tick
+    bi = jnp.clip(qpos // bs, 0, mb - 1)                    # [B, T]
+    off = qpos % bs
+    phys = jnp.take_along_axis(tables, bi, axis=1)          # [B, T]
+    phys = jnp.where(active[:, None] > 0, phys, nb)
+    token_valid = jnp.broadcast_to(active[:, None], (b, t))
+
+    def layer_body(x, scanned):
+        if quant:
+            layer, pk, pv, pks, pvs = scanned
+        else:
+            layer, pk, pv = scanned
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ layer["wq"].astype(cdt)).reshape(b, t, nh, hd)
+        k = (h @ layer["wk"].astype(cdt)).reshape(b, t, nkv, hd)
+        v = (h @ layer["wv"].astype(cdt)).reshape(b, t, nkv, hd)
+        q = rope(q)
+        k = rope(k)
+        if quant:
+            qk, sk = _quantize_rows(k)                      # [B, T, ...]
+            qv, sv = _quantize_rows(v)
+            pk = pk.at[phys, off].set(qk, mode="drop")
+            pv = pv.at[phys, off].set(qv, mode="drop")
+            pks = pks.at[phys, off].set(sk, mode="drop")
+            pvs = pvs.at[phys, off].set(sv, mode="drop")
+            ck = _dequantize_rows(pk[tables], pks[tables], cdt)
+            cv = _dequantize_rows(pv[tables], pvs[tables], cdt)
+        else:
+            pk = pk.at[phys, off].set(k.astype(pk.dtype), mode="drop")
+            pv = pv.at[phys, off].set(v.astype(pv.dtype), mode="drop")
+            ck, cv = pk[tables], pv[tables]
+        ck = ck.reshape(b, s_view, nkv, hd).astype(cdt)
+        cv = cv.reshape(b, s_view, nkv, hd).astype(cdt)
+
+        qg = q.reshape(b, t, nkv, g, hd)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck).astype(jnp.float32)
+        scores = scores * scale + mask[:, :, None]
+        probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
+        attn = jnp.einsum("bkgts,bskd->btkgd", probs, cv).reshape(b, t, nh * hd)
+        x = x + attn @ layer["wo"].astype(cdt)
+
+        x, _aux = mlp_block(cfg, x, layer, valid=token_valid)
+        if quant:
+            return x, (pk, pv, pks, pvs)
+        return x, (pk, pv)
+
+    if quant:
+        scanned = (params["layers"], pool["k"], pool["v"],
+                   pool["ks"], pool["vs"])
+    else:
+        scanned = (params["layers"], pool["k"], pool["v"])
+    x, out = jax.lax.scan(layer_body, x, scanned)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head", None)
+    if head is None:
+        head = params["embed"].T
+    logits = (x @ head.astype(cdt)).astype(jnp.float32)
+    if quant:
+        pool = {"k": out[0], "v": out[1], "ks": out[2], "vs": out[3]}
+    else:
+        pool = {"k": out[0], "v": out[1]}
+    return logits, pool
+
+
+@functools.lru_cache(maxsize=8)
+def verify_slots_paged_fn(cfg: LlamaConfig, kv_dtype: str | None = None):
+    """Paged twin of ``verify_slots_fn``: jitted ``(params, pool,
+    tables [B, max_blocks] i32, tokens [B,T], pos [B], draft_len [B],
+    key_data [B,T,2] u32, temperature [B], top_k [B], top_p [B],
+    active [B]) -> (sampled [B,T], counts [B], pool)`` — one
+    speculative tick through the block arena."""
+    quant = kv_dtype == "int8"
+
+    def run(params, pool, tables, tokens, pos, draft_len, key_data,
+            temperature, top_k, top_p, active):
+        logits, pool = _verify_slots_paged_block(
+            params, cfg, tokens, pool, tables, pos, active, quant
+        )
+        sampled = _sample_slots_multi(
+            logits, key_data, temperature, top_k, top_p
+        )
+        counts = _accept_prefix(tokens, sampled, draft_len)
+        return sampled, counts, pool
 
     return jax.jit(run, donate_argnums=_serve_donate())

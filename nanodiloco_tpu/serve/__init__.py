@@ -7,6 +7,11 @@ Layers (each usable alone):
   compiled step advancing all live slots per tick.
 - ``prefix_cache.PrefixCache`` — chunk-granular content-keyed LRU over
   prompt-prefix K/V (the system-prompt case prefills once).
+- ``speculation.PromptLookupProposer`` — host-side prompt-lookup draft
+  proposer for speculative decoding (``spec_k``): n-gram drafts from
+  the request's own prompt+output, verified by one batched forward
+  per tick; greedy and sampled streams stay bit-identical to solo
+  ``generate()`` (exact acceptance).
 - ``scheduler.Scheduler`` — SLO-aware admission (priority classes, EDF
   within a class, starvation bound) with backpressure, slot
   allocation, deadlines, and one-prefill-chunk-per-tick interleaving;
@@ -27,8 +32,10 @@ from nanodiloco_tpu.serve.scheduler import (
     Ticket,
 )
 from nanodiloco_tpu.serve.server import ServeServer
+from nanodiloco_tpu.serve.speculation import PromptLookupProposer
 
 __all__ = [
+    "PromptLookupProposer",
     "BlockPool",
     "BlocksExhausted",
     "InferenceEngine",

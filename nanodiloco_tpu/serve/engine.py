@@ -39,11 +39,21 @@ A request's life:
   prompt length. Sampling is FUSED into the chunk program: a final
   chunk is one dispatch doing attention+sampling, never
   attention-then-sample.
-- every ``step()`` advances ALL decoding slots one token with a single
-  compiled program (per-slot positions, PRNG keys, and sampling params
-  ride as traced arrays; sampling fused into the same executable) —
-  admitting a new request or retiring a finished one never recompiles
-  and never stops the other streams.
+- every ``step()`` advances ALL decoding slots with a single compiled
+  program and returns per-slot token VECTORS (per-slot positions, PRNG
+  keys, and sampling params ride as traced arrays; sampling fused into
+  the same executable) — admitting a new request or retiring a
+  finished one never recompiles and never stops the other streams.
+  Without speculation every live slot emits exactly one token; with
+  ``spec_k > 0`` host-proposed prompt-lookup drafts
+  (serve/speculation.py) are verified by one forward over k+1
+  positions per slot and each slot emits its longest accepted prefix
+  plus the verified bonus token — 1..k+1 tokens, never zero. Exact
+  acceptance (accept a draft iff it equals the token the plain tick
+  would have sampled with the same per-step key) keeps EVERY stream —
+  greedy and sampled — bit-identical to solo ``generate()``; for the
+  deterministic prompt-lookup proposal this rule coincides with
+  rejection sampling, so it costs no acceptance either.
 - ``release(slot)`` frees the row (mid-prefill or mid-decode). Nothing
   is zeroed: a retired slot's stale K/V is causally unreachable to the
   next occupant. In paged mode every block the slot referenced is
@@ -103,16 +113,23 @@ from nanodiloco_tpu.models.generate import (
     kv_bytes_per_token,
     prefill_chunk_fn,
     prefill_chunk_paged_fn,
+    verify_slots_fn,
+    verify_slots_paged_fn,
 )
 from nanodiloco_tpu.obs.telemetry import Histogram
 from nanodiloco_tpu.serve.block_pool import BlockPool, BlocksExhausted
 from nanodiloco_tpu.serve.prefix_cache import PrefixCache
+from nanodiloco_tpu.serve.speculation import PromptLookupProposer
 
 __all__ = ["InferenceEngine", "BlocksExhausted"]
 
 # blocks-held-per-request histogram bounds (requests, not seconds —
 # powers of two up to a long request's worst case)
 _BLOCK_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+# emitted-tokens-per-tick histogram bounds (tokens; a spec tick emits
+# 1..spec_k+1 per slot)
+_SPEC_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16)
 
 
 def _floor_pow2(n: int) -> int:
@@ -152,6 +169,8 @@ class InferenceEngine:
         kv_block_size: int = 0,
         kv_dtype: str | None = None,
         kv_pool_blocks: int | None = None,
+        spec_k: int = 0,
+        spec_ngram: int = 3,
     ) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1; got {num_slots}")
@@ -174,6 +193,8 @@ class InferenceEngine:
                 "int8 KV storage requires the paged cache; pass "
                 "kv_block_size > 0"
             )
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0; got {spec_k}")
         self.params = params
         self.cfg = cfg
         self.num_slots = int(num_slots)
@@ -243,6 +264,32 @@ class InferenceEngine:
             )
             if prefix_cache_tokens else None
         )
+        # speculative decoding (spec_k > 0): host-side prompt-lookup
+        # drafts (serve/speculation.py) verified by ONE compiled forward
+        # over k+1 positions per slot. Draft widths bucket to powers of
+        # two, so the verify program set is bounded like the chunk set;
+        # a tick with no drafts anywhere falls back to the plain decode
+        # program, so adversarial traffic pays only the (host) lookup.
+        self.spec_k = int(spec_k)
+        self.spec_ngram = int(spec_ngram)
+        if self.spec_k:
+            self.speculator = PromptLookupProposer(
+                self.spec_k, max_ngram=self.spec_ngram
+            )
+            self._verify = (
+                verify_slots_paged_fn(cfg, self.kv_dtype) if self.paged
+                else verify_slots_fn(cfg)
+            )
+        else:
+            self.speculator = None
+            self._verify = None
+        self._spec_ok = [False] * self.num_slots   # per-slot opt-in state
+        self.spec_draft_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_rejected_tokens = 0
+        self.spec_ticks = 0                        # ticks that ran verify
+        self.decode_ticks = 0                      # every decode tick
+        self.hist_spec_tokens_per_tick = Histogram(_SPEC_BUCKETS)
 
         s = self.max_len
         self._tokens = np.zeros(b, np.int32)       # next input token per slot
@@ -491,6 +538,13 @@ class InferenceEngine:
         self._topk[slot] = top_k
         self._topp[slot] = top_p
         self._active[slot] = 1
+        # speculation is per-request opt-in (``GenRequest.speculate``):
+        # the proposer only ever sees opted-in slots
+        self._spec_ok[slot] = bool(self.spec_k) and bool(
+            getattr(req, "speculate", True)
+        )
+        if self._spec_ok[slot]:
+            self.speculator.begin(slot, ids, tok0)
         self._dev = None  # slot state changed: re-stage on the next step
 
         self._prefills[slot] = None
@@ -537,18 +591,10 @@ class InferenceEngine:
             if tok is not None:
                 return tok
 
-    def step(self) -> np.ndarray:
-        """Advance every live slot one token (one compiled tick,
-        sampling fused in). Returns the [B] sampled tokens; entries for
-        inactive slots are meaningless."""
-        b = self.num_slots
-        keys_now = np.empty((b, 2), np.uint32)
-        for s in range(b):
-            ks = self._keys[s]
-            if self._active[s] and ks is not None and self._step_idx[s] < len(ks):
-                keys_now[s] = ks[self._step_idx[s]]
-            else:
-                keys_now[s] = self._dummy_key
+    def _stage_dev(self) -> dict:
+        """Device-resident slot state that only changes at admit/release
+        (uploading key_valid/tables every tick would put an H2D copy on
+        the per-token path)."""
         if self._dev is None:
             self._dev = {
                 "temp": jnp.asarray(self._temp),
@@ -560,29 +606,248 @@ class InferenceEngine:
                 self._dev["tables"] = jnp.asarray(self._tables)
             else:
                 self._dev["key_valid"] = jnp.asarray(self._key_valid)
+        return self._dev
+
+    def _collect_drafts(self) -> tuple[list[list[int]], int]:
+        """Ask the proposer for each live opted-in slot's drafts, capped
+        so the tick can never emit past the request's key schedule
+        (emitted <= draft_len + 1 <= remaining). Returns (per-slot draft
+        lists, max draft length this tick)."""
+        drafts: list[list[int]] = [[] for _ in range(self.num_slots)]
+        k_tick = 0
+        new_tick = getattr(self.speculator, "new_tick", None)
+        if new_tick is not None:
+            new_tick()
+        for s in range(self.num_slots):
+            if not self._active[s] or not self._spec_ok[s]:
+                continue
+            ks = self._keys[s]
+            # keys has max_new - 1 entries; position j of the verify
+            # window consumes key[step_idx + j], so the last legal draft
+            # index is len(keys) - step_idx - 1 (the +1 bonus token then
+            # lands exactly on the request's final step)
+            cap = min(self.spec_k, len(ks) - self._step_idx[s] - 1)
+            if cap <= 0:
+                continue
+            d = list(self.speculator.propose(s, cap))[:cap]
+            if d:
+                drafts[s] = [int(t) for t in d]
+                k_tick = max(k_tick, len(d))
+        return drafts, k_tick
+
+    def step(self) -> list[list[int]]:
+        """Advance every live slot 1..spec_k+1 tokens (one compiled
+        tick, sampling fused in). Returns per-slot emitted-token lists
+        (empty for inactive slots). Without speculation — or on a tick
+        where no slot has a draft — every live slot emits exactly one
+        token via the plain decode program; with drafts in flight, ONE
+        verify dispatch covers every slot and each emits its accepted
+        prefix plus the verified bonus token (never zero: all-reject
+        still makes one token of forward progress)."""
+        b = self.num_slots
+        drafts, k_tick = (
+            self._collect_drafts() if self.spec_k
+            else ([[] for _ in range(b)], 0)
+        )
+        self.decode_ticks += 1
+        if k_tick == 0:
+            return self._step_plain()
+        return self._step_verify(drafts, k_tick)
+
+    def _step_plain(self) -> list[list[int]]:
+        b = self.num_slots
+        keys_now = np.empty((b, 2), np.uint32)
+        for s in range(b):
+            ks = self._keys[s]
+            if self._active[s] and ks is not None and self._step_idx[s] < len(ks):
+                keys_now[s] = ks[self._step_idx[s]]
+            else:
+                keys_now[s] = self._dummy_key
+        dev = self._stage_dev()
         if self.paged:
             nxt, self.pool = self._decode_paged(
-                self.params, self.pool, self._dev["tables"],
+                self.params, self.pool, dev["tables"],
                 jnp.asarray(self._tokens), jnp.asarray(self._pos),
                 jnp.asarray(keys_now),
-                self._dev["temp"], self._dev["topk"],
-                self._dev["topp"], self._dev["active"],
+                dev["temp"], dev["topk"], dev["topp"], dev["active"],
             )
         else:
             nxt, self.cache = self._decode(
                 self.params, self.cache,
                 jnp.asarray(self._tokens), jnp.asarray(self._pos),
-                self._dev["key_valid"], jnp.asarray(keys_now),
-                self._dev["temp"], self._dev["topk"],
-                self._dev["topp"], self._dev["active"],
+                dev["key_valid"], jnp.asarray(keys_now),
+                dev["temp"], dev["topk"], dev["topp"], dev["active"],
             )
         nxt = np.asarray(nxt)
+        out: list[list[int]] = []
         for s in range(b):
             if self._active[s]:
                 self._pos[s] += 1
                 self._step_idx[s] += 1
                 self._tokens[s] = nxt[s]
-        return nxt
+                tok = int(nxt[s])
+                if self._spec_ok[s]:
+                    self.speculator.observe(s, [tok])
+                out.append([tok])
+            else:
+                out.append([])
+        return out
+
+    def _step_verify(self, drafts: list[list[int]], k_tick: int) -> list[list[int]]:
+        """One speculative tick: verify up to ``k_tick`` drafts per slot
+        (bucketed to a power of two — bounded verify-program set) in a
+        single forward over k+1 positions, emit each slot's longest
+        accepted prefix + bonus token, and advance cursors by the
+        emission count. Rejected positions' K/V rows sit PAST the
+        advanced cursor inside the slot's own allocation (or dropped at
+        the table sentinel) and are rewritten by a later tick before any
+        query can reach them — rollback is cursor arithmetic, with
+        nothing to free and nothing leakable."""
+        b = self.num_slots
+        bucket = min(_ceil_pow2(k_tick), self.spec_k)
+        t = bucket + 1
+        tokens = np.zeros((b, t), np.int32)
+        tokens[:, 0] = self._tokens
+        dlen = np.zeros(b, np.int32)
+        keys_now = np.empty((b, t, 2), np.uint32)
+        keys_now[:] = self._dummy_key
+        for s in range(b):
+            d = drafts[s][:bucket]
+            if d:
+                tokens[s, 1:1 + len(d)] = d
+                dlen[s] = len(d)
+            ks = self._keys[s]
+            if self._active[s] and ks is not None:
+                lo = self._step_idx[s]
+                n = min(t, len(ks) - lo)
+                if n > 0:
+                    keys_now[s, :n] = ks[lo:lo + n]
+        dev = self._stage_dev()
+        args = (
+            jnp.asarray(tokens), jnp.asarray(self._pos),
+            jnp.asarray(dlen), jnp.asarray(keys_now),
+            dev["temp"], dev["topk"], dev["topp"], dev["active"],
+        )
+        if self.paged:
+            sampled, counts, self.pool = self._verify(
+                self.params, self.pool, dev["tables"], *args,
+            )
+        else:
+            sampled, counts, self.cache = self._verify(
+                self.params, self.cache, args[0], args[1], args[2],
+                dev["key_valid"], *args[3:],
+            )
+        sampled = np.asarray(sampled)
+        counts = np.asarray(counts)
+        out: list[list[int]] = []
+        for s in range(b):
+            if not self._active[s]:
+                out.append([])
+                continue
+            c = int(counts[s])
+            emitted = [int(v) for v in sampled[s, :c]]
+            self._pos[s] += c
+            self._step_idx[s] += c
+            self._tokens[s] = emitted[-1]
+            proposed = int(dlen[s])
+            accepted = c - 1
+            self.spec_draft_tokens += proposed
+            self.spec_accepted_tokens += accepted
+            self.spec_rejected_tokens += proposed - accepted
+            if proposed:
+                # drafting slots only: a no-draft neighbour riding the
+                # verify tick emits 1 by construction, and counting it
+                # would make the gated tokens-per-tick economics measure
+                # batch composition instead of speculation quality
+                self.hist_spec_tokens_per_tick.observe(c)
+            if self._spec_ok[s]:
+                if proposed:
+                    self.speculator.feedback(s, proposed, accepted)
+                self.speculator.observe(s, emitted)
+            out.append(emitted)
+        self.spec_ticks += 1
+        return out
+
+    def warm_spec(self) -> int:
+        """Compile every verify-program bucket before traffic arrives
+        (spec_k buckets the draft width to powers of two; each bucket
+        is one executable). Drives a throwaway greedy request through
+        slot 0 with a scripted proposer that walks the bucket widths,
+        then releases it — nothing observable leaks (no prefix-cache
+        insert, blocks returned). Requires an idle engine (call at
+        startup, before the tick loop owns the slots). Returns the
+        number of buckets warmed; no-op without speculation."""
+        if not self.spec_k:
+            return 0
+        if any(self._active) or any(p is not None for p in self._prefills):
+            raise RuntimeError("warm_spec needs an idle engine")
+        # widest first: the cap arithmetic (len(keys) - step_idx - 1)
+        # shrinks as the throwaway stream advances, so the width that
+        # needs the most headroom goes while headroom is maximal
+        widths = sorted({
+            min(_ceil_pow2(k), self.spec_k)
+            for k in range(1, self.spec_k + 1)
+        }, reverse=True)
+
+        class _Ramp:
+            """Proposes exactly ``self.k`` junk drafts per tick."""
+
+            def __init__(self, vocab: int) -> None:
+                self.k = 0
+                self.tok = vocab - 1
+
+            def begin(self, *a):
+                pass
+
+            def release(self, *a):
+                pass
+
+            def propose(self, slot, cap):
+                return [self.tok] * min(self.k, cap)
+
+            def observe(self, *a):
+                pass
+
+            def feedback(self, *a):
+                pass
+
+        from nanodiloco_tpu.serve.scheduler import GenRequest
+
+        prompt_len = min(8, self.max_len // 2)
+        req = GenRequest(
+            prompt=(1,) * prompt_len,
+            max_new_tokens=max(2, min(
+                (self.spec_k + 2) * len(widths), self.max_len - prompt_len,
+            )),
+            prefix_cache=False,
+        )
+        saved = self.speculator
+        ramp = _Ramp(self.vocab_size)
+        self.speculator = ramp
+        try:
+            self.prefill(0, req)
+            self._spec_ok[0] = True
+            for w in widths:
+                ramp.k = w
+                self.step()
+        finally:
+            self.speculator = saved
+            self.release(0)
+            # the ramp's ticks are warmup, not traffic: /metrics must
+            # never report them
+            self.reset_spec_stats()
+        return len(widths)
+
+    def reset_spec_stats(self) -> None:
+        """Zero the speculation counters and histogram — warmup traffic
+        (warm_spec's ramp, a bench's compile-warming request) must not
+        leak into a measured window or the gauges."""
+        self.spec_draft_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_rejected_tokens = 0
+        self.spec_ticks = 0
+        self.decode_ticks = 0
+        self.hist_spec_tokens_per_tick = Histogram(_SPEC_BUCKETS)
 
     def release(self, slot: int) -> None:
         self._active[slot] = 0
@@ -590,7 +855,18 @@ class InferenceEngine:
         self._keys[slot] = None
         self._pos[slot] = 0
         self._tokens[slot] = 0
+        # reset sampling params too: _sample_slots' batch-level cond
+        # fast paths (all-greedy -> argmax only; no top-k/p -> no vocab
+        # sorts) test jnp.any over the WHOLE row set, and one retired
+        # sampled request's stale temperature would otherwise pin every
+        # later all-greedy tick onto the slow branch
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        self._topp[slot] = 1.0
         self._prefills[slot] = None
+        if self._spec_ok[slot]:
+            self.speculator.release(slot)
+        self._spec_ok[slot] = False
         if self.paged:
             blocks = self._slot_blocks[slot]
             if blocks:
@@ -633,6 +909,35 @@ class InferenceEngine:
             "hist_blocks_per_request": self.hist_blocks_per_request.snapshot(),
         }
 
+    def spec_stats(self) -> dict | None:
+        """Speculative-decoding counters for /metrics and the stats
+        JSONL (None with speculation off). ``acceptance_rate`` is
+        accepted/drafted over the engine's whole life;
+        ``tokens_per_tick_mean`` averages emitted tokens over
+        SPECULATIVE ticks (the histogram carries the distribution)."""
+        if not self.spec_k:
+            return None
+        drafted = self.spec_draft_tokens
+        hist = self.hist_spec_tokens_per_tick.snapshot()
+        return {
+            "spec_k": self.spec_k,
+            "spec_ngram": self.spec_ngram,
+            "draft_tokens": drafted,
+            "accepted_tokens": self.spec_accepted_tokens,
+            "rejected_tokens": self.spec_rejected_tokens,
+            "acceptance_rate": (
+                round(self.spec_accepted_tokens / drafted, 4)
+                if drafted else None
+            ),
+            "spec_ticks": self.spec_ticks,
+            "decode_ticks": self.decode_ticks,
+            "tokens_per_tick_mean": (
+                round(hist["sum"] / hist["count"], 4)
+                if hist["count"] else None
+            ),
+            "hist_tokens_per_tick": hist,
+        }
+
     def compile_counts(self) -> dict:
         """Compiled-executable counts per program — the bounded-compile
         contract is testable, not folklore: chunk programs are capped by
@@ -652,6 +957,7 @@ class InferenceEngine:
                 self._chunk_paged if self.paged else self._chunk
             ),
             "decode": size(self._decode_paged if self.paged else self._decode),
+            "verify": size(self._verify),
             "extract": size(self._extract),
             "insert": size(self._insert),
         }

@@ -45,10 +45,14 @@ Tick anatomy (one call, strictly ordered, deterministic):
 4. run ONE prefill chunk for the neediest mid-prefill slot; a final
    chunk yields the request's first token (it may also finish it
    outright: stop token or ``max_new_tokens == 1``);
-5. if any slot is decoding, ONE decode step advances them all; finished
-   slots (stop token / length / deadline) are retired and their slots
-   are free for the next tick's admission pass — requests join and
-   leave the batch mid-stream, there is no barrier between requests.
+5. if any slot is decoding, ONE decode step advances them all; the
+   backend returns a token VECTOR per slot (one token without
+   speculation, up to k+1 with it — never zero), delivered in order
+   with the stop token and length bound scanned WITHIN the vector;
+   finished slots (stop token / length / deadline) are retired and
+   their slots are free for the next tick's admission pass — requests
+   join and leave the batch mid-stream, there is no barrier between
+   requests. Decode stats count EMITTED tokens, not ticks.
 
 Threading: ``submit`` may be called from any thread (the HTTP handlers);
 ``tick`` must be called from exactly one thread. The queue is the only
@@ -64,6 +68,8 @@ import dataclasses
 import threading
 import time
 from typing import Callable
+
+import numpy as np
 
 from nanodiloco_tpu.obs import flightrec
 from nanodiloco_tpu.obs.telemetry import Histogram, nearest_rank_percentile
@@ -85,7 +91,11 @@ class GenRequest:
     class (0 = most urgent; admission is EDF within a class; default 1
     = normal, best-effort traffic should use a higher number).
     ``prefix_cache`` opts this request out of shared-prefix KV reuse
-    (both reading and populating) when False. ``request_id`` is an
+    (both reading and populating) when False. ``speculate`` opts this
+    request out of speculative decoding when False (it decodes one
+    token per tick even on an engine with ``spec_k > 0``; greedy and
+    sampled streams are bit-identical either way — the opt-out is a
+    latency/fairness knob, not a correctness one). ``request_id`` is an
     optional client-supplied correlation id echoed in the result (and
     stamped on the request's trace spans); absent, the scheduler
     derives one from its rid so client logs, serve spans, and
@@ -102,6 +112,7 @@ class GenRequest:
     request_id: str | None = None
     priority: int = 1
     prefix_cache: bool = True
+    speculate: bool = True
 
 
 class Ticket:
@@ -446,7 +457,15 @@ class Scheduler:
                     self._slots[s] = None
                     self._retire(live, reason, t_first)
 
-        # 5. one decode step for everyone live
+        # 5. one decode step for everyone live. The backend emits a
+        # token VECTOR per slot (1..k+1 under speculative decoding;
+        # legacy/fake backends may still return one scalar per slot):
+        # tokens are delivered in order, scanning for the stop token
+        # and the length bound WITHIN the vector — a draft window that
+        # sails past EOS must not leak post-stop tokens into the
+        # result. Decode stats count EMITTED tokens, not ticks: at one
+        # token per tick the two were equal, so the old tick count was
+        # latently wrong the moment multi-token emission landed.
         live = [
             s for s in range(len(self._slots))
             if isinstance(self._slots[s], _Running)
@@ -457,12 +476,28 @@ class Scheduler:
             t1 = self._clock()
             self._decode_s += t1 - t0
             self.hist_decode_tick.observe(t1 - t0)
-            self._tokens_out += len(live)
-            self._decode_tokens += len(live)
             for s in live:
                 run = self._slots[s]
-                run.tokens.append(int(toks[s]))
-                reason = self._finish_reason(run, t1)
+                vec = toks[s]
+                if not isinstance(vec, (list, tuple, np.ndarray)):
+                    vec = [vec]  # scalar-per-slot backends
+                req = run.request
+                reason = None
+                emitted = 0
+                for tok in vec:
+                    run.tokens.append(int(tok))
+                    emitted += 1
+                    if (req.stop_token is not None
+                            and run.tokens[-1] == req.stop_token):
+                        reason = "stop"
+                        break
+                    if len(run.tokens) >= req.max_new_tokens:
+                        reason = "length"
+                        break
+                self._tokens_out += emitted
+                self._decode_tokens += emitted
+                if reason is None:
+                    reason = self._finish_reason(run, t1)
                 if reason is not None:
                     self._backend_release(s)
                     self._slots[s] = None
@@ -657,6 +692,10 @@ class Scheduler:
             "admission_blocked_no_blocks": self._blocked_no_blocks,
             "tokens_out": self._tokens_out,
             "decode_s": self._decode_s,
+            # EMITTED decode tokens (multi-token speculative ticks
+            # included), not ticks x slots — the rate a client actually
+            # receives tokens at
+            "decode_tokens": self._decode_tokens,
             "decode_tokens_per_sec": (
                 self._decode_tokens / self._decode_s
                 if self._decode_s > 0 else None
@@ -683,4 +722,9 @@ class Scheduler:
             kv = kv_stats()
             if kv is not None:
                 out["kv_pool"] = kv
+        spec_stats = getattr(self.backend, "spec_stats", None)
+        if spec_stats is not None:
+            spec = spec_stats()
+            if spec is not None:
+                out["spec"] = spec
         return out

@@ -307,6 +307,11 @@ class ServeServer:
             raise ValueError(
                 f"prefix_cache must be a boolean; got {prefix_cache!r}"
             )
+        speculate = doc.get("speculate", True)
+        if not isinstance(speculate, bool):
+            raise ValueError(
+                f"speculate must be a boolean; got {speculate!r}"
+            )
         deadline = doc.get("deadline_s", self._default_deadline_s)
         # reject impossible shapes at submit time (400), not in the loop
         backend = self._scheduler.backend
@@ -324,6 +329,7 @@ class ServeServer:
             request_id=request_id,
             priority=priority,
             prefix_cache=prefix_cache,
+            speculate=speculate,
         )
 
     # -- observability -------------------------------------------------------
@@ -429,6 +435,40 @@ class ServeServer:
                     "nanodiloco_kv_blocks_per_request", "histogram",
                     "KV blocks a request held over its life (observed "
                     "at release)", hist,
+                ))
+        # speculative decoding: the draft/accept economics — the
+        # acceptance-rate gauge is what says whether speculation is
+        # earning its verify overhead on the live traffic mix
+        spec = s.get("spec")
+        if spec is not None:
+            families.append((
+                "nanodiloco_spec_draft_tokens", "counter",
+                "draft tokens proposed by prompt-lookup speculation",
+                [(None, spec["draft_tokens"])],
+            ))
+            families.append((
+                "nanodiloco_spec_accepted", "counter",
+                "draft tokens accepted by batched verification",
+                [(None, spec["accepted_tokens"])],
+            ))
+            families.append((
+                "nanodiloco_spec_rejected", "counter",
+                "draft tokens rejected by batched verification",
+                [(None, spec["rejected_tokens"])],
+            ))
+            if spec.get("acceptance_rate") is not None:
+                families.append((
+                    "nanodiloco_spec_acceptance_rate", "gauge",
+                    "accepted / drafted over the engine's life",
+                    [(None, spec["acceptance_rate"])],
+                ))
+            hist = spec.get("hist_tokens_per_tick")
+            if hist is not None:
+                families.append((
+                    "nanodiloco_spec_tokens_per_tick", "histogram",
+                    "tokens emitted per DRAFTING slot per speculative "
+                    "tick (accepted prefix + the verified bonus token)",
+                    hist,
                 ))
         # shared-prefix KV cache: the counters that tell an operator
         # whether the system-prompt traffic is actually being reused

@@ -366,6 +366,21 @@ def summarize_run(path: str) -> dict[str, Any]:
                     "admission_blocked_no_blocks"):
             if last.get(key) is not None:
                 out[f"serve_{key}"] = last[key]
+        # speculative decoding (spec_k > 0 serves): draft/accept
+        # economics, same keys as the /metrics families — absent from
+        # older JSONLs, whose summaries are unchanged
+        spec = last.get("spec")
+        if isinstance(spec, dict):
+            for key, out_key in (
+                ("draft_tokens", "spec_draft_tokens"),
+                ("accepted_tokens", "spec_accepted_tokens"),
+                ("rejected_tokens", "spec_rejected_tokens"),
+                ("acceptance_rate", "spec_acceptance_rate"),
+                ("tokens_per_tick_mean", "spec_tokens_per_tick"),
+                ("spec_ticks", "spec_ticks"),
+            ):
+                if spec.get(key) is not None:
+                    out[out_key] = spec[key]
     # goodput ledger (obs/goodput): stitch the per-lifetime snapshots —
     # a supervised crash-loopy run appends several lifetimes to ONE
     # JSONL, and the honest number is the merged fraction including the
@@ -437,6 +452,16 @@ _COMPARE_METRICS = [
     # fixed budget. Gated only when both summaries carry them.
     ("kv_hbm_bytes_per_token", True),
     ("max_concurrent_slots", False),
+    # speculative decoding (serve_bench --workload repetitive): the
+    # speedup on lookup-friendly traffic must not erode, acceptance and
+    # emitted tokens/tick must not collapse, AND the adversarial
+    # (no-accept) workload's spec-on/spec-off ratio must not sink —
+    # both directions of the speculation contract. Gated only when
+    # both summaries carry them.
+    ("spec_speedup", False),
+    ("spec_acceptance_rate", False),
+    ("spec_tokens_per_tick", False),
+    ("spec_adversarial_ratio", False),
     # sync-vs-async outer-sync shares from the overlap bench differencing
     # (scripts/streaming_overlap.py / bench.py BENCH_ASYNC): the fraction
     # of a warm round the outer boundary costs in each mode. Shares are

@@ -44,6 +44,13 @@ ceremony:
      takes copy-on-write hits, the block-pool gauges scrape over the
      wire, and an fp-paged stream is replayed through solo
      ``generate()`` on the same backend for bit-parity.
+  9. a speculative-decoding drill: the `serve` CLI with prompt-lookup
+     speculation (--spec-k) under greedy repetitive traffic — the
+     draft/accept counters must prove real acceptance on the live
+     backend, the spec gauges scrape over the wire, and the
+     speculative stream is replayed through solo ``generate()`` for
+     bit-parity (the CPU record pins correctness + acceptance; this
+     sitting pins the on-chip speedup).
 
 Usage (each phase also runs alone):
     python scripts/chip_agenda.py               # everything
@@ -1424,6 +1431,180 @@ def phase_kv_paging() -> None:
     })
 
 
+def phase_spec_decode() -> None:
+    """Speculative-decoding drill on this backend: serve a tiny trained
+    checkpoint with prompt-lookup speculation enabled (--spec-k), drive
+    greedy repetitive traffic (the templated shape where lookup
+    accepts), assert the draft/accept counters prove REAL acceptance on
+    the live backend, scrape the spec gauges off /metrics over the
+    wire, then — after the server releases the chip — replay the spec
+    stream through solo ``generate()`` on the SAME backend and assert
+    bit-parity. The CPU tests pin the same contracts; this phase proves
+    the verify programs compile, accept, and hold parity on the real
+    accelerator — and its timed leg is what turns the CPU-pinned
+    speedup claim into an on-chip number."""
+    import socket
+    import tempfile
+
+    from nanodiloco_tpu.obs.telemetry import parse_metrics_text
+    from nanodiloco_tpu.serve.client import http_get, http_post_json
+
+    tmp = tempfile.mkdtemp(prefix="nanodiloco-spec-decode-")
+    ckpt = os.path.join(tmp, "ckpt")
+    model_cfg = os.path.join(tmp, "model.json")
+    with open(model_cfg, "w") as f:
+        json.dump({
+            "vocab_size": 2048, "hidden_size": 128, "intermediate_size": 256,
+            "num_attention_heads": 4, "num_hidden_layers": 2,
+            "max_position_embeddings": 256,
+        }, f)
+    budget = float(os.environ.get("NANODILOCO_AGENDA_TIMEOUT_SPEC_DECODE",
+                                  "900"))
+    train = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu",
+         "--total-steps", "4", "--inner-steps", "2",
+         "--batch-size", "8", "--per-device-batch-size", "4",
+         "--seq-length", "256", "--warmup-steps", "2",
+         "--llama-config-file", model_cfg, "--no-measure-comm",
+         "--no-cost-analysis", "--quiet",
+         "--checkpoint-dir", ckpt, "--log-dir", tmp,
+         "--run-name", "spec-decode-probe"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=budget * 0.4,
+    )
+    if train.returncode != 0:
+        record({"phase": "spec_decode",
+                "error": (train.stderr or train.stdout)[-400:]})
+        raise SystemExit(1)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nanodiloco_tpu", "serve",
+         "--checkpoint-dir", ckpt, "--port", str(port),
+         "--host", "127.0.0.1", "--slots", "2", "--max-len", "192",
+         "--max-new-tokens-cap", "96", "--chunk-size", "16",
+         "--spec-k", "4", "--spec-ngram", "3"],
+        cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+    def get(path):
+        return http_get(f"http://127.0.0.1:{port}{path}", timeout=5)
+
+    def post(doc, timeout=300):
+        return http_post_json(
+            f"http://127.0.0.1:{port}/v1/generate", doc, timeout=timeout
+        )
+
+    # greedy + repetitive (templated pattern x3 + unique tail): the
+    # traffic prompt-lookup exists for — greedy continuations
+    # self-repeat, so drafts accept on the live backend
+    pattern = [(i * 37 + 11) % 256 for i in range(8)]
+    spec_doc = {
+        "token_ids": pattern * 3 + [5, 7],
+        "max_new_tokens": 64, "temperature": 0.0,
+        "seed": 7, "stop": False, "prefix_cache": False,
+    }
+    try:
+        deadline = time.time() + budget * 0.3
+        up = False
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                up = get("/healthz")[0] == 200
+            except OSError:
+                up = False
+            if up:
+                break
+            time.sleep(0.3)
+        if not up:
+            record({"phase": "spec_decode",
+                    "error": "server never answered /healthz"})
+            raise SystemExit(1)
+        # warmup: compile the prefill buckets + plain decode outside
+        # the assertion window (the verify buckets precompiled at boot
+        # via the engine's warm_spec)
+        code, out = post({"token_ids": list(range(2, 20)),
+                          "max_new_tokens": 2, "stop": False,
+                          "prefix_cache": False, "speculate": False})
+        if code != 200:
+            record({"phase": "spec_decode",
+                    "error": f"warmup failed {code}: {out.get('error')}"})
+            raise SystemExit(1)
+        code, out = post(spec_doc)
+        if code != 200:
+            record({"phase": "spec_decode",
+                    "error": f"spec request failed {code}: "
+                             f"{out.get('error')}"})
+            raise SystemExit(1)
+        served_stream = out["token_ids"]
+        m = parse_metrics_text(get("/metrics")[1])
+        drafted = m.get("nanodiloco_spec_draft_tokens_total", 0)
+        accepted = m.get("nanodiloco_spec_accepted_total", 0)
+        if not drafted or not accepted:
+            record({"phase": "spec_decode",
+                    "error": "speculation never accepted on the live "
+                             "backend (greedy repetitive stream should "
+                             "self-repeat)",
+                    "draft_tokens": drafted, "accepted_tokens": accepted})
+            raise SystemExit(1)
+        scraped = {
+            k: m[k] for k in (
+                "nanodiloco_spec_draft_tokens_total",
+                "nanodiloco_spec_accepted_total",
+                "nanodiloco_spec_rejected_total",
+                "nanodiloco_spec_acceptance_rate",
+                "nanodiloco_spec_tokens_per_tick_count",
+                "nanodiloco_serve_decode_tokens_per_sec",
+            ) if k in m
+        }
+    finally:
+        import signal as _signal
+
+        if proc.poll() is None:
+            proc.send_signal(_signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    # bit-parity leg: the chip is free again; the SAME greedy request
+    # through solo generate() must reproduce the speculative stream
+    probe = subprocess.run(
+        [sys.executable, "-c", (
+            "import json, sys\n"
+            "import jax, jax.numpy as jnp, numpy as np\n"
+            "from nanodiloco_tpu.cli import _load_checkpoint_snapshot\n"
+            "from nanodiloco_tpu.models import generate\n"
+            "doc = json.loads(sys.argv[1])\n"
+            "cfg, _sc, params = _load_checkpoint_snapshot(sys.argv[2], None)\n"
+            "out = generate(params, jnp.asarray([doc['token_ids']],"
+            " jnp.int32), cfg, doc['max_new_tokens'],"
+            " temperature=doc['temperature'],"
+            " key=jax.random.key(doc['seed']))\n"
+            "print(json.dumps(np.asarray(out[0]).tolist()))\n"
+        ), json.dumps(spec_doc), ckpt],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=budget * 0.3,
+    )
+    if probe.returncode != 0:
+        record({"phase": "spec_decode",
+                "error": f"solo generate probe failed: {probe.stdout[-200:]}"
+                         f"{probe.stderr[-200:]}"})
+        raise SystemExit(1)
+    solo = json.loads(probe.stdout.strip().splitlines()[-1])
+    if served_stream != solo:
+        record({"phase": "spec_decode",
+                "error": "speculative stream diverged from solo generate()",
+                "served": served_stream, "solo": solo})
+        raise SystemExit(1)
+    record({
+        "phase": "spec_decode",
+        "spec_bit_parity": True,
+        "parity_tokens": len(served_stream),
+        "scraped": scraped,
+    })
+
+
 PHASES = {
     "bench": phase_bench,
     "sweep": phase_sweep,
@@ -1438,6 +1619,7 @@ PHASES = {
     "serve": phase_serve,
     "serve_interference": phase_serve_interference,
     "kv_paging": phase_kv_paging,
+    "spec_decode": phase_spec_decode,
 }
 
 
@@ -1483,6 +1665,7 @@ PHASE_TIMEOUT_S = {
     "serve": 900,
     "serve_interference": 900,
     "kv_paging": 900,
+    "spec_decode": 900,
 }
 
 

@@ -39,6 +39,20 @@ Workloads:
   with chunked prefill it must stay bounded — and the prefix-cache
   counters show the shared prefix being computed once, not per request.
 
+- ``repetitive``: the speculative-decoding sweep. Four legs on the same
+  build: templated GREEDY prompts (pattern x reps + unique tail — the
+  few-shot/templated shape where prompt-lookup speculation shines,
+  because greedy continuations self-repeat) served spec-on and
+  spec-off, then adversarial unique-random-token prompts at sampling
+  temperature (no n-gram structure — lookup proposes nothing and the
+  engine falls back to plain ticks) served spec-on and spec-off.
+  Headline gated keys: ``spec_speedup`` (client tokens/s on vs off,
+  the >= 1.5x contract), ``spec_acceptance_rate`` and
+  ``spec_tokens_per_tick`` (the draft economics), and
+  ``spec_adversarial_ratio`` (on/off where lookup CANNOT work — must
+  stay ~1.0; reported alongside the flattering number on purpose,
+  PERF.md honest-measurement rules).
+
 By default the model is a random-init tiny Llama (shape knobs below) so
 the bench runs anywhere, CPU included; ``--checkpoint-dir`` serves a
 real trained checkpoint instead. Examples:
@@ -74,13 +88,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "random-init tiny model (throughput-shaped, "
                         "content-free)")
     p.add_argument("--step", type=int, default=None)
-    p.add_argument("--workload", choices=("uniform", "mixed", "capacity"),
+    p.add_argument("--workload",
+                   choices=("uniform", "mixed", "capacity", "repetitive"),
                    default="uniform",
                    help="uniform: every client cycles --prompt-lens; "
                         "mixed: long-prompt interference + shared-prefix "
                         "short traffic; capacity: fixed-HBM-budget sweep "
-                        "over dense/paged-fp/paged-int8 KV (see module "
-                        "docstring)")
+                        "over dense/paged-fp/paged-int8 KV; repetitive: "
+                        "the speculative-decoding sweep — templated "
+                        "greedy traffic where prompt-lookup shines AND "
+                        "an adversarial random-token leg where it "
+                        "cannot, each measured spec-on vs spec-off on "
+                        "the same build (see module docstring)")
     p.add_argument("--slots", type=int, default=4)
     p.add_argument("--max-len", type=int, default=256)
     p.add_argument("--max-queue", type=int, default=256)
@@ -141,6 +160,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--capacity-decode-ticks", type=int, default=12,
                    help="[capacity] timed decode ticks per mode (after "
                         "one warmup tick)")
+    # speculative decoding (any workload; the repetitive workload's
+    # spec-on legs use these, its spec-off legs force 0)
+    p.add_argument("--spec-k", type=int, default=None,
+                   help="speculative drafts verified per slot per tick "
+                        "(default: 4 for the repetitive workload's "
+                        "spec-on legs, 0 — speculation off — for every "
+                        "other workload)")
+    p.add_argument("--spec-ngram", type=int, default=3,
+                   help="longest prompt-lookup n-gram")
+    p.add_argument("--repetitive-pattern-len", type=int, default=16,
+                   help="[repetitive] template pattern length; each "
+                        "prompt is the pattern repeated "
+                        "--repetitive-reps times + a unique 4-token "
+                        "tail (few-shot shape)")
+    p.add_argument("--repetitive-reps", type=int, default=3,
+                   help="[repetitive] template repetitions per prompt")
     # tiny-model shape knobs (ignored with --checkpoint-dir)
     p.add_argument("--hidden", type=int, default=128)
     p.add_argument("--layers", type=int, default=4)
@@ -302,6 +337,191 @@ def run_capacity(args, cfg, params, jax) -> None:
     print(json.dumps(rec), flush=True)
 
 
+def _spec_leg(args, cfg, params, *, spec_k: int, adversarial: bool,
+              seed: int) -> dict:
+    """One repetitive-workload leg: a fresh engine (speculation on or
+    off) behind a real socket, closed-loop clients, client-side AND
+    engine-side decode throughput. Repetitive legs send GREEDY
+    templated prompts (pattern x reps + unique tail — few-shot shape;
+    greedy output self-repeats, which is exactly what prompt-lookup
+    predicts); adversarial legs send unique random-token prompts at
+    --temperature, where n-gram lookup finds nothing and the engine
+    must fall back to plain one-token ticks."""
+    import random
+    import threading as _threading
+
+    from nanodiloco_tpu.serve import (
+        InferenceEngine,
+        Scheduler,
+        ServeServer,
+        http_post_json,
+    )
+
+    engine = InferenceEngine(
+        params, cfg, num_slots=args.slots,
+        max_len=min(args.max_len, cfg.max_position_embeddings),
+        chunk_size=args.chunk_size,
+        prefix_cache_tokens=args.prefix_cache_tokens,
+        kv_block_size=args.kv_block_size, kv_dtype=args.kv_dtype,
+        kv_pool_blocks=args.kv_pool_blocks,
+        spec_k=spec_k, spec_ngram=args.spec_ngram,
+    )
+    # every verify bucket compiles BEFORE the window: the adaptive-k
+    # ramp reaches buckets data-dependently, and a 0.5 s compile landing
+    # mid-window would swamp the ~3 ms ticks being measured
+    engine.warm_spec()
+    server = ServeServer(
+        Scheduler(engine, max_queue=args.max_queue),
+        port=0, host="127.0.0.1", max_new_tokens_cap=args.max_new_tokens,
+    ).start()
+
+    def post(doc):
+        return http_post_json(
+            f"http://127.0.0.1:{server.port}/v1/generate", doc
+        )
+
+    rng = random.Random(seed)
+    pattern = [rng.randrange(cfg.vocab_size)
+               for _ in range(args.repetitive_pattern_len)]
+    docs = []
+    for c in range(args.clients):
+        for r in range(args.requests_per_client):
+            if adversarial:
+                ids = [rng.randrange(cfg.vocab_size) for _ in range(
+                    args.repetitive_pattern_len * args.repetitive_reps + 4
+                )]
+                temp, top_k = args.temperature, args.top_k
+            else:
+                ids = pattern * args.repetitive_reps + [
+                    rng.randrange(cfg.vocab_size) for _ in range(4)
+                ]
+                temp, top_k = 0.0, 0
+            docs.append((c, {
+                "token_ids": ids, "max_new_tokens": args.max_new_tokens,
+                "temperature": temp, "top_k": top_k,
+                "seed": seed + c * 1000 + r, "stop": False,
+            }))
+    # warmup outside the window: compile every prefill bucket + the
+    # decode tick + (spec legs) the verify buckets the adaptive-k ramp
+    # walks through — a long greedy repetitive request climbs them all
+    warm = {
+        "token_ids": pattern * args.repetitive_reps + [1, 2, 3, 4],
+        "max_new_tokens": args.max_new_tokens, "temperature": 0.0,
+        "seed": 999_999, "stop": False, "prefix_cache": False,
+    }
+    code, out = post(warm)
+    if code != 200:
+        server.stop()
+        raise SystemExit(
+            f"repetitive warmup failed with {code}: {out.get('error')}"
+        )
+    # the warmup request's ticks must not leak into the measured
+    # window: spec counters reset outright, cumulative scheduler decode
+    # stats subtracted as a baseline snapshot below
+    engine.reset_spec_stats()
+    s0 = server._scheduler.stats()
+    results, errors = [], []
+    lock = _threading.Lock()
+
+    def client(cid):
+        for c, doc in docs:
+            if c != cid:
+                continue
+            code, out = post(doc)
+            with lock:
+                (results if code == 200 else errors).append(out)
+
+    threads = [_threading.Thread(target=client, args=(c,))
+               for c in range(args.clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    stats = server._scheduler.stats()
+    server.stop()
+    completion = sum(r["completion_tokens"] for r in results)
+    ttft = sorted(r["timing"]["ttft_s"] for r in results)
+    decode_tokens = stats["decode_tokens"] - s0["decode_tokens"]
+    decode_s = stats["decode_s"] - s0["decode_s"]
+    return {
+        "requests": len(results),
+        "errors": len(errors),
+        "wall_s": round(wall, 3),
+        "client_tokens_per_sec": round(completion / wall, 1) if wall else None,
+        "decode_tokens_per_sec": (
+            round(decode_tokens / decode_s, 1) if decode_s > 0 else None
+        ),
+        "ttft_p50_s": round(_pct(ttft, 0.50), 4) if ttft else None,
+        "spec": stats.get("spec"),
+    }
+
+
+def run_repetitive(args, cfg, params, jax) -> None:
+    """The speculative-decoding sweep: repetitive (templated, greedy)
+    and adversarial (random-token, sampled) traffic, each served
+    spec-on and spec-off on the SAME build — one ``BENCH_SERVE`` record
+    whose gated keys are the speedup where lookup works, the
+    acceptance/emission economics, and the adversarial ratio proving
+    the fallback costs (almost) nothing."""
+    legs = {}
+    for name, spec_k, adversarial in (
+        ("repetitive_spec_on", args.spec_k, False),
+        ("repetitive_spec_off", 0, False),
+        ("adversarial_spec_on", args.spec_k, True),
+        ("adversarial_spec_off", 0, True),
+    ):
+        legs[name] = _spec_leg(
+            args, cfg, params, spec_k=spec_k, adversarial=adversarial,
+            seed=args.seed,
+        )
+        print(f"# {name}: {legs[name]}", file=sys.stderr, flush=True)
+    on, off = legs["repetitive_spec_on"], legs["repetitive_spec_off"]
+    aon, aoff = legs["adversarial_spec_on"], legs["adversarial_spec_off"]
+    spec = on.get("spec") or {}
+    rec = {
+        "metric": "BENCH_SERVE",
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "model": f"random-init llama (hidden {cfg.hidden_size} x "
+                 f"{cfg.num_hidden_layers}L, vocab {cfg.vocab_size})",
+        "workload": "repetitive",
+        "slots": args.slots,
+        "clients": args.clients,
+        "requests_per_client": args.requests_per_client,
+        "max_new_tokens": args.max_new_tokens,
+        "spec_k": args.spec_k,
+        "spec_ngram": args.spec_ngram,
+        "kv_block_size": args.kv_block_size,
+        "legs": legs,
+        # the gated speculation contract (see _COMPARE_METRICS):
+        # client-visible decode throughput with speculation on, its
+        # ratio to the same build with speculation off, the
+        # draft-accept economics, and the adversarial fallback ratio
+        "decode_tokens_per_sec": on["decode_tokens_per_sec"],
+        "client_tokens_per_sec": on["client_tokens_per_sec"],
+        "spec_off_client_tokens_per_sec": off["client_tokens_per_sec"],
+        "spec_speedup": (
+            round(on["client_tokens_per_sec"] / off["client_tokens_per_sec"], 3)
+            if on["client_tokens_per_sec"] and off["client_tokens_per_sec"]
+            else None
+        ),
+        "spec_acceptance_rate": spec.get("acceptance_rate"),
+        "spec_tokens_per_tick": spec.get("tokens_per_tick_mean"),
+        "adversarial_client_tokens_per_sec": aon["client_tokens_per_sec"],
+        "adversarial_spec_off_client_tokens_per_sec": (
+            aoff["client_tokens_per_sec"]
+        ),
+        "spec_adversarial_ratio": (
+            round(aon["client_tokens_per_sec"] / aoff["client_tokens_per_sec"], 3)
+            if aon["client_tokens_per_sec"] and aoff["client_tokens_per_sec"]
+            else None
+        ),
+    }
+    print(json.dumps(rec), flush=True)
+
+
 def main() -> None:
     args = build_parser().parse_args()
     import jax
@@ -333,6 +553,11 @@ def main() -> None:
     if args.workload == "capacity":
         run_capacity(args, cfg, params, jax)
         return
+    if args.workload == "repetitive":
+        if args.spec_k is None:
+            args.spec_k = 4
+        run_repetitive(args, cfg, params, jax)
+        return
 
     engine = InferenceEngine(
         params, cfg, num_slots=args.slots,
@@ -342,7 +567,10 @@ def main() -> None:
         kv_block_size=args.kv_block_size,
         kv_dtype=args.kv_dtype,
         kv_pool_blocks=args.kv_pool_blocks,
+        spec_k=args.spec_k or 0,
+        spec_ngram=args.spec_ngram,
     )
+    engine.warm_spec()  # no-op unless --spec-k was passed
     server = ServeServer(
         Scheduler(engine, max_queue=args.max_queue),
         port=0, host="127.0.0.1", max_new_tokens_cap=args.max_new_tokens,

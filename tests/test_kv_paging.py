@@ -370,7 +370,7 @@ def test_int8_kv_greedy_parity_and_logit_tolerance(params):
                 logits[mode].append(np.array(eng.last_prefill_logits))
                 toks = [int(eng._tokens[0])]
                 for _ in range(req.max_new_tokens - 1):
-                    toks.append(int(eng.step()[0]))
+                    toks.extend(eng.step()[0])
                 streams[mode].append(toks)
                 eng.release(0)
         refs = [

@@ -383,6 +383,7 @@ def test_server_rejects_bad_requests_with_400(params):
             {"token_ids": [1], "request_id": ""},          # empty id
             {"token_ids": [1], "request_id": 7},           # non-string id
             {"token_ids": [1], "request_id": "x" * 200},   # oversized id
+            {"token_ids": [1], "speculate": "yes"},        # non-bool opt-out
         ):
             code, out = _post(srv.port, bad)
             assert code == 400, (bad, out)
