@@ -545,6 +545,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "slots x ceil(max_len/block) — the dense "
                         "footprint, oversubscribable downward because "
                         "short requests only hold what they use")
+    p.add_argument("--tp", type=int, default=1, metavar="N",
+                   help="tensor-parallel degree: shard the params, every "
+                        "serve program (prefill chunks, the decode tick, "
+                        "speculative verify), and the KV arenas over N "
+                        "devices on the mesh's tp axis — for models too "
+                        "big for one chip's HBM. N must divide the "
+                        "model's KV-head count and not exceed the device "
+                        "count (validated loudly at boot); sampling runs "
+                        "on replicated final logits, so streams stay "
+                        "bit-identical to solo generate() on the same "
+                        "layout")
     p.add_argument("--spec-k", type=int, default=0, metavar="K",
                    help="speculative decoding: verify up to K "
                         "prompt-lookup draft tokens per slot per tick "
@@ -623,6 +634,7 @@ def serve_main(argv: list[str]) -> None:
         kv_pool_blocks=args.kv_pool_blocks,
         spec_k=args.spec_k,
         spec_ngram=args.spec_ngram,
+        tp=args.tp,
     )
     if args.spec_k:
         # compile the verify buckets before traffic: the adaptive-k ramp

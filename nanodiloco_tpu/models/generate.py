@@ -582,6 +582,53 @@ def _serve_donate():
     return () if jax.default_backend() == "cpu" else (1,)
 
 
+# -- tensor-parallel serving (mesh != None on the serve programs) -----------
+#
+# Every serve program below takes an optional ``mesh``: params are
+# constrained to the training partition rules (parallel/sharding.py
+# ``param_specs`` — the same layout solo ``generate(mesh=...)`` uses, so
+# a TP-served stream and a TP solo run shard every matmul identically
+# and stay BIT-identical on the same layout), the KV arenas are
+# constrained to ``kv_cache_spec`` (head-sharded: each shard owns its
+# own KV heads' rows end to end — no K/V ever crosses a shard), and the
+# final logits are constrained to REPLICATED before sampling, so the
+# fused per-slot sampling — and with it the per-step PRNG key schedule —
+# runs exactly as on one device. The only cross-shard reductions are the
+# ones the param specs imply (the wo / w_down row-parallel psums), which
+# GSPMD inserts; nothing here issues a collective.
+
+
+def _tp_params(params, cfg: LlamaConfig, mesh):
+    # lazy import: parallel imports models, so the reverse edge must not
+    # be at module top (same note as generate()'s sharded path)
+    from nanodiloco_tpu.parallel.sharding import constrain, param_specs
+
+    return constrain(params, mesh, param_specs(cfg))
+
+
+def _tp_kv(kv: dict, mesh) -> dict:
+    """Constrain a KV arena pytree per ``kv_arena_leaf_spec`` (5-d k/v
+    on the KV-head axis, the int8 per-row scales replicated)."""
+    from jax.sharding import NamedSharding
+
+    from nanodiloco_tpu.parallel.sharding import kv_arena_leaf_spec
+
+    return {
+        name: jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, kv_arena_leaf_spec(arr.ndim))
+        )
+        for name, arr in kv.items()
+    }
+
+
+def _tp_replicated(x, mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec())
+    )
+
+
 def _sample_one(logits, key_data, temperature, top_k, top_p):
     """Single-row ``_sample_slots`` over raw key data: the fused
     prefill-side sample (same op sequence the decode tick uses)."""
@@ -591,8 +638,8 @@ def _sample_one(logits, key_data, temperature, top_k, top_p):
     )[0]
 
 
-@functools.lru_cache(maxsize=4)
-def prefill_chunk_fn(cfg: LlamaConfig):
+@functools.lru_cache(maxsize=8)
+def prefill_chunk_fn(cfg: LlamaConfig, mesh=None):
     """Jitted ``(params, cache, chunk [1,C], chunk_valid [1,C], slot,
     pos, last_idx, key_data [2]u32, temperature, top_k, top_p) ->
     (token scalar, logits [1,V] float32, cache)``: run ONE chunk of
@@ -614,6 +661,9 @@ def prefill_chunk_fn(cfg: LlamaConfig):
 
     def run(params, cache, chunk, chunk_valid, slot, pos, last_idx,
             key_data, temperature, top_k, top_p):
+        if mesh is not None:
+            params = _tp_params(params, cfg, mesh)
+            cache = _tp_kv(cache, mesh)
         l, _b, s_max, nkv, hd = cache["k"].shape
         ck = jax.lax.dynamic_slice(
             cache["k"], (0, slot, 0, 0, 0), (l, 1, s_max, nkv, hd)
@@ -637,6 +687,11 @@ def prefill_chunk_fn(cfg: LlamaConfig):
                 cache["v"], sub["v"], (0, slot, 0, 0, 0)
             ),
         }
+        if mesh is not None:
+            # replicated final logits: fused sampling (and its PRNG key
+            # schedule) runs exactly as on one device, per shard
+            logits = _tp_replicated(logits, mesh)
+            cache = _tp_kv(cache, mesh)
         tok = _sample_one(logits, key_data, temperature, top_k, top_p)
         return tok, logits, cache
 
@@ -644,7 +699,8 @@ def prefill_chunk_fn(cfg: LlamaConfig):
 
 
 @functools.lru_cache(maxsize=8)
-def prefill_chunk_paged_fn(cfg: LlamaConfig, kv_dtype: str | None = None):
+def prefill_chunk_paged_fn(cfg: LlamaConfig, kv_dtype: str | None = None,
+                           mesh=None):
     """Paged twin of ``prefill_chunk_fn``: jitted ``(params, pool,
     table [max_blocks] i32, chunk [1,C], chunk_valid [1,C], pos,
     last_idx, key_data, temperature, top_k, top_p) -> (token, logits,
@@ -663,6 +719,9 @@ def prefill_chunk_paged_fn(cfg: LlamaConfig, kv_dtype: str | None = None):
 
     def run(params, pool, table, chunk, chunk_valid, pos, last_idx,
             key_data, temperature, top_k, top_p):
+        if mesh is not None:
+            params = _tp_params(params, cfg, mesh)
+            pool = _tp_kv(pool, mesh)
         cdt = jnp.dtype(cfg.dtype)
         l, nb, bs, nkv, hd = pool["k"].shape
         mb = table.shape[0]
@@ -702,6 +761,9 @@ def prefill_chunk_paged_fn(cfg: LlamaConfig, kv_dtype: str | None = None):
                 new[name] = pool[name].at[:, phys].set(
                     w.astype(pool[name].dtype), mode="drop"
                 )
+        if mesh is not None:
+            logits = _tp_replicated(logits, mesh)
+            new = _tp_kv(new, mesh)
         tok = _sample_one(logits, key_data, temperature, top_k, top_p)
         return tok, logits, new
 
@@ -749,8 +811,8 @@ def insert_chunk_fn(cfg: LlamaConfig):
     return jax.jit(run, donate_argnums=(0,) if jax.default_backend() != "cpu" else ())
 
 
-@functools.lru_cache(maxsize=4)
-def decode_slots_fn(cfg: LlamaConfig):
+@functools.lru_cache(maxsize=8)
+def decode_slots_fn(cfg: LlamaConfig, mesh=None):
     """Jitted ``(params, cache, tokens [B], pos [B], key_valid [B,S],
     key_data [B,2] uint32, temperature [B], top_k [B], top_p [B],
     active [B]) -> (next_tokens [B], cache)``: one tick advancing every
@@ -759,9 +821,15 @@ def decode_slots_fn(cfg: LlamaConfig):
 
     def run(params, cache, tokens, pos, key_valid, key_data,
             temperature, top_k, top_p, active):
+        if mesh is not None:
+            params = _tp_params(params, cfg, mesh)
+            cache = _tp_kv(cache, mesh)
         logits, cache = _decode_slots_block(
             params, cfg, tokens, cache, pos, key_valid, active
         )
+        if mesh is not None:
+            logits = _tp_replicated(logits, mesh)
+            cache = _tp_kv(cache, mesh)
         keys = jax.random.wrap_key_data(key_data)
         nxt = _sample_slots(logits, keys, temperature, top_k, top_p)
         return nxt, cache
@@ -784,7 +852,8 @@ def _decode_slots_paged_block(params, cfg: LlamaConfig, tokens, pool,
 
 
 @functools.lru_cache(maxsize=8)
-def decode_slots_paged_fn(cfg: LlamaConfig, kv_dtype: str | None = None):
+def decode_slots_paged_fn(cfg: LlamaConfig, kv_dtype: str | None = None,
+                          mesh=None):
     """Paged twin of ``decode_slots_fn``: jitted ``(params, pool,
     tables [B, max_blocks] i32, tokens [B], pos [B], key_data [B,2]
     u32, temperature [B], top_k [B], top_p [B], active [B]) ->
@@ -794,9 +863,15 @@ def decode_slots_paged_fn(cfg: LlamaConfig, kv_dtype: str | None = None):
 
     def run(params, pool, tables, tokens, pos, key_data,
             temperature, top_k, top_p, active):
+        if mesh is not None:
+            params = _tp_params(params, cfg, mesh)
+            pool = _tp_kv(pool, mesh)
         logits, pool = _decode_slots_paged_block(
             params, cfg, tokens, pool, tables, pos, active, quant
         )
+        if mesh is not None:
+            logits = _tp_replicated(logits, mesh)
+            pool = _tp_kv(pool, mesh)
         keys = jax.random.wrap_key_data(key_data)
         nxt = _sample_slots(logits, keys, temperature, top_k, top_p)
         return nxt, pool
@@ -946,8 +1021,8 @@ def _verify_slots_block(params, cfg: LlamaConfig, tokens, cache, pos,
     return logits, {"k": ck, "v": cv}
 
 
-@functools.lru_cache(maxsize=4)
-def verify_slots_fn(cfg: LlamaConfig):
+@functools.lru_cache(maxsize=8)
+def verify_slots_fn(cfg: LlamaConfig, mesh=None):
     """Jitted ``(params, cache, tokens [B,T], pos [B], draft_len [B],
     key_valid [B,S], key_data [B,T,2] u32, temperature [B], top_k [B],
     top_p [B], active [B]) -> (sampled [B,T], counts [B], cache)``: one
@@ -960,9 +1035,15 @@ def verify_slots_fn(cfg: LlamaConfig):
 
     def run(params, cache, tokens, pos, draft_len, key_valid, key_data,
             temperature, top_k, top_p, active):
+        if mesh is not None:
+            params = _tp_params(params, cfg, mesh)
+            cache = _tp_kv(cache, mesh)
         logits, cache = _verify_slots_block(
             params, cfg, tokens, cache, pos, key_valid, active
         )
+        if mesh is not None:
+            logits = _tp_replicated(logits, mesh)
+            cache = _tp_kv(cache, mesh)
         sampled = _sample_slots_multi(
             logits, key_data, temperature, top_k, top_p
         )
@@ -1076,7 +1157,8 @@ def _verify_slots_paged_block(params, cfg: LlamaConfig, tokens, pool,
 
 
 @functools.lru_cache(maxsize=8)
-def verify_slots_paged_fn(cfg: LlamaConfig, kv_dtype: str | None = None):
+def verify_slots_paged_fn(cfg: LlamaConfig, kv_dtype: str | None = None,
+                          mesh=None):
     """Paged twin of ``verify_slots_fn``: jitted ``(params, pool,
     tables [B, max_blocks] i32, tokens [B,T], pos [B], draft_len [B],
     key_data [B,T,2] u32, temperature [B], top_k [B], top_p [B],
@@ -1086,9 +1168,15 @@ def verify_slots_paged_fn(cfg: LlamaConfig, kv_dtype: str | None = None):
 
     def run(params, pool, tables, tokens, pos, draft_len, key_data,
             temperature, top_k, top_p, active):
+        if mesh is not None:
+            params = _tp_params(params, cfg, mesh)
+            pool = _tp_kv(pool, mesh)
         logits, pool = _verify_slots_paged_block(
             params, cfg, tokens, pool, tables, pos, active, quant
         )
+        if mesh is not None:
+            logits = _tp_replicated(logits, mesh)
+            pool = _tp_kv(pool, mesh)
         sampled = _sample_slots_multi(
             logits, key_data, temperature, top_k, top_p
         )

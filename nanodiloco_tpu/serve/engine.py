@@ -85,6 +85,17 @@ array is materialized up front because ``split(key, n)[i]`` depends on
 The int8 arena trades that bit-parity for HBM: its contract is logit
 tolerance + greedy-token parity (tests/test_kv_paging.py), not bits.
 
+Tensor parallelism (``tp > 1``): params shard by the training
+``param_specs`` rules, both cache modes shard on the KV-HEAD axis
+(``parallel/sharding.py::kv_cache_spec``), and every compiled program
+above runs sharded with the final logits replicated before sampling —
+the per-step PRNG schedule is unchanged, so a TP stream is bit-identical
+to solo ``generate(mesh=...)`` on the same layout. Everything host-side
+(block table, free list, refcounts, the prefix cache's chunk registry)
+stays UNsharded: a block id names the same physical block on every
+shard, so allocation, copy-on-write sharing, and rejection rollback are
+degree-independent by construction.
+
 Known divergence, inherited from ``generate`` and narrowed here: dense-
 dispatch token-choice MoE sizes expert capacity from the tokens in the
 call, so a decode tick routes over B slots where ``generate`` routes
@@ -171,6 +182,7 @@ class InferenceEngine:
         kv_pool_blocks: int | None = None,
         spec_k: int = 0,
         spec_ngram: int = 3,
+        tp: int = 1,
     ) -> None:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1; got {num_slots}")
@@ -195,6 +207,46 @@ class InferenceEngine:
             )
         if spec_k < 0:
             raise ValueError(f"spec_k must be >= 0; got {spec_k}")
+        # tensor parallelism: shard params (param_specs), the compiled
+        # serve programs, and the KV arenas (kv_cache_spec: the KV-head
+        # axis) over a tp-axis mesh. Validated LOUDLY here, at boot —
+        # a bad degree must be a readable config error, never a shape
+        # error out of the first traced program.
+        tp = int(tp)
+        if tp < 1:
+            raise ValueError(f"tp must be >= 1; got {tp}")
+        if tp > 1:
+            ndev = len(jax.devices())
+            if tp > ndev:
+                raise ValueError(
+                    f"tp={tp} exceeds the {ndev} available "
+                    f"device{'s' if ndev != 1 else ''} — the mesh cannot "
+                    "be built (use --force-cpu-devices N for virtual "
+                    "CPU shards)"
+                )
+            if cfg.kv_heads % tp:
+                raise ValueError(
+                    f"tp={tp} does not divide the model's KV-head count "
+                    f"({cfg.kv_heads}): the KV arenas shard on the "
+                    "KV-head axis, so the degree must divide it evenly"
+                )
+        self.tp = tp
+        if tp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from nanodiloco_tpu.parallel.mesh import MeshConfig, build_mesh
+            from nanodiloco_tpu.parallel.sharding import named, param_specs
+
+            self.mesh = build_mesh(
+                MeshConfig(tp=tp), devices=jax.devices()[:tp]
+            )
+            self._replicated = NamedSharding(self.mesh, PartitionSpec())
+            # params resident in their serving layout up front: the
+            # first tick must never pay a resharding transfer
+            params = jax.device_put(params, named(self.mesh, param_specs(cfg)))
+        else:
+            self.mesh = None
+            self._replicated = None
         self.params = params
         self.cfg = cfg
         self.num_slots = int(num_slots)
@@ -238,10 +290,14 @@ class InferenceEngine:
             # serves short requests and validate() rejects the long
             # ones outright (they could never be admitted)
             self.block_pool = BlockPool(nb, bs)
-            self.pool = init_kv_pool(cfg, nb, bs, self.kv_dtype)
+            self.pool = self._shard_kv(init_kv_pool(cfg, nb, bs, self.kv_dtype))
             self.cache = None
-            self._chunk_paged = prefill_chunk_paged_fn(cfg, self.kv_dtype)
-            self._decode_paged = decode_slots_paged_fn(cfg, self.kv_dtype)
+            self._chunk_paged = prefill_chunk_paged_fn(
+                cfg, self.kv_dtype, self.mesh
+            )
+            self._decode_paged = decode_slots_paged_fn(
+                cfg, self.kv_dtype, self.mesh
+            )
             # per-slot block tables; the sentinel nb is out of range:
             # reads clamp to causally-dead garbage, writes drop
             self._tables = np.full((b, self.table_blocks), nb, np.int32)
@@ -252,9 +308,11 @@ class InferenceEngine:
             self.kv_block_size = 0
             self.block_pool = None
             self.pool = None
-            self.cache = init_kv_cache(cfg, self.num_slots, self.max_len)
-            self._chunk = prefill_chunk_fn(cfg)
-            self._decode = decode_slots_fn(cfg)
+            self.cache = self._shard_kv(
+                init_kv_cache(cfg, self.num_slots, self.max_len)
+            )
+            self._chunk = prefill_chunk_fn(cfg, self.mesh)
+            self._decode = decode_slots_fn(cfg, self.mesh)
             self._extract = extract_chunk_fn(cfg)
             self._insert = insert_chunk_fn(cfg)
         self.prefix_cache = (
@@ -277,8 +335,8 @@ class InferenceEngine:
                 self.spec_k, max_ngram=self.spec_ngram
             )
             self._verify = (
-                verify_slots_paged_fn(cfg, self.kv_dtype) if self.paged
-                else verify_slots_fn(cfg)
+                verify_slots_paged_fn(cfg, self.kv_dtype, self.mesh)
+                if self.paged else verify_slots_fn(cfg, self.mesh)
             )
         else:
             self.speculator = None
@@ -317,6 +375,39 @@ class InferenceEngine:
         # admit/release (key_valid alone is [B, S_max] — re-uploading it
         # every tick would put an H2D transfer on the per-token path)
         self._dev: dict | None = None
+        # (kind -> bucket set) of every program shape dispatched, for
+        # the layout-qualified compile-count introspection
+        self._buckets: dict[str, set[int]] = {}
+
+    # -- tensor-parallel plumbing -------------------------------------------
+
+    def _shard_kv(self, kv: dict) -> dict:
+        """Commit a KV arena to its serving sharding — the same
+        ``kv_arena_leaf_spec`` rule the compiled programs constrain to,
+        so the committed layout can never drift from the traced one.
+        No-op without a mesh."""
+        if self.mesh is None:
+            return kv
+        from jax.sharding import NamedSharding
+
+        from nanodiloco_tpu.parallel.sharding import kv_arena_leaf_spec
+
+        return {
+            name: jax.device_put(
+                arr, NamedSharding(self.mesh, kv_arena_leaf_spec(arr.ndim))
+            )
+            for name, arr in kv.items()
+        }
+
+    def _jarr(self, value, dtype=None):
+        """Host value -> device array. With a mesh, commit it REPLICATED
+        over the tp shards so every program input has an unambiguous
+        placement (mixing mesh-committed params with single-device tick
+        inputs would make the dispatch placement implementation-defined)."""
+        arr = np.asarray(value, dtype) if dtype is not None else np.asarray(value)
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        return jax.device_put(arr, self._replicated)
 
     # -- request validation (shared with the server's 400 path) -------------
 
@@ -424,8 +515,8 @@ class InferenceEngine:
             blocks = self.prefix_cache.match(ids)
             for i, (k, v) in enumerate(blocks):
                 self.cache = self._insert(
-                    self.cache, k, v, jnp.int32(slot),
-                    jnp.int32(i * self.chunk_size),
+                    self.cache, k, v, self._jarr(slot, np.int32),
+                    self._jarr(i * self.chunk_size, np.int32),
                 )
             done = len(blocks) * self.chunk_size
         self._prefills[slot] = _Prefill(request, ids, done)
@@ -435,21 +526,23 @@ class InferenceEngine:
                    key_data, temp: float, top_k: int, top_p: float):
         """Dispatch one (bucketed) chunk through the mode's compiled
         program; returns (token scalar, logits [1, V])."""
+        self._buckets.setdefault("prefill_chunk", set()).add(len(chunk))
         args = (
-            jnp.asarray([chunk], jnp.int32), jnp.asarray(valid),
-            jnp.int32(pos), jnp.int32(last),
-            jnp.asarray(key_data, jnp.uint32),
-            jnp.float32(temp), jnp.int32(top_k), jnp.float32(top_p),
+            self._jarr([chunk], np.int32), self._jarr(valid),
+            self._jarr(pos, np.int32), self._jarr(last, np.int32),
+            self._jarr(key_data, np.uint32),
+            self._jarr(temp, np.float32), self._jarr(top_k, np.int32),
+            self._jarr(top_p, np.float32),
         )
         if self.paged:
             tok, logits, self.pool = self._chunk_paged(
                 self.params, self.pool,
-                jnp.asarray(self._tables[slot]), *args,
+                self._jarr(self._tables[slot]), *args,
             )
         else:
             tok, logits, self.cache = self._chunk(
                 self.params, self.cache, args[0], args[1],
-                jnp.int32(slot), *args[2:],
+                self._jarr(slot, np.int32), *args[2:],
             )
         return tok, logits
 
@@ -574,7 +667,8 @@ class InferenceEngine:
 
                 def extract(i: int):
                     k, v = self._extract(
-                        self.cache, jnp.int32(slot), jnp.int32(i * cs), cs
+                        self.cache, self._jarr(slot, np.int32),
+                        self._jarr(i * cs, np.int32), cs,
                     )
                     return k, v
 
@@ -597,15 +691,15 @@ class InferenceEngine:
         the per-token path)."""
         if self._dev is None:
             self._dev = {
-                "temp": jnp.asarray(self._temp),
-                "topk": jnp.asarray(self._topk),
-                "topp": jnp.asarray(self._topp),
-                "active": jnp.asarray(self._active),
+                "temp": self._jarr(self._temp),
+                "topk": self._jarr(self._topk),
+                "topp": self._jarr(self._topp),
+                "active": self._jarr(self._active),
             }
             if self.paged:
-                self._dev["tables"] = jnp.asarray(self._tables)
+                self._dev["tables"] = self._jarr(self._tables)
             else:
-                self._dev["key_valid"] = jnp.asarray(self._key_valid)
+                self._dev["key_valid"] = self._jarr(self._key_valid)
         return self._dev
 
     def _collect_drafts(self) -> tuple[list[list[int]], int]:
@@ -663,19 +757,20 @@ class InferenceEngine:
                 keys_now[s] = ks[self._step_idx[s]]
             else:
                 keys_now[s] = self._dummy_key
+        self._buckets.setdefault("decode", set()).add(1)
         dev = self._stage_dev()
         if self.paged:
             nxt, self.pool = self._decode_paged(
                 self.params, self.pool, dev["tables"],
-                jnp.asarray(self._tokens), jnp.asarray(self._pos),
-                jnp.asarray(keys_now),
+                self._jarr(self._tokens), self._jarr(self._pos),
+                self._jarr(keys_now),
                 dev["temp"], dev["topk"], dev["topp"], dev["active"],
             )
         else:
             nxt, self.cache = self._decode(
                 self.params, self.cache,
-                jnp.asarray(self._tokens), jnp.asarray(self._pos),
-                dev["key_valid"], jnp.asarray(keys_now),
+                self._jarr(self._tokens), self._jarr(self._pos),
+                dev["key_valid"], self._jarr(keys_now),
                 dev["temp"], dev["topk"], dev["topp"], dev["active"],
             )
         nxt = np.asarray(nxt)
@@ -722,10 +817,11 @@ class InferenceEngine:
                 n = min(t, len(ks) - lo)
                 if n > 0:
                     keys_now[s, :n] = ks[lo:lo + n]
+        self._buckets.setdefault("verify", set()).add(t)
         dev = self._stage_dev()
         args = (
-            jnp.asarray(tokens), jnp.asarray(self._pos),
-            jnp.asarray(dlen), jnp.asarray(keys_now),
+            self._jarr(tokens), self._jarr(self._pos),
+            self._jarr(dlen), self._jarr(keys_now),
             dev["temp"], dev["topk"], dev["topp"], dev["active"],
         )
         if self.paged:
@@ -898,7 +994,7 @@ class InferenceEngine:
         if not self.paged:
             return None
         ps = self.block_pool.stats()
-        return {
+        out = {
             **ps,
             "kv_dtype": self.kv_dtype or str(self.cfg.dtype),
             "block_evictions": self.kv_block_evictions,
@@ -908,6 +1004,19 @@ class InferenceEngine:
             ),
             "hist_blocks_per_request": self.hist_blocks_per_request.snapshot(),
         }
+        if self.tp > 1:
+            # per-shard breakdown: the host pool is global (a block id
+            # names the same physical block on every shard — each shard
+            # holds that block's rows for ITS KV heads), so every shard
+            # reports the same free count here; the per-shard family
+            # exists so a fleet scraper has one shape whether shards
+            # share a pool (this engine) or own one each (a future
+            # disaggregated deployment)
+            out["tp_degree"] = self.tp
+            out["blocks_free_per_shard"] = {
+                str(s): ps["blocks_free"] for s in range(self.tp)
+            }
+        return out
 
     def spec_stats(self) -> dict | None:
         """Speculative-decoding counters for /metrics and the stats
@@ -938,12 +1047,33 @@ class InferenceEngine:
             "hist_tokens_per_tick": hist,
         }
 
+    @property
+    def kv_layout(self) -> str:
+        """The engine's program layout tag: cache storage mode plus the
+        tensor-parallel degree when sharded — the string every
+        ``compile_counts`` key carries."""
+        if not self.paged:
+            base = "dense"
+        elif self.kv_dtype == "int8":
+            base = "paged-int8"
+        else:
+            base = "paged"
+        return base if self.tp == 1 else f"{base}-tp{self.tp}"
+
     def compile_counts(self) -> dict:
-        """Compiled-executable counts per program — the bounded-compile
-        contract is testable, not folklore: chunk programs are capped by
-        the power-of-two bucket set, decode/copy by 1 each (sampling is
-        fused into chunk and decode, so there is no separate sample
-        program to count)."""
+        """Compiled-executable counts per program, keyed by
+        ``kind:layout`` — the bounded-compile contract is testable, not
+        folklore: chunk programs are capped by the power-of-two bucket
+        set, decode/copy by 1 each (sampling is fused into chunk and
+        decode, so there is no separate sample program to count).
+
+        Keys are LAYOUT-QUALIFIED (``prefill_chunk:paged-int8-tp2``,
+        not ``prefill_chunk``): a flat kind key let a per-layout pin
+        silently read the wrong mode's count — a paged test asserting
+        ``prefill_chunk <= 4`` could not tell whether it had measured
+        the paged program set or the dense one. ``buckets`` records the
+        (kind -> program shape) set actually dispatched, so a pin can
+        assert the exact (kind, bucket, layout) triples too."""
         def size(fn):
             if fn is None:
                 return None
@@ -952,12 +1082,23 @@ class InferenceEngine:
             except Exception:  # pragma: no cover - older/newer jit internals
                 return None
 
-        return {
-            "prefill_chunk": size(
+        layout = self.kv_layout
+        out: dict = {
+            "layout": layout,
+            "tp_degree": self.tp,
+            "buckets": {k: sorted(v) for k, v in sorted(self._buckets.items())},
+            f"prefill_chunk:{layout}": size(
                 self._chunk_paged if self.paged else self._chunk
             ),
-            "decode": size(self._decode_paged if self.paged else self._decode),
-            "verify": size(self._verify),
-            "extract": size(self._extract),
-            "insert": size(self._insert),
+            f"decode:{layout}": size(
+                self._decode_paged if self.paged else self._decode
+            ),
         }
+        if self._verify is not None:
+            out[f"verify:{layout}"] = size(self._verify)
+        if not self.paged:
+            # the dense-only prefix-cache copy programs; paged mode
+            # shares prefix blocks by reference and never compiles them
+            out[f"extract:{layout}"] = size(self._extract)
+            out[f"insert:{layout}"] = size(self._insert)
+        return out

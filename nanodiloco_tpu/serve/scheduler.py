@@ -712,6 +712,13 @@ class Scheduler:
                 p: h.snapshot() for p, h in sorted(prio_hists.items())
             },
         }
+        # tensor-parallel degree (engines expose ``tp``; 1 = unsharded):
+        # a gauge, so dashboards can tell a TP fleet member from a solo
+        # replica without parsing flags. Fake/scripted backends without
+        # the attribute simply omit the key.
+        tp = getattr(self.backend, "tp", None)
+        if tp is not None:
+            out["tp_degree"] = int(tp)
         prefix_stats = getattr(self.backend, "prefix_stats", None)
         if prefix_stats is not None:
             ps = prefix_stats()

@@ -372,6 +372,9 @@ class ServeServer:
             ("nanodiloco_serve_decode_tokens_per_sec",
              "aggregate decode throughput across live slots",
              s["decode_tokens_per_sec"]),
+            ("nanodiloco_serve_tp_degree",
+             "tensor-parallel shards the decode tick spans (1 = "
+             "unsharded)", s.get("tp_degree")),
         ]
         families: list = [
             (name, "gauge", help_text, [(None, value)])
@@ -429,6 +432,20 @@ class ServeServer:
                 "nanodiloco_kv_block_size_tokens", "gauge",
                 "token rows per KV block", [(None, kv["block_size"])],
             ))
+            per_shard = kv.get("blocks_free_per_shard")
+            if per_shard:
+                # its own family (not labeled samples on
+                # nanodiloco_kv_blocks_free): a sum-by-family aggregation
+                # over shard labels would multiply the global pool's
+                # free count by tp — the prefix-cache lookup lesson
+                families.append((
+                    "nanodiloco_kv_blocks_free_per_shard", "gauge",
+                    "KV blocks free per tensor-parallel shard (the host "
+                    "pool is global: a block id names the same physical "
+                    "block on every shard)",
+                    [({"shard": str(sh)}, v)
+                     for sh, v in sorted(per_shard.items())],
+                ))
             hist = kv.get("hist_blocks_per_request")
             if hist is not None:
                 families.append((

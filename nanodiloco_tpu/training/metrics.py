@@ -366,6 +366,15 @@ def summarize_run(path: str) -> dict[str, Any]:
                     "admission_blocked_no_blocks"):
             if last.get(key) is not None:
                 out[f"serve_{key}"] = last[key]
+        # tensor-parallel serving (tp > 1): the degree and the per-shard
+        # free-block breakdown — absent from older JSONLs, whose
+        # summaries are unchanged
+        if last.get("tp_degree") is not None:
+            out["serve_tp_degree"] = last["tp_degree"]
+        if isinstance(kv, dict) and isinstance(
+            kv.get("blocks_free_per_shard"), dict
+        ):
+            out["kv_blocks_free_per_shard"] = kv["blocks_free_per_shard"]
         # speculative decoding (spec_k > 0 serves): draft/accept
         # economics, same keys as the /metrics families — absent from
         # older JSONLs, whose summaries are unchanged
@@ -462,6 +471,18 @@ _COMPARE_METRICS = [
     ("spec_acceptance_rate", False),
     ("spec_tokens_per_tick", False),
     ("spec_adversarial_ratio", False),
+    # tensor-parallel serving (serve_bench --workload capacity --tp N):
+    # the per-layout decode throughput on the TP mesh must not erode.
+    # The CPU numbers are an ABSOLUTE parity bar — virtual-device
+    # shards pin program structure and correctness, the chip sitting
+    # pins the speedup — compared TP-record vs TP-record, never TP vs
+    # solo. Gated only when both summaries carry them. (The record's
+    # headline ``tp_decode_tokens_per_sec`` mirrors the paged-int8
+    # number and is deliberately NOT gated — gating the alias would
+    # report the same regression twice.)
+    ("tp_dense_decode_tokens_per_sec", False),
+    ("tp_paged_fp_decode_tokens_per_sec", False),
+    ("tp_paged_int8_decode_tokens_per_sec", False),
     # sync-vs-async outer-sync shares from the overlap bench differencing
     # (scripts/streaming_overlap.py / bench.py BENCH_ASYNC): the fraction
     # of a warm round the outer boundary costs in each mode. Shares are

@@ -51,6 +51,15 @@ ceremony:
      speculative stream is replayed through solo ``generate()`` for
      bit-parity (the CPU record pins correctness + acceptance; this
      sitting pins the on-chip speedup).
+  10. a tensor-parallel serving drill: the `serve` CLI with --tp 2 —
+     params, the decode/verify programs, and the paged KV pool sharded
+     over two devices — under greedy plain + speculative traffic; the
+     TP gauges (tp_degree, per-shard kv_blocks_free) must scrape over
+     the wire and both streams must replay bit-identically through
+     solo ``generate(mesh=...)`` on the same layout (on CPU the drill
+     runs on 2 virtual devices: same programs, same parity bar, no
+     speedup claim — the chip sitting is what pins serving models
+     bigger than one chip).
 
 Usage (each phase also runs alone):
     python scripts/chip_agenda.py               # everything
@@ -1605,6 +1614,212 @@ def phase_spec_decode() -> None:
     })
 
 
+def phase_tp_decode() -> None:
+    """Tensor-parallel serving drill on this backend: serve a tiny
+    trained checkpoint with ``--tp 2`` (paged KV + speculation riding
+    the sharded programs), stream greedy plain AND speculative traffic,
+    scrape the new TP gauges (``nanodiloco_serve_tp_degree``, the
+    per-shard ``nanodiloco_kv_blocks_free_per_shard`` family) off
+    /metrics over the wire, then — after the server releases the chip —
+    replay the served stream through solo ``generate(mesh=...)`` on the
+    SAME tp=2 layout and assert bit-parity. On a live accelerator the
+    mesh spans 2 real chips (and this sitting is what pins the
+    serve-bigger-than-one-chip claim); without one the drill runs on 2
+    virtual CPU devices — same programs, same parity bar, no speedup
+    claim (PERF.md honest-measurement rules)."""
+    import socket
+    import tempfile
+
+    from nanodiloco_tpu.obs.telemetry import parse_metrics_text
+    from nanodiloco_tpu.serve.client import http_get, http_post_json
+
+    live = chip_is_live()
+    tmp = tempfile.mkdtemp(prefix="nanodiloco-tp-decode-")
+    ckpt = os.path.join(tmp, "ckpt")
+    model_cfg = os.path.join(tmp, "model.json")
+    with open(model_cfg, "w") as f:
+        json.dump({
+            "vocab_size": 2048, "hidden_size": 128, "intermediate_size": 256,
+            "num_attention_heads": 4, "num_hidden_layers": 2,
+            "max_position_embeddings": 256,
+        }, f)
+    budget = float(os.environ.get("NANODILOCO_AGENDA_TIMEOUT_TP_DECODE",
+                                  "1200"))
+    train = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu",
+         "--total-steps", "4", "--inner-steps", "2",
+         "--batch-size", "8", "--per-device-batch-size", "4",
+         "--seq-length", "256", "--warmup-steps", "2",
+         "--llama-config-file", model_cfg, "--no-measure-comm",
+         "--no-cost-analysis", "--quiet",
+         "--checkpoint-dir", ckpt, "--log-dir", tmp,
+         "--run-name", "tp-decode-probe"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=budget * 0.4,
+    )
+    if train.returncode != 0:
+        record({"phase": "tp_decode",
+                "error": (train.stderr or train.stdout)[-400:]})
+        raise SystemExit(1)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cpu_flags = [] if live else ["--force-cpu-devices", "2"]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nanodiloco_tpu", "serve",
+         "--checkpoint-dir", ckpt, "--port", str(port),
+         "--host", "127.0.0.1", "--slots", "2", "--max-len", "192",
+         "--max-new-tokens-cap", "96", "--chunk-size", "16",
+         "--kv-block-size", "16", "--tp", "2",
+         "--spec-k", "4", "--spec-ngram", "3", *cpu_flags],
+        cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+    def get(path):
+        return http_get(f"http://127.0.0.1:{port}{path}", timeout=5)
+
+    def post(doc, timeout=300):
+        return http_post_json(
+            f"http://127.0.0.1:{port}/v1/generate", doc, timeout=timeout
+        )
+
+    # greedy plain + greedy repetitive (the spec-accepting shape): both
+    # streams must replay bit-identically through the same-layout solo
+    # generate() below
+    pattern = [(i * 37 + 11) % 256 for i in range(8)]
+    plain_doc = {
+        "token_ids": [(i * 13 + 3) % 256 for i in range(18)],
+        "max_new_tokens": 12, "temperature": 0.0,
+        "seed": 5, "stop": False, "prefix_cache": False,
+        "speculate": False,
+    }
+    spec_doc = {
+        "token_ids": pattern * 3 + [5, 7],
+        "max_new_tokens": 48, "temperature": 0.0,
+        "seed": 7, "stop": False, "prefix_cache": False,
+    }
+    try:
+        deadline = time.time() + budget * 0.3
+        up = False
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                up = get("/healthz")[0] == 200
+            except OSError:
+                up = False
+            if up:
+                break
+            time.sleep(0.3)
+        if not up:
+            record({"phase": "tp_decode",
+                    "error": "server never answered /healthz (tp=2)"})
+            raise SystemExit(1)
+        streams = {}
+        for name, doc in (("plain", plain_doc), ("spec", spec_doc)):
+            code, out = post(doc)
+            if code != 200:
+                record({"phase": "tp_decode",
+                        "error": f"{name} request failed {code}: "
+                                 f"{out.get('error')}"})
+                raise SystemExit(1)
+            streams[name] = out["token_ids"]
+        m = parse_metrics_text(get("/metrics")[1])
+        tp_deg = m.get("nanodiloco_serve_tp_degree")
+        shard0 = m.get('nanodiloco_kv_blocks_free_per_shard{shard="0"}')
+        shard1 = m.get('nanodiloco_kv_blocks_free_per_shard{shard="1"}')
+        drafted = m.get("nanodiloco_spec_draft_tokens_total", 0)
+        accepted = m.get("nanodiloco_spec_accepted_total", 0)
+        if tp_deg != 2 or shard0 is None or shard0 != shard1:
+            record({"phase": "tp_decode",
+                    "error": "TP gauges missing or inconsistent",
+                    "tp_degree": tp_deg, "shard0": shard0,
+                    "shard1": shard1})
+            raise SystemExit(1)
+        if not drafted or not accepted:
+            # the drill's point is speculation RIDING the sharded verify
+            # program — zero drafts means the spec stream degraded to
+            # plain ticks and the parity replay below would pass
+            # vacuously (same loud check as phase_spec_decode)
+            record({"phase": "tp_decode",
+                    "error": "speculation never drafted/accepted on the "
+                             "tp=2 mesh (greedy repetitive stream should "
+                             "self-repeat)",
+                    "draft_tokens": drafted, "accepted_tokens": accepted})
+            raise SystemExit(1)
+        scraped = {
+            k: m[k] for k in (
+                "nanodiloco_serve_tp_degree",
+                "nanodiloco_kv_blocks_free",
+                'nanodiloco_kv_blocks_free_per_shard{shard="0"}',
+                'nanodiloco_kv_blocks_free_per_shard{shard="1"}',
+                "nanodiloco_spec_draft_tokens_total",
+                "nanodiloco_spec_accepted_total",
+                "nanodiloco_serve_decode_tokens_per_sec",
+            ) if k in m
+        }
+    finally:
+        import signal as _signal
+
+        if proc.poll() is None:
+            proc.send_signal(_signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    # bit-parity leg: the chip is free again; replay BOTH streams
+    # through solo generate() on the SAME tp=2 mesh layout
+    probe = subprocess.run(
+        [sys.executable, "-c", (
+            "import json, sys\n"
+            + ("" if live else
+               "from nanodiloco_tpu.utils import force_virtual_cpu_devices\n"
+               "force_virtual_cpu_devices(2)\n")
+            + "import jax, jax.numpy as jnp, numpy as np\n"
+            "from nanodiloco_tpu.cli import _load_checkpoint_snapshot\n"
+            "from nanodiloco_tpu.models import generate\n"
+            "from nanodiloco_tpu.parallel.mesh import MeshConfig, build_mesh\n"
+            "from nanodiloco_tpu.parallel.sharding import named, param_specs\n"
+            "docs = json.loads(sys.argv[1])\n"
+            "cfg, _sc, params = _load_checkpoint_snapshot(sys.argv[2], None)\n"
+            "mesh = build_mesh(MeshConfig(tp=2), devices=jax.devices()[:2])\n"
+            # restored params are committed to device 0; a big-model run
+            # device_puts them into the mesh layout before generating
+            "params = jax.device_put(params, named(mesh, param_specs(cfg)))\n"
+            "outs = {}\n"
+            "for name, doc in docs.items():\n"
+            "    out = generate(params, jnp.asarray([doc['token_ids']],"
+            " jnp.int32), cfg, doc['max_new_tokens'],"
+            " temperature=doc['temperature'],"
+            " key=jax.random.key(doc['seed']), mesh=mesh)\n"
+            "    outs[name] = np.asarray(out[0]).tolist()\n"
+            "print(json.dumps(outs))\n"
+        ), json.dumps({"plain": plain_doc, "spec": spec_doc}), ckpt],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=budget * 0.3,
+    )
+    if probe.returncode != 0:
+        record({"phase": "tp_decode",
+                "error": f"tp solo generate probe failed: "
+                         f"{probe.stdout[-200:]}{probe.stderr[-200:]}"})
+        raise SystemExit(1)
+    solo = json.loads(probe.stdout.strip().splitlines()[-1])
+    for name in ("plain", "spec"):
+        if streams[name] != solo[name]:
+            record({"phase": "tp_decode",
+                    "error": f"tp=2 {name} stream diverged from "
+                             "same-layout solo generate()",
+                    "served": streams[name], "solo": solo[name]})
+            raise SystemExit(1)
+    record({
+        "phase": "tp_decode",
+        "tp_bit_parity": True,
+        "backend_live": live,
+        "parity_tokens": {k: len(v) for k, v in streams.items()},
+        "spec_drafted_on_mesh": drafted,
+        "scraped": scraped,
+    })
+
+
 PHASES = {
     "bench": phase_bench,
     "sweep": phase_sweep,
@@ -1620,6 +1835,7 @@ PHASES = {
     "serve_interference": phase_serve_interference,
     "kv_paging": phase_kv_paging,
     "spec_decode": phase_spec_decode,
+    "tp_decode": phase_tp_decode,
 }
 
 
@@ -1666,6 +1882,7 @@ PHASE_TIMEOUT_S = {
     "serve_interference": 900,
     "kv_paging": 900,
     "spec_decode": 900,
+    "tp_decode": 1200,
 }
 
 

@@ -53,6 +53,13 @@ Workloads:
   stay ~1.0; reported alongside the flattering number on purpose,
   PERF.md honest-measurement rules).
 
+``--tp N`` shards every engine the bench builds over an N-device
+tensor-parallel mesh (``--force-cpu-devices N`` for virtual CPU shards
+on a dev box); all records carry ``tp_degree``, and the capacity
+workload additionally emits per-layout ``tp_*_decode_tokens_per_sec``
+keys gated by ``report compare`` — on CPU these are an absolute parity
+bar (TP-record vs TP-record), never a speedup claim (PERF.md).
+
 By default the model is a random-init tiny Llama (shape knobs below) so
 the bench runs anywhere, CPU included; ``--checkpoint-dir`` serves a
 real trained checkpoint instead. Examples:
@@ -137,6 +144,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--top-k", type=int, default=20)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel degree: shard every engine the "
+                        "bench builds over this many devices (must "
+                        "divide the model's KV-head count); the record "
+                        "carries tp_degree, and the capacity workload "
+                        "additionally emits per-layout "
+                        "tp_*_decode_tokens_per_sec keys gated by "
+                        "report compare")
+    p.add_argument("--force-cpu-devices", type=int, default=None,
+                   metavar="N",
+                   help="bench on N virtual CPU devices (the TP record "
+                        "on a laptop/CI box; same mechanism as the "
+                        "serve CLI flag)")
     # paged-KV engine knobs (any workload) + the capacity sweep's shape
     p.add_argument("--kv-block-size", type=int, default=0,
                    help="page the KV cache into blocks of this many "
@@ -219,7 +239,7 @@ def _capacity_mode(args, cfg, params, mode: str, budget_bytes: int) -> dict:
         slots = max(1, int(budget_bytes // per_slot))
         eng = InferenceEngine(
             params, cfg, num_slots=slots, max_len=max_len,
-            chunk_size=args.chunk_size,
+            chunk_size=args.chunk_size, tp=args.tp,
         )
         kv_bytes = int(eng.cache["k"].nbytes + eng.cache["v"].nbytes)
     else:
@@ -235,7 +255,7 @@ def _capacity_mode(args, cfg, params, mode: str, budget_bytes: int) -> dict:
         eng = InferenceEngine(
             params, cfg, num_slots=slots, max_len=max_len,
             chunk_size=args.chunk_size, kv_block_size=bs,
-            kv_dtype=kv_dtype, kv_pool_blocks=nb,
+            kv_dtype=kv_dtype, kv_pool_blocks=nb, tp=args.tp,
         )
         kv_bytes = int(eng.kv_stats()["kv_bytes"])
     rng = __import__("random").Random(args.seed)
@@ -315,6 +335,7 @@ def run_capacity(args, cfg, params, jax) -> None:
         "model": f"random-init llama (hidden {cfg.hidden_size} x "
                  f"{cfg.num_hidden_layers}L, vocab {cfg.vocab_size})",
         "workload": "capacity",
+        "tp_degree": args.tp,
         "kv_hbm_budget_mb": args.kv_hbm_budget_mb,
         "capacity_prompt_len": args.capacity_prompt_len,
         "max_new_tokens": args.max_new_tokens,
@@ -334,6 +355,22 @@ def run_capacity(args, cfg, params, jax) -> None:
             if dense["max_concurrent_slots"] else None
         ),
     }
+    if args.tp > 1:
+        # the gated TP contract (see _COMPARE_METRICS): per-layout
+        # decode throughput ON the mesh — compared TP-record vs
+        # TP-record, an absolute parity bar on CPU virtual devices (the
+        # chip sitting pins the actual speedup/HBM headroom)
+        rec["tp_dense_decode_tokens_per_sec"] = dense["decode_tokens_per_sec"]
+        rec["tp_paged_fp_decode_tokens_per_sec"] = (
+            modes["paged-fp"]["decode_tokens_per_sec"]
+        )
+        rec["tp_paged_int8_decode_tokens_per_sec"] = (
+            int8["decode_tokens_per_sec"]
+        )
+        # headline alias of the paged-int8 number (the PR-9 convention:
+        # the record leads with its best layout); informational only —
+        # the gate reads the per-layout keys above
+        rec["tp_decode_tokens_per_sec"] = int8["decode_tokens_per_sec"]
     print(json.dumps(rec), flush=True)
 
 
@@ -364,7 +401,7 @@ def _spec_leg(args, cfg, params, *, spec_k: int, adversarial: bool,
         prefix_cache_tokens=args.prefix_cache_tokens,
         kv_block_size=args.kv_block_size, kv_dtype=args.kv_dtype,
         kv_pool_blocks=args.kv_pool_blocks,
-        spec_k=spec_k, spec_ngram=args.spec_ngram,
+        spec_k=spec_k, spec_ngram=args.spec_ngram, tp=args.tp,
     )
     # every verify bucket compiles BEFORE the window: the adaptive-k
     # ramp reaches buckets data-dependently, and a 0.5 s compile landing
@@ -487,6 +524,7 @@ def run_repetitive(args, cfg, params, jax) -> None:
         "model": f"random-init llama (hidden {cfg.hidden_size} x "
                  f"{cfg.num_hidden_layers}L, vocab {cfg.vocab_size})",
         "workload": "repetitive",
+        "tp_degree": args.tp,
         "slots": args.slots,
         "clients": args.clients,
         "requests_per_client": args.requests_per_client,
@@ -524,6 +562,10 @@ def run_repetitive(args, cfg, params, jax) -> None:
 
 def main() -> None:
     args = build_parser().parse_args()
+    if args.force_cpu_devices:
+        from nanodiloco_tpu.utils import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(args.force_cpu_devices)
     import jax
 
     from nanodiloco_tpu.serve import (
@@ -569,6 +611,7 @@ def main() -> None:
         kv_pool_blocks=args.kv_pool_blocks,
         spec_k=args.spec_k or 0,
         spec_ngram=args.spec_ngram,
+        tp=args.tp,
     )
     engine.warm_spec()  # no-op unless --spec-k was passed
     server = ServeServer(
@@ -701,6 +744,7 @@ def main() -> None:
                f"{cfg.num_hidden_layers}L, vocab {cfg.vocab_size})"
         ),
         "workload": args.workload,
+        "tp_degree": args.tp,
         "slots": args.slots,
         "chunk_size": engine.chunk_size,
         "kv_block_size": engine.kv_block_size,

@@ -392,6 +392,46 @@ def test_int8_kv_greedy_parity_and_logit_tolerance(params):
         )
 
 
+def test_int8_tp2_greedy_parity_across_layouts(params):
+    """The int8 contract on a tensor-parallel mesh: greedy paged-int8
+    streams from a tp=2 engine match the tp=1 paged-int8 engine AND
+    solo fp ``generate()`` token for token (per-row quantization is
+    amax/127 — max is exactly associative, so the int8 bits are
+    layout-invariant; only the fp matmul reassociation moves, and
+    greedy argmax absorbs it at this scale like the dense tp tests)."""
+    lens = [3, 5, 8]
+    reqs = [
+        GenRequest(
+            prompt=tuple((7 * i + 3 * j) % 50 + 1 for j in range(n)),
+            max_new_tokens=4, seed=40 + i,
+        )
+        for i, n in enumerate(lens)
+    ]
+    streams = {}
+    with jax.default_matmul_precision("highest"):
+        for tp in (1, 2):
+            eng = InferenceEngine(params, CFG, num_slots=1, max_len=32,
+                                  chunk_size=4, kv_block_size=4,
+                                  kv_dtype="int8", tp=tp)
+            streams[tp] = []
+            for req in reqs:
+                eng.prefill(0, req)
+                toks = [int(eng._tokens[0])]
+                for _ in range(req.max_new_tokens - 1):
+                    toks.extend(eng.step()[0])
+                streams[tp].append(toks)
+                eng.release(0)
+        refs = [
+            np.asarray(generate(
+                params, jnp.asarray([r.prompt], jnp.int32), CFG,
+                r.max_new_tokens,
+            )[0]).tolist()
+            for r in reqs
+        ]
+    for n, s1, s2, ref in zip(lens, streams[1], streams[2], refs):
+        assert s2 == s1 == ref, f"int8 tp2 diverged at prompt len {n}"
+
+
 def test_bucket_overflow_corner_never_rewrites_shared_blocks(params):
     """The re-feed corner, closed: with max_len NOT a multiple of the
     final bucket (done=16, remaining=5 -> bucket 8 pokes past a 22-row
@@ -462,16 +502,17 @@ def test_compile_count_bounded_under_paging():
             break
     assert all(t.done() for t in tickets)
     counts = eng.compile_counts()
-    if counts["prefill_chunk"] is None:
+    assert counts["layout"] == "paged"
+    if counts["prefill_chunk:paged"] is None:
         pytest.skip("jit cache introspection unavailable on this jax")
     # 12 distinct prompt lengths -> at most the 4 bucket lengths
     # {1, 2, 4, 8}; admitting/retiring never recompiled the tick
-    assert 1 <= counts["prefill_chunk"] <= 4
-    assert counts["decode"] == 1
+    assert 1 <= counts["prefill_chunk:paged"] <= 4
+    assert counts["decode:paged"] == 1
     # the dense-only copy programs never compile in paged mode (prefix
-    # sharing is by block reference, zero device copies)
-    assert counts["extract"] is None
-    assert counts["insert"] is None
+    # sharing is by block reference, zero device copies) — and under
+    # the layout-keyed introspection they do not even have a key
+    assert not any(k.startswith(("extract", "insert")) for k in counts)
 
 
 # -- observability keys -------------------------------------------------------

@@ -246,6 +246,42 @@ def test_compare_runs_gates_serve_latency_keys():
     assert not onesided["regressions"]
 
 
+def test_compare_runs_gates_tp_serve_keys_both_directions():
+    """The tensor-parallel serve-bench keys (per-layout decode
+    throughput on the TP mesh) gate like throughput: a drop past
+    max_tps_drop regresses, an improvement passes, and a baseline
+    without them never gates a TP-less candidate (or vice versa)."""
+    from nanodiloco_tpu.training.metrics import compare_runs
+
+    base = {"tp_dense_decode_tokens_per_sec": 60.0,
+            "tp_paged_fp_decode_tokens_per_sec": 50.0,
+            "tp_paged_int8_decode_tokens_per_sec": 100.0,
+            # headline alias of the int8 number: informational, NOT a
+            # gated key (gating it would report one regression twice)
+            "tp_decode_tokens_per_sec": 100.0,
+            "tp_degree": 2}
+    ok = compare_runs(base, {**base,
+                             "tp_paged_int8_decode_tokens_per_sec": 110.0})
+    assert not ok["regressions"]
+    same = compare_runs(base, dict(base))
+    assert not same["regressions"]
+    bad = compare_runs(base, {**base,
+                              "tp_paged_int8_decode_tokens_per_sec": 10.0,
+                              "tp_paged_fp_decode_tokens_per_sec": 4.0})
+    assert "tp_paged_int8_decode_tokens_per_sec" in bad["regressions"]
+    assert "tp_paged_fp_decode_tokens_per_sec" in bad["regressions"]
+    assert "tp_decode_tokens_per_sec" not in bad["regressions"]
+    # the reverse direction: a better candidate compared against the
+    # worse record also exits green — gating is asymmetric on purpose
+    rev = compare_runs(
+        {**base, "tp_paged_int8_decode_tokens_per_sec": 10.0}, base
+    )
+    assert not rev["regressions"]
+    # one-sided keys: reported, never gating
+    onesided = compare_runs({"final_loss": 2.0}, base)
+    assert not onesided["regressions"]
+
+
 def test_report_cli(tmp_path, capsys):
     from nanodiloco_tpu.cli import main
 

@@ -37,20 +37,39 @@ KV_MODES = [
     pytest.param({"kv_block_size": 4}, id="paged"),
 ]
 
+# THE acceptance test additionally runs on a tensor-parallel mesh
+# (params + KV arenas sharded over 2 virtual CPU devices): a TP stream
+# must be bit-identical to solo generate() on the SAME layout
+# (generate(mesh=...)) — across layouts only greedy token-identity can
+# hold, because the tp psums reassociate float reductions
+KV_TP_MODES = KV_MODES + [
+    pytest.param({"tp": 2}, id="dense-tp2"),
+    pytest.param({"kv_block_size": 4, "tp": 2}, id="paged-tp2"),
+]
+
+
+def _tp_mesh(tp: int):
+    from nanodiloco_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    return build_mesh(MeshConfig(tp=tp), devices=jax.devices()[:tp])
+
 
 @pytest.fixture(scope="module")
 def params():
     return init_params(jax.random.key(0), CFG)
 
 
-def _reference(params, req: GenRequest):
+def _reference(params, req: GenRequest, tp: int = 1):
     """The request run ALONE through the one-shot generate() — the
-    stream the engine must reproduce bit-identically."""
+    stream the engine must reproduce bit-identically. ``tp > 1`` runs
+    the solo reference on the same tensor-parallel layout the engine
+    under test shards over."""
     out = generate(
         params, jnp.asarray([req.prompt], jnp.int32), CFG,
         req.max_new_tokens, temperature=req.temperature, top_k=req.top_k,
         top_p=req.top_p, key=jax.random.key(req.seed),
         stop_token=req.stop_token,
+        mesh=_tp_mesh(tp) if tp > 1 else None,
     )
     row = np.asarray(out[0]).tolist()
     if req.stop_token is not None and req.stop_token in row:
@@ -61,12 +80,13 @@ def _reference(params, req: GenRequest):
 # -- continuous-batching correctness ----------------------------------------
 
 
-@pytest.mark.parametrize("kv", KV_MODES)
+@pytest.mark.parametrize("kv", KV_TP_MODES)
 def test_overlapping_requests_bit_match_sequential_generate(params, kv):
     """THE acceptance test: requests admitted mid-stream, decoded
     together in one batch, and retired at different times produce token
     ids bit-identical to running each alone through generate() with the
-    same seed and sampling params."""
+    same seed and sampling params — on the tp modes, through a sharded
+    mesh against the same-layout solo run."""
     eng = InferenceEngine(params, CFG, num_slots=2, max_len=32, **kv)
     sched = Scheduler(eng)
     reqs = [
@@ -86,12 +106,13 @@ def test_overlapping_requests_bit_match_sequential_generate(params, kv):
         for _ in range(20):               # C refills the first freed slot
             if sched.tick() == 0 and all(t.done() for t in tickets):
                 break
-        refs = [_reference(params, r) for r in reqs]
+        refs = [_reference(params, r, tp=kv.get("tp", 1)) for r in reqs]
     for ticket, ref in zip(tickets, refs):
         assert ticket.result["finish_reason"] == "length"
         assert ticket.result["tokens"] == ref
     s = sched.stats()
     assert s["served"] == 3 and s["slots_busy"] == 0
+    assert s["tp_degree"] == kv.get("tp", 1)
 
 
 @pytest.mark.parametrize("kv", KV_MODES)
@@ -178,7 +199,12 @@ def test_chunked_prefill_boundary_parity(params, kv):
     )
 
 
-@pytest.mark.parametrize("kv", KV_MODES)
+@pytest.mark.parametrize("kv", KV_MODES + [
+    # dense-tp2: the extract/insert device copies move SHARDED chunk
+    # K/V through the host-keyed cache — the one tp path the
+    # acceptance matrix doesn't already cross
+    pytest.param({"tp": 2}, id="dense-tp2"),
+])
 def test_prefix_cache_hit_parity_and_counters(params, kv):
     """Cached-prefix admission bit-parity: requests B and D share A's
     chunk-aligned prefix — their admission copies A's cached K/V rows
@@ -210,7 +236,7 @@ def test_prefix_cache_hit_parity_and_counters(params, kv):
         for _ in range(40):
             if sched.tick() == 0 and all(t.done() for t in others):
                 break
-        refs = [_reference(params, r) for r in reqs]
+        refs = [_reference(params, r, tp=kv.get("tp", 1)) for r in reqs]
     for ticket, ref in zip([ta, *others], refs):
         assert ticket.result["tokens"] == ref
     ps = eng.prefix_stats()
@@ -248,16 +274,21 @@ def test_compile_count_bounded_across_mixed_lengths():
             break
     assert all(t.done() for t in tickets)
     counts = eng.compile_counts()
-    if counts["prefill_chunk"] is None:
+    assert counts["layout"] == "dense"
+    if counts["prefill_chunk:dense"] is None:
         pytest.skip("jit cache introspection unavailable on this jax")
     # 12 distinct prompt lengths -> at most the 4 bucket lengths
     # {1, 2, 4, 8} ever compile (the PR-4 path compiled 12); sampling
     # is fused into the chunk and decode programs, so there is no
     # separate sample executable at all
-    assert 1 <= counts["prefill_chunk"] <= 4
-    assert counts["decode"] == 1
-    assert counts["extract"] in (None, 0, 1)
-    assert counts["insert"] in (None, 0, 1)
+    assert 1 <= counts["prefill_chunk:dense"] <= 4
+    assert counts["decode:dense"] == 1
+    assert counts["extract:dense"] in (None, 0, 1)
+    assert counts["insert:dense"] in (None, 0, 1)
+    # the dispatched program-shape ledger: every chunk bucket a power
+    # of two <= 8, the decode tick always T=1
+    assert set(counts["buckets"]["prefill_chunk"]) <= {1, 2, 4, 8}
+    assert counts["buckets"]["decode"] == [1]
 
 
 def test_engine_validates_impossible_requests(params):
@@ -268,6 +299,154 @@ def test_engine_validates_impossible_requests(params):
         eng.validate([], 4)
     with pytest.raises(ValueError, match="vocabulary"):
         eng.validate([CFG.vocab_size + 5], 4)
+
+
+# -- tensor-parallel serving --------------------------------------------------
+
+
+def test_tp_greedy_token_identical_across_layouts(params):
+    """Cross-layout greedy token-identity: the same greedy requests
+    through dense-tp2, tp4, and paged-tp2 engines produce the same
+    token ids as unsharded solo generate(). (Bit-parity of SAMPLED
+    streams only holds within one layout — the tp psums reassociate
+    float reductions — which is exactly what the same-layout acceptance
+    test above pins.)"""
+    reqs = [
+        GenRequest(prompt=(5, 9, 2, 11, 3), max_new_tokens=6, seed=0),
+        GenRequest(prompt=(7, 1, 4), max_new_tokens=5, seed=1),
+    ]
+    with jax.default_matmul_precision("highest"):
+        refs = [_reference(params, r) for r in reqs]  # unsharded solo
+        for kv in ({"tp": 2}, {"tp": 4}, {"tp": 2, "kv_block_size": 4}):
+            eng = InferenceEngine(params, CFG, num_slots=2, max_len=32, **kv)
+            sched = Scheduler(eng)
+            tickets = [sched.submit(r) for r in reqs]
+            for _ in range(20):
+                if sched.tick() == 0 and all(t.done() for t in tickets):
+                    break
+            for ticket, ref in zip(tickets, refs):
+                assert ticket.result["tokens"] == ref, kv
+
+
+def test_tp_validation_is_a_loud_boot_error(params):
+    """A bad --tp degree must fail at engine CONSTRUCTION with a
+    readable config error — never as a shape error out of the first
+    traced program: tp not dividing the KV-head count (CFG has 4), and
+    tp exceeding the device count (the harness pins 8 virtual CPUs)."""
+    with pytest.raises(ValueError, match="KV-head"):
+        InferenceEngine(params, CFG, num_slots=1, max_len=16, tp=3)
+    with pytest.raises(ValueError, match="devices"):
+        InferenceEngine(params, CFG, num_slots=1, max_len=16, tp=16)
+    with pytest.raises(ValueError, match="tp"):
+        InferenceEngine(params, CFG, num_slots=1, max_len=16, tp=0)
+    # the serve CLI carries the flag end to end
+    from nanodiloco_tpu.cli import build_serve_parser
+
+    args = build_serve_parser().parse_args(
+        ["--checkpoint-dir", "x", "--tp", "2"]
+    )
+    assert args.tp == 2
+
+
+def test_compile_counts_keyed_by_layout():
+    """The introspection-conflation regression pin: compile counts are
+    keyed (kind, layout) — with ``buckets`` carrying the dispatched
+    (kind, bucket) shapes — so a per-layout compile pin can NEVER
+    silently read another layout's program set (the old flat
+    ``prefill_chunk`` key reported dense and paged counts identically
+    named). Dedicated config — distinct VALUES too, not just a fresh
+    object: LlamaConfig hashes by value, so a config equal to another
+    test's would share its lru-cached jits and absorb its compiles."""
+    cfgc = LlamaConfig(
+        vocab_size=80, hidden_size=32, intermediate_size=64,
+        num_attention_heads=2, num_hidden_layers=1,
+        max_position_embeddings=64,
+    )
+    paramsc = init_params(jax.random.key(3), cfgc)
+
+    def drive(eng):
+        sched = Scheduler(eng)
+        tickets = [
+            sched.submit(GenRequest(prompt=tuple((i + j) % 60
+                                                 for j in range(n)),
+                                    max_new_tokens=2, seed=i))
+            for i, n in enumerate([3, 8])
+        ]
+        for _ in range(40):
+            if sched.tick() == 0 and all(t.done() for t in tickets):
+                break
+        assert all(t.done() for t in tickets)
+
+    dense = InferenceEngine(paramsc, cfgc, num_slots=2, max_len=32,
+                            chunk_size=8)
+    paged = InferenceEngine(paramsc, cfgc, num_slots=2, max_len=32,
+                            chunk_size=8, kv_block_size=8)
+    drive(dense)
+    drive(paged)
+    dc, pc = dense.compile_counts(), paged.compile_counts()
+    assert dc["layout"] == "dense" and pc["layout"] == "paged"
+    # each layout's counts live ONLY under its own keys
+    assert "prefill_chunk:dense" in dc and "prefill_chunk:paged" not in dc
+    assert "prefill_chunk:paged" in pc and "prefill_chunk:dense" not in pc
+    # dense-only copy programs never appear under the paged layout
+    assert "extract:dense" in dc and not any(
+        k.startswith("extract") for k in pc
+    )
+    # the dispatched shapes: prompts of 3 and 8 -> chunk buckets {4, 8}
+    # in both layouts, decode always T=1
+    assert dc["buckets"]["prefill_chunk"] == [4, 8]
+    assert pc["buckets"]["prefill_chunk"] == [4, 8]
+    assert dc["buckets"]["decode"] == pc["buckets"]["decode"] == [1]
+    # a tp engine's keys are further qualified by the degree
+    tp = InferenceEngine(paramsc, cfgc, num_slots=1, max_len=32,
+                         chunk_size=8, tp=2)
+    assert tp.compile_counts()["layout"] == "dense-tp2"
+    assert "prefill_chunk:dense-tp2" in tp.compile_counts()
+
+
+def test_tp_metrics_and_stats_jsonl_flow(params, tmp_path):
+    """The TP observability contract over a real socket: a paged tp=2
+    server reports ``nanodiloco_serve_tp_degree`` and the per-shard
+    ``nanodiloco_kv_blocks_free_per_shard`` family on /metrics, and the
+    same keys ride ``serve_stats`` JSONL -> summarize_run (older
+    JSONLs without them summarize unchanged)."""
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    eng = InferenceEngine(params, CFG, num_slots=2, max_len=32,
+                          kv_block_size=4, tp=2)
+    srv = ServeServer(
+        Scheduler(eng), port=0, host="127.0.0.1", request_timeout_s=120.0,
+    ).start()
+    try:
+        code, out = _post(srv.port, {"token_ids": [5, 9, 2],
+                                     "max_new_tokens": 2, "stop": False})
+        assert code == 200, out
+        code, body = _get(srv.port, "/metrics")
+        assert code == 200
+        m = parse_metrics_text(body)
+        assert m["nanodiloco_serve_tp_degree"] == 2
+        assert m['nanodiloco_kv_blocks_free_per_shard{shard="0"}'] == \
+            m['nanodiloco_kv_blocks_free_per_shard{shard="1"}'] == \
+            m["nanodiloco_kv_blocks_free"]
+        stats = srv._scheduler.stats()
+    finally:
+        srv.stop()
+    new = tmp_path / "new.jsonl"
+    new.write_text(json.dumps({
+        "serve_stats": True, "served": stats["served"],
+        "tp_degree": stats["tp_degree"],
+        "kv_pool": {"blocks_free": 16, "blocks_used": 0,
+                    "num_blocks": 16, "block_size": 4,
+                    "blocks_free_per_shard": {"0": 16, "1": 16}},
+    }) + "\n")
+    s = summarize_run(str(new))
+    assert s["serve_tp_degree"] == 2
+    assert s["kv_blocks_free_per_shard"] == {"0": 16, "1": 16}
+    old = tmp_path / "old.jsonl"
+    old.write_text(json.dumps({"serve_stats": True, "served": 1}) + "\n")
+    s2 = summarize_run(str(old))
+    assert "serve_tp_degree" not in s2
+    assert "kv_blocks_free_per_shard" not in s2
 
 
 # -- the HTTP server over a real socket --------------------------------------

@@ -331,6 +331,42 @@ def test_int8_paged_spec_greedy_parity(params):
         assert ticket.result["tokens"] == ref
 
 
+@pytest.mark.parametrize("kv", KV_MODES)
+def test_tp2_spec_streams_bit_match_sharded_generate(params, kv):
+    """Speculation on a tensor-parallel mesh: greedy AND sampled
+    spec-on streams through a tp=2 engine are bit-identical to solo
+    ``generate(mesh=...)`` on the SAME layout — the verify program's
+    sampling runs on replicated logits with the plain tick's exact
+    per-step key schedule, so sharding changes neither acceptance nor
+    tokens."""
+    from nanodiloco_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    mesh = build_mesh(MeshConfig(tp=2), devices=jax.devices()[:2])
+    eng = InferenceEngine(params, CFG, num_slots=2, max_len=32,
+                          spec_k=4, tp=2, **kv)
+    sched = Scheduler(eng)
+    reqs = [
+        GenRequest(prompt=(5, 9, 2, 11, 3), max_new_tokens=10, seed=0),
+        GenRequest(prompt=(7, 1, 4), max_new_tokens=8,
+                   temperature=0.8, top_k=20, seed=7),
+    ]
+    with jax.default_matmul_precision("highest"):
+        tickets = [sched.submit(r) for r in reqs]
+        _drain(sched, tickets)
+        refs = []
+        for r in reqs:
+            out = generate(
+                params, jnp.asarray([r.prompt], jnp.int32), CFG,
+                r.max_new_tokens, temperature=r.temperature,
+                top_k=r.top_k, top_p=r.top_p,
+                key=jax.random.key(r.seed), mesh=mesh,
+            )
+            refs.append(np.asarray(out[0]).tolist())
+    for ticket, ref in zip(tickets, refs):
+        assert ticket.result["tokens"] == ref
+    assert "tp2" in eng.compile_counts()["layout"]
+
+
 def test_stop_token_inside_a_draft_window_truncates(params):
     """A verify window can sail past EOS: the scheduler must scan the
     emitted vector in order, finish AT the stop token, and never leak
@@ -464,11 +500,13 @@ def test_compile_count_pinned_with_speculation():
             break
     assert all(t.done() for t in tickets)
     counts = eng.compile_counts()
-    if counts["verify"] is None:
+    if counts["verify:dense"] is None:
         pytest.skip("jit cache introspection unavailable on this jax")
-    assert 1 <= counts["verify"] <= 3   # T buckets {2, 3, 5}
-    assert counts["decode"] == 1
-    assert 1 <= counts["prefill_chunk"] <= 4
+    assert 1 <= counts["verify:dense"] <= 3   # T buckets {2, 3, 5}
+    assert counts["decode:dense"] == 1
+    assert 1 <= counts["prefill_chunk:dense"] <= 4
+    # every dispatched verify width was a bucketed T in {2, 3, 5}
+    assert set(counts["buckets"].get("verify", [])) <= {2, 3, 5}
 
 
 def test_warm_spec_compiles_buckets_and_leaves_no_trace(params):
@@ -489,8 +527,8 @@ def test_warm_spec_compiles_buckets_and_leaves_no_trace(params):
     warmed = eng.warm_spec()
     assert warmed == 3  # widths {1, 2, 4}
     counts = eng.compile_counts()
-    if counts["verify"] is not None:
-        assert counts["verify"] == 3
+    if counts["verify:paged"] is not None:
+        assert counts["verify:paged"] == 3
     ss = eng.spec_stats()
     assert ss["draft_tokens"] == 0 and ss["spec_ticks"] == 0
     assert ss["hist_tokens_per_tick"]["count"] == 0
