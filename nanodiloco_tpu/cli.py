@@ -654,6 +654,22 @@ def serve_main(argv: list[str]) -> None:
         engine, max_queue=args.max_queue, tracer=tracer,
         starvation_s=args.starvation_s if args.starvation_s > 0 else None,
     )
+
+    def swap_loader(ckpt_dir: str, step: int | None):
+        """POST /admin/swap's loader: the same self-describing restore
+        path boot used, plus a LOUD architecture check — a checkpoint
+        from a different config must be a readable 400, never a shape
+        error out of the next tick."""
+        new_cfg, _sc, params = _load_checkpoint_snapshot(ckpt_dir, step)
+        if new_cfg != model_cfg:
+            raise ValueError(
+                f"checkpoint {ckpt_dir} was trained with a different "
+                "model config than this replica serves — boot a new "
+                "replica for architecture changes; hot swap is for "
+                "same-shape weight updates"
+            )
+        return params
+
     server = ServeServer(
         scheduler, tokenizer,
         port=args.port, host=args.host,
@@ -662,6 +678,7 @@ def serve_main(argv: list[str]) -> None:
         request_timeout_s=args.request_timeout_s,
         default_deadline_s=args.deadline_s,
         profile_dir=args.profile_dir,
+        swap_loader=swap_loader,
     ).start()
     print(
         f"serving {args.checkpoint_dir} on {args.host}:{server.port} "
@@ -678,6 +695,10 @@ def serve_main(argv: list[str]) -> None:
         prev_recorder = flightrec.install(
             flightrec.FlightRecorder(dump_path=args.blackbox)
         )
+        # best-effort dump on SIGABRT/SIGSEGV/... too (train() already
+        # arms these): a replica killed by a native fault must leave its
+        # black box for the fleet router to attach to the ejection event
+        flightrec.arm_fatal_signals()
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         try:
@@ -704,6 +725,7 @@ def serve_main(argv: list[str]) -> None:
         if args.blackbox:
             from nanodiloco_tpu.obs import flightrec
 
+            flightrec.disarm_fatal_signals()
             flightrec.install(prev_recorder)
 
 
@@ -732,6 +754,155 @@ def _append_serve_stats(path: str, scheduler) -> None:
     _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "a") as f:
         f.write(json.dumps(rec) + "\n")
+
+
+def build_fleet_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nanodiloco_tpu fleet",
+        description="Fleet router + canary deploy controller over N "
+                    "serve replicas (nanodiloco_tpu/fleet): POST "
+                    "/v1/generate spreads load on queue-depth + "
+                    "kv_blocks_free, /healthz-503 replicas are ejected "
+                    "(blackbox attached), and --watch-checkpoint-dir "
+                    "canaries every fresh training checkpoint with "
+                    "promote-on-passing-compare-verdict / rollback.",
+    )
+    p.add_argument("--replica", action="append", required=True,
+                   metavar="URL[,BLACKBOX]",
+                   help="a serve replica's base URL, e.g. "
+                        "http://127.0.0.1:8101 — repeat per replica. An "
+                        "optional ,PATH names the replica's `serve "
+                        "--blackbox` dump file, attached to its "
+                        "ejection event")
+    p.add_argument("--port", type=int, default=0,
+                   help="router HTTP port; 0 (default) picks a free "
+                        "port, printed at startup")
+    p.add_argument("--host", type=str, default="0.0.0.0")
+    p.add_argument("--events-jsonl", type=str, default=None,
+                   metavar="JSONL",
+                   help="append every deploy event (promote/rollback/"
+                        "eject/drain/swap/canary) plus the final fleet-"
+                        "goodput record here — readable by `report` / "
+                        "summarize_run")
+    p.add_argument("--health-interval-s", type=float, default=1.0,
+                   help="replica probe cadence")
+    p.add_argument("--eject-after", type=int, default=3,
+                   help="consecutive UNREACHABLE probes before ejection "
+                        "(an explicit /healthz 503 — a dead engine loop "
+                        "— ejects immediately)")
+    p.add_argument("--drain-timeout-s", type=float, default=30.0,
+                   help="bounded wait for a draining replica's in-flight "
+                        "streams before its weight swap proceeds (the "
+                        "swap is safe under stragglers either way — "
+                        "they finish on the old weights)")
+    p.add_argument("--watch-checkpoint-dir", type=str, default=None,
+                   metavar="DIR",
+                   help="training --checkpoint-dir to watch: every "
+                        "fresh checkpoint is canaried and promoted/"
+                        "rolled back (unset = routing only)")
+    p.add_argument("--initial-step", type=int, default=None,
+                   help="checkpoint step the replicas booted with (the "
+                        "first canary baseline; without it the first "
+                        "discovered checkpoint promotes against no "
+                        "baseline)")
+    p.add_argument("--canary", type=str, default=None,
+                   help="replica name (r0, r1, ...) to canary on; "
+                        "default the first replica")
+    p.add_argument("--poll-interval-s", type=float, default=2.0,
+                   help="checkpoint-dir watch cadence")
+    p.add_argument("--canary-clients", type=int, default=2,
+                   help="closed-loop clients in the canary bench")
+    p.add_argument("--canary-requests", type=int, default=2,
+                   help="requests per canary client")
+    p.add_argument("--canary-max-new-tokens", type=int, default=16)
+    p.add_argument("--canary-prompt-len", type=int, default=12)
+    p.add_argument("--max-loss-increase", type=float, default=0.02,
+                   help="relative canary eval-loss increase that blocks "
+                        "promotion (the `report compare` loss gate)")
+    p.add_argument("--max-tps-drop", type=float, default=0.2,
+                   help="relative canary tokens/s drop that blocks "
+                        "promotion")
+    p.add_argument("--max-latency-increase", type=float, default=0.5,
+                   help="relative canary TTFT increase that blocks "
+                        "promotion")
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def fleet_main(argv: list[str]) -> None:
+    args = build_fleet_parser().parse_args(argv)
+    import signal
+    import threading
+    import time
+
+    from nanodiloco_tpu.fleet import DeployController, FleetRouter, Replica
+
+    replicas = []
+    for i, spec in enumerate(args.replica):
+        url, _, blackbox = spec.partition(",")
+        replicas.append(Replica(
+            name=f"r{i}", url=url.rstrip("/"),
+            blackbox=blackbox or None,
+        ))
+    router = FleetRouter(
+        replicas,
+        port=args.port, host=args.host,
+        events_jsonl=args.events_jsonl,
+        health_interval_s=args.health_interval_s,
+        eject_after_failures=args.eject_after,
+        drain_timeout_s=args.drain_timeout_s,
+        quiet=args.quiet,
+    ).start()
+    print(
+        f"fleet router on {args.host}:{router.port} over "
+        f"{len(replicas)} replica(s): "
+        + ", ".join(f"{r.name}={r.url}" for r in replicas),
+        flush=True,
+    )
+    stop = threading.Event()
+    controller_thread = None
+    if args.watch_checkpoint_dir:
+        controller = DeployController(
+            router, args.watch_checkpoint_dir,
+            initial_step=args.initial_step,
+            canary=args.canary,
+            poll_interval_s=args.poll_interval_s,
+            max_loss_increase=args.max_loss_increase,
+            max_tps_drop=args.max_tps_drop,
+            max_latency_increase=args.max_latency_increase,
+            bench_kwargs={
+                "clients": args.canary_clients,
+                "requests_per_client": args.canary_requests,
+                "max_new_tokens": args.canary_max_new_tokens,
+                "prompt_len": args.canary_prompt_len,
+            },
+        )
+        controller_thread = threading.Thread(
+            target=controller.run, args=(stop,),
+            name="nanodiloco-fleet-deploy", daemon=True,
+        )
+        controller_thread.start()
+        print(
+            f"watching {args.watch_checkpoint_dir} for checkpoints "
+            f"(canary={controller.canary}, "
+            f"deployed_step={controller.deployed_step})",
+            flush=True,
+        )
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:  # not the main thread (embedded use)
+            break
+    try:
+        while not stop.is_set():
+            time.sleep(0.2)
+    finally:
+        stop.set()
+        if controller_thread is not None:
+            controller_thread.join(timeout=10)
+        router.stop()
+        if args.events_jsonl:
+            print(f"deploy events -> {args.events_jsonl}", flush=True)
 
 
 def _load_checkpoint_snapshot(checkpoint_dir: str, step: int | None):
@@ -1291,6 +1462,11 @@ def main(argv: list[str] | None = None) -> None:
         return
     if argv and argv[0] == "serve":
         serve_main(argv[1:])
+        return
+    if argv and argv[0] == "fleet":
+        # multi-replica serve router + canary-gated continuous
+        # deployment (nanodiloco_tpu/fleet)
+        fleet_main(argv[1:])
         return
     if argv and argv[0] == "export-hf":
         export_hf_main(argv[1:])

@@ -1,0 +1,42 @@
+"""The fleet tier: train -> serve, closed (ROADMAP item 1).
+
+Three coupled pieces over the serving stack (nanodiloco_tpu/serve):
+
+- hot-swap weight deployment lives IN the engine
+  (``InferenceEngine.swap_weights`` + the ``/admin/swap`` endpoint):
+  params from the latest training checkpoint replace the serving params
+  atomically, the paged KV pool survives untouched, in-flight streams
+  finish bit-identically on the weights they were admitted under, and
+  the prefix cache is invalidated;
+- ``router.FleetRouter`` — a small HTTP front over N serve replicas:
+  least-loaded routing from queue-depth + ``kv_blocks_free`` gauges,
+  ejection on ``/healthz`` 503 with the replica's flight-recorder black
+  box attached to the event, drain/refill one-replica-at-a-time weight
+  pushes, and a fleet goodput ledger (replica-seconds accounted by
+  state);
+- ``deploy.DeployController`` — watches the training checkpoint dir,
+  canaries each fresh checkpoint on one replica (closed-loop bench +
+  held-out eval loss), and promotes fleet-wide only on a passing
+  ``report compare`` verdict — automatic rollback on regression.
+
+``python -m nanodiloco_tpu fleet --replica URL[,BLACKBOX] ...`` boots
+the router (+ the controller with ``--watch-checkpoint-dir``).
+"""
+
+from nanodiloco_tpu.fleet.deploy import (
+    DeployController,
+    canary_bench,
+    canary_eval_loss,
+    latest_checkpoint_step,
+)
+from nanodiloco_tpu.fleet.router import EVENT_KINDS, FleetRouter, Replica
+
+__all__ = [
+    "DeployController",
+    "EVENT_KINDS",
+    "FleetRouter",
+    "Replica",
+    "canary_bench",
+    "canary_eval_loss",
+    "latest_checkpoint_step",
+]

@@ -1,0 +1,716 @@
+"""Fleet router: N serve replicas behind one front door.
+
+The serving tier (nanodiloco_tpu/serve) is one replica: one engine, one
+scheduler, one HTTP endpoint. This module is the fleet layer above it —
+the piece that turns "a server" into "a service" (ROADMAP item 1, the
+millions-of-users scenario; MegaScale's every-second-accounted
+discipline, arXiv:2402.15627, applied to serving):
+
+- **Load spreading.** ``POST /v1/generate`` forwards each request to the
+  least-loaded READY replica, scored from the gauges the replicas
+  already expose on their health bodies: queue depth + busy slots
+  first, then most free KV blocks (HBM headroom breaks ties — two
+  replicas with equal queues differ in how many more admissions their
+  block pools can take). A local in-flight counter per replica keeps
+  the spread honest BETWEEN health ticks.
+- **Ejection.** A health loop probes every replica's ``/healthz``
+  (liveness) and ``/readyz`` (readiness). An explicit 503 on /healthz
+  means the engine loop DIED — that replica never recovers and is
+  ejected immediately; an unreachable socket is ejected after
+  ``eject_after_failures`` consecutive probes (a restart window is not
+  a death). The ejection event attaches the replica's flight-recorder
+  black box (``serve --blackbox`` dump) when one exists: the forensics
+  travel WITH the fleet event, not in a log directory someone has to
+  know about.
+- **Drain/refill weight pushes.** ``push_weights`` walks the target
+  replicas ONE AT A TIME: drain (the replica flips not-ready and stops
+  admitting; the router stops routing to it), wait — bounded — for
+  in-flight streams to finish, ``/admin/swap`` the new checkpoint in,
+  resume. One replica is re-weighting at any moment, so fleet capacity
+  never drops by more than one replica. The wait is hygiene, not
+  correctness: the engine's weight-generation machinery makes a swap
+  under stragglers safe (they finish on the old weights).
+- **Fleet goodput.** Every replica-second is attributed to a state
+  (serving-ready / serving-unready / draining / ejected), so ONE number
+  says what fraction of wall-clock x replicas was actually available to
+  serve tokens — the goodput ledger's discipline extended across the
+  fleet. Every promote/rollback/eject/drain/swap event lands in the
+  deploy JSONL (``events_jsonl``) read by ``summarize_run`` / ``report``.
+
+Testability follows the scheduler's discipline: the probe and post
+functions, clock, and sleep are injectable, so every routing and
+ejection decision is provable with scripted replicas and a fake clock —
+no sockets, no model (tests/test_fleet.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from nanodiloco_tpu.obs import flightrec
+from nanodiloco_tpu.obs.telemetry import (
+    OPENMETRICS_CONTENT_TYPE,
+    render_exposition,
+)
+from nanodiloco_tpu.serve.client import http_get, http_post_json
+
+#: deploy-event kinds the router/controller counters track (one counter
+#: family on /metrics; unknown kinds still log, they just don't gauge)
+EVENT_KINDS = (
+    "promote", "rollback", "rollback_failed", "eject", "drain", "swap",
+    "swap_failed", "canary_start", "canary_baseline",
+    "canary_baseline_failed", "canary_verdict", "canary_failed",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Replica:
+    """One serve replica the router fronts. ``url`` is the base
+    (``http://host:port``); ``blackbox`` is the path of the replica's
+    ``serve --blackbox`` dump, attached to its ejection event when the
+    file exists."""
+
+    name: str
+    url: str
+    blackbox: str | None = None
+
+
+class _ReplicaState:
+    """Per-replica tracking: status, readiness, last health stats, and
+    per-state wall-clock seconds (the fleet goodput numerator). All
+    mutation happens under the router's lock."""
+
+    def __init__(self, replica: Replica, clock: Callable[[], float]) -> None:
+        self.replica = replica
+        self.status = "serving"        # serving | draining | ejected
+        self.ready = False             # last readiness probe
+        self.failures = 0              # consecutive unreachable probes
+        self.stats: dict = {}          # queue_depth/slots_busy/kv_blocks_free/...
+        self.router_inflight = 0       # requests this router has in flight here
+        self._clock = clock
+        self._since = clock()
+        self.seconds = {
+            "serving_ready": 0.0, "serving_unready": 0.0,
+            "draining": 0.0, "ejected": 0.0,
+        }
+
+    def _bucket(self) -> str:
+        if self.status == "serving":
+            return "serving_ready" if self.ready else "serving_unready"
+        return self.status
+
+    def account(self) -> None:
+        """Fold elapsed time into the CURRENT state bucket (called on
+        every transition and before every snapshot, so the partition is
+        exact by construction — the goodput ledger's rule)."""
+        now = self._clock()
+        self.seconds[self._bucket()] += max(0.0, now - self._since)
+        self._since = now
+
+    def set(self, status: str | None = None,
+            ready: bool | None = None) -> None:
+        self.account()
+        if status is not None:
+            self.status = status
+        if ready is not None:
+            self.ready = ready
+
+
+class FleetRouter:
+    """HTTP front + health loop + drain/refill weight pushes over a
+    replica set. ``probe``/``post`` are injectable (tests script them);
+    the defaults speak the serve wire contract via ``serve/client``."""
+
+    def __init__(
+        self,
+        replicas: list[Replica],
+        *,
+        port: int = 0,
+        host: str = "0.0.0.0",
+        probe: Callable[[Replica], dict] | None = None,
+        post: Callable[..., tuple[int, dict]] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        wall: Callable[[], float] = time.time,
+        sleep: Callable[[float], None] = time.sleep,
+        health_interval_s: float = 1.0,
+        probe_timeout_s: float = 2.0,
+        eject_after_failures: int = 3,
+        drain_timeout_s: float = 30.0,
+        request_timeout_s: float = 600.0,
+        events_jsonl: str | None = None,
+        quiet: bool = False,
+    ) -> None:
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique; got {names}")
+        self._clock = clock
+        self._wall = wall
+        self._sleep = sleep
+        self._probe = probe or self._http_probe
+        self._post = post or self._http_post
+        self.health_interval_s = float(health_interval_s)
+        # per-GET bound for the health probes, deliberately well below
+        # the request timeout: the sweep is SEQUENTIAL, so one dead
+        # host (SYN timeout, no RST) must not stall every other
+        # replica's probe — and so ejection — behind it
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.eject_after_failures = int(eject_after_failures)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._request_timeout_s = float(request_timeout_s)
+        self.events_jsonl = events_jsonl
+        self.quiet = quiet
+        self._states = [_ReplicaState(r, clock) for r in replicas]
+        self._by_name = {st.replica.name: st for st in self._states}
+        # reentrant: the health tick ejects (and so logs/counts an
+        # event) while holding the state lock
+        self._lock = threading.RLock()
+        # serializes whole push_weights calls (controller thread vs an
+        # operator's /fleet/push) — see push_weights
+        self._push_lock = threading.Lock()
+        self._events_lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+        self._t0 = clock()
+        self._stop = threading.Event()
+        self._health_thread: threading.Thread | None = None
+        self._http_thread: threading.Thread | None = None
+
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # scrapes must not spam stdout
+                pass
+
+            def _reply(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_json(self, code: int, doc: dict) -> None:
+                self._reply(code, (json.dumps(doc) + "\n").encode(),
+                            "application/json")
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._reply(200, router.render_metrics().encode(),
+                                OPENMETRICS_CONTENT_TYPE)
+                elif path in ("/healthz", "/readyz"):
+                    code, doc = router.health()
+                    self._reply_json(code, doc)
+                elif path == "/fleet/status":
+                    self._reply_json(200, router.fleet_stats())
+                else:
+                    self._reply(404, b"not found\n", "text/plain")
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    doc = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(doc, dict):
+                        raise ValueError("body must be a JSON object")
+                except ValueError as e:
+                    self._reply_json(400, {"error": f"bad JSON: {e}"})
+                    return
+                if path == "/v1/generate":
+                    code, out = router.handle_generate(doc)
+                    self._reply_json(code, out)
+                elif path == "/fleet/push":
+                    code, out = router.handle_push(doc)
+                    self._reply_json(code, out)
+                else:
+                    self._reply(404, b"not found\n", "text/plain")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FleetRouter":
+        self.health_tick()  # replicas routable before the first request
+        if self._health_thread is None:
+            self._health_thread = threading.Thread(
+                target=self._health_loop, name="nanodiloco-fleet-health",
+                daemon=True,
+            )
+            self._health_thread.start()
+        if self._http_thread is None:
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="nanodiloco-fleet-http", daemon=True,
+            )
+            self._http_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        for t in (self._health_thread, self._http_thread):
+            if t is not None:
+                t.join(timeout=5)
+        self._health_thread = self._http_thread = None
+        # the final fleet-goodput record: the one number for this
+        # router's whole life, next to the deploy events that shaped it
+        self._append_jsonl({"fleet_goodput": self.fleet_stats()})
+
+    def _health_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.health_tick()
+            except Exception:  # a probe bug must never kill routing
+                pass
+            self._stop.wait(self.health_interval_s)
+
+    # -- wire defaults (injectable) ------------------------------------------
+
+    def _http_probe(self, replica: Replica) -> dict:
+        """One observation of a replica over the wire:
+        ``{"reachable", "live", "ready", "stats"}``. The stats ride on
+        the health/readiness BODIES (queue_depth, slots_busy,
+        kv_blocks_free, deploy_generation, in_flight) — no /metrics
+        parse on the health path."""
+        out: dict = {"reachable": False, "live": False, "ready": False,
+                     "stats": {}}
+        try:
+            code, body = http_get(replica.url + "/healthz",
+                                  timeout=self.probe_timeout_s)
+        except OSError:
+            return out
+        out["reachable"] = True
+        out["live"] = code == 200
+        try:
+            doc = json.loads(body)
+        except (json.JSONDecodeError, ValueError):
+            doc = {}
+        for k in ("queue_depth", "slots_busy", "kv_blocks_free",
+                  "deploy_generation", "draining"):
+            if doc.get(k) is not None:
+                out["stats"][k] = doc[k]
+        try:
+            rcode, rbody = http_get(replica.url + "/readyz",
+                                    timeout=self.probe_timeout_s)
+            out["ready"] = rcode == 200
+            rdoc = json.loads(rbody)
+            if isinstance(rdoc, dict) and rdoc.get("in_flight") is not None:
+                out["stats"]["in_flight"] = rdoc["in_flight"]
+        except (OSError, json.JSONDecodeError, ValueError):
+            out["ready"] = False
+        return out
+
+    def _http_post(self, replica: Replica, path: str, doc: dict,
+                   timeout: float | None = None) -> tuple[int, dict]:
+        return http_post_json(
+            replica.url + path, doc,
+            timeout=self._request_timeout_s if timeout is None else timeout,
+        )
+
+    # -- health + ejection ---------------------------------------------------
+
+    def health_tick(self) -> None:
+        """One probe sweep over the non-ejected replicas: refresh
+        readiness + load stats, count consecutive failures, eject."""
+        for st in self._states:
+            if st.status == "ejected":
+                continue
+            r = self._probe(st.replica)
+            with self._lock:
+                if st.status == "ejected":  # a push thread raced us
+                    continue
+                stats = r.get("stats") or {}
+                if stats:
+                    st.stats.update(stats)
+                if r.get("live"):
+                    st.failures = 0
+                    # a replica draining ITSELF (a push in progress)
+                    # stays unroutable regardless of its readyz
+                    st.set(ready=bool(r.get("ready"))
+                           and st.status == "serving")
+                    continue
+                if r.get("reachable"):
+                    # an explicit /healthz 503: the engine loop DIED.
+                    # It never comes back — eject now, don't wait out
+                    # the failure budget meant for restart windows.
+                    self._eject_locked(st, "healthz_503")
+                    continue
+                st.failures += 1
+                st.set(ready=False)
+                if st.failures >= self.eject_after_failures:
+                    self._eject_locked(st, "unreachable")
+
+    def _eject_locked(self, st: _ReplicaState, reason: str) -> None:
+        """Eject a replica (caller holds the lock): it stops being a
+        routing candidate permanently, and its flight-recorder black
+        box — if one landed on disk — is attached to the event, so the
+        ejection carries its own forensics."""
+        st.set(status="ejected", ready=False)
+        fields: dict = {"replica": st.replica.name, "reason": reason}
+        bb = self._read_blackbox(st.replica)
+        if bb:
+            fields["blackbox"] = bb
+        self.log_event("eject", **fields)
+
+    def _read_blackbox(self, replica: Replica) -> dict | None:
+        path = replica.blackbox
+        if not path or not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            return {
+                "path": path,
+                "reason": doc.get("reason"),
+                "t_unix": doc.get("t_unix"),
+                "events": len(doc.get("events") or []),
+            }
+        except (OSError, json.JSONDecodeError, ValueError):
+            return {"path": path}
+
+    # -- routing -------------------------------------------------------------
+
+    def pick(self) -> _ReplicaState | None:
+        """Least-loaded READY replica: lowest queue depth + busy slots
+        (+ this router's own in-flight count, which keeps the spread
+        honest between health ticks), then MOST free KV blocks, then
+        name for determinism."""
+        return self._pick_excluding(set())
+
+    def handle_generate(self, doc: dict) -> tuple[int, dict]:
+        """Forward one request to the least-loaded ready replica; one
+        retry on a DIFFERENT replica when the first answers 503/429 or
+        the socket fails (the health loop owns ejection — a forward
+        failure only counts against the failure budget; a 429 means
+        THAT replica's queue is full, and the router's load view can be
+        a health-tick stale, so another replica may have headroom)."""
+        tried: set[str] = set()
+        last_429: tuple[int, dict] | None = None
+        for _ in range(2):
+            st = self._pick_excluding(tried)
+            if st is None:
+                return 503, {"error": "no ready replica",
+                             **({"tried": sorted(tried)} if tried else {})}
+            name = st.replica.name
+            tried.add(name)
+            with self._lock:
+                st.router_inflight += 1
+            try:
+                code, out = self._post(st.replica, "/v1/generate", doc)
+            except (OSError, ValueError):
+                # ValueError = a non-JSON body (misconfigured URL, an
+                # intermediary's error page): route around it — a bad
+                # replica must cost the client a retry, not a dropped
+                # connection from a dead handler thread
+                with self._lock:
+                    st.failures += 1
+                    st.set(ready=False)
+                continue
+            finally:
+                with self._lock:
+                    st.router_inflight -= 1
+            if code == 503:
+                # the replica's loop is dead or it is draining: route
+                # around it now; the health loop decides ejection
+                with self._lock:
+                    st.set(ready=False)
+                continue
+            if code == 429:
+                # queue full HERE, not fleet-wide: try another replica;
+                # if every candidate is saturated, the client gets the
+                # honest 429 (backpressure), never a fake 503
+                last_429 = (code, {**out, "replica": name}
+                            if isinstance(out, dict) else out)
+                continue
+            if isinstance(out, dict):
+                out = {**out, "replica": name}
+            return code, out
+        if last_429 is not None:
+            return last_429
+        return 503, {"error": "no replica could take the request",
+                     "tried": sorted(tried)}
+
+    def _pick_excluding(self, names: set[str]) -> _ReplicaState | None:
+        with self._lock:
+            cands = [st for st in self._states
+                     if st.status == "serving" and st.ready
+                     and st.replica.name not in names]
+            if not cands:
+                return None
+
+            def key(st: _ReplicaState):
+                s = st.stats
+                load = ((s.get("queue_depth") or 0)
+                        + (s.get("slots_busy") or 0) + st.router_inflight)
+                free = s.get("kv_blocks_free")
+                return (load, -(free if free is not None else -1),
+                        st.replica.name)
+
+            return min(cands, key=key)
+
+    # -- drain/refill weight pushes ------------------------------------------
+
+    def handle_push(self, doc: dict) -> tuple[int, dict]:
+        ckpt = doc.get("checkpoint_dir")
+        if not isinstance(ckpt, str) or not ckpt:
+            return 400, {"error": "checkpoint_dir must be a non-empty string"}
+        step = doc.get("step")
+        if step is not None and (isinstance(step, bool)
+                                 or not isinstance(step, int)):
+            return 400, {"error": f"step must be an integer; got {step!r}"}
+        reps = doc.get("replicas")
+        if reps is not None and not (
+            isinstance(reps, list) and all(isinstance(r, str) for r in reps)
+        ):
+            return 400, {"error": "replicas must be a list of names"}
+        results = self.push_weights(ckpt, step, replicas=reps)
+        ok = bool(results) and all(r.get("ok") for r in results)
+        return (200 if ok else 502), {"ok": ok, "results": results}
+
+    def push_weights(self, checkpoint_dir: str, step: int | None = None,
+                     *, replicas: list[str] | None = None) -> list[dict]:
+        """Drain/refill each target replica ONE AT A TIME (fleet
+        capacity never drops by more than one replica): drain -> wait
+        (bounded) for in-flight streams to finish -> /admin/swap ->
+        resume. Returns one result dict per replica, in push order.
+        Serialized under a push lock: the deploy controller's thread
+        and an operator's /fleet/push must never interleave drains and
+        resumes on the same replica (push 2's resume landing mid-push
+        1's drain wait would both corrupt the wait and break the
+        one-replica-at-a-time capacity invariant)."""
+        with self._push_lock:
+            targets = [
+                st for st in self._states
+                if st.status == "serving"
+                and (replicas is None or st.replica.name in replicas)
+            ]
+            if replicas is not None:
+                missing = set(replicas) - {st.replica.name
+                                           for st in targets}
+                if missing:
+                    return [{"replica": n, "ok": False,
+                             "error": "not a serving replica"}
+                            for n in sorted(missing)]
+            return [self._push_one(st, checkpoint_dir, step)
+                    for st in targets]
+
+    def _push_one(self, st: _ReplicaState, checkpoint_dir: str,
+                  step: int | None) -> dict:
+        name = st.replica.name
+        self.log_event("drain", replica=name,
+                       **({"step": step} if step is not None else {}))
+        with self._lock:
+            st.set(status="draining", ready=False)
+        try:
+            self._post(st.replica, "/admin/drain", {}, timeout=30.0)
+            # bounded wait for in-flight streams: hygiene for a clean
+            # canary window, NOT correctness — the engine's generation
+            # machinery lets stragglers finish on the old weights even
+            # if the swap lands under them
+            t0 = self._clock()
+            while self._clock() - t0 < self.drain_timeout_s:
+                r = self._probe(st.replica)
+                if (r.get("stats") or {}).get("in_flight", 0) == 0:
+                    break
+                self._sleep(0.05)
+            body = {"checkpoint_dir": checkpoint_dir}
+            if step is not None:
+                body["step"] = step
+            code, out = self._post(st.replica, "/admin/swap", body)
+            ok = code == 200 and isinstance(out, dict) and out.get("swapped")
+            self._post(st.replica, "/admin/resume", {}, timeout=30.0)
+            with self._lock:
+                if ok:
+                    st.stats["deploy_generation"] = out.get(
+                        "deploy_generation"
+                    )
+                # routable again immediately; the next health tick
+                # re-reads the replica's own readiness. Guarded: the
+                # health loop may have EJECTED this replica while the
+                # push was mid-flight (it crashed during the drain
+                # wait) — resurrecting it would re-route traffic to a
+                # corpse and double-count its eventual re-ejection.
+                if st.status == "draining":
+                    st.set(status="serving", ready=True)
+            if ok:
+                self.log_event(
+                    "swap", replica=name,
+                    deploy_generation=out.get("deploy_generation"),
+                    **({"step": step} if step is not None else {}),
+                )
+                return {"replica": name, "ok": True,
+                        "deploy_generation": out.get("deploy_generation")}
+            err = out.get("error") if isinstance(out, dict) else str(out)
+            self.log_event("swap_failed", replica=name, code=code,
+                           error=err,
+                           **({"step": step} if step is not None else {}))
+            return {"replica": name, "ok": False, "code": code,
+                    "error": err}
+        except (OSError, ValueError) as e:
+            # ValueError covers JSONDecodeError: a replica answering a
+            # plain-text body (an old serve without /admin routes, a
+            # proxy error page) must be a failed push, not an exception
+            # that silently kills the deploy controller's thread
+            try:
+                # the drain may have SUCCEEDED before the failure: a
+                # replica left draining admits nothing forever (queued
+                # requests expire at their deadlines) — best-effort
+                # resume, because a failed push must cost a retry, not
+                # a replica's whole capacity
+                self._post(st.replica, "/admin/resume", {}, timeout=30.0)
+            except (OSError, ValueError):
+                pass
+            with self._lock:
+                if st.status == "draining":  # not ejected mid-push
+                    st.set(status="serving")
+                st.failures += 1
+            self.log_event("swap_failed", replica=name, error=str(e),
+                           **({"step": step} if step is not None else {}))
+            return {"replica": name, "ok": False, "error": str(e)}
+
+    # -- events + observability ----------------------------------------------
+
+    def log_event(self, kind: str, **fields) -> dict:
+        """One deploy event: counted for /metrics, appended to the
+        deploy JSONL (``{"deploy_event": kind, ...}`` — the record shape
+        ``summarize_run`` and ``report faults`` read), mirrored into the
+        flight-recorder ring, and printed unless quiet."""
+        with self._lock:
+            self._counters[kind] = self._counters.get(kind, 0) + 1
+        rec = {"deploy_event": kind, "t_unix": round(self._wall(), 3),
+               **fields}
+        self._append_jsonl(rec)
+        try:
+            flightrec.record_event("deploy", kind=kind, **{
+                k: v for k, v in fields.items() if not isinstance(v, dict)
+            })
+        except Exception:
+            pass
+        if not self.quiet:
+            print(f"[fleet] {json.dumps(rec)}", flush=True)
+        return rec
+
+    def _append_jsonl(self, rec: dict) -> None:
+        if not self.events_jsonl:
+            return
+        try:
+            d = os.path.dirname(os.path.abspath(self.events_jsonl))
+            os.makedirs(d, exist_ok=True)
+            with self._events_lock, open(self.events_jsonl, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass  # a full disk must not take down routing
+
+    def replica_names(self) -> list[str]:
+        return [st.replica.name for st in self._states]
+
+    def url_of(self, name: str) -> str:
+        return self._by_name[name].replica.url
+
+    def state_of(self, name: str) -> dict:
+        st = self._by_name[name]
+        with self._lock:
+            return {"name": name, "status": st.status, "ready": st.ready,
+                    "failures": st.failures, "stats": dict(st.stats)}
+
+    def fleet_stats(self) -> dict:
+        """The fleet snapshot: readiness counts, per-replica deploy
+        generations, event counters, and the fleet goodput fraction —
+        replica-seconds spent serving-AND-ready over wall-clock x
+        replicas (what fraction of the fleet's theoretical capacity was
+        actually available; drains, ejections, and dead time all show
+        up as the gap to 1.0)."""
+        with self._lock:
+            for st in self._states:
+                st.account()
+            elapsed = max(0.0, self._clock() - self._t0)
+            n = len(self._states)
+            ready_s = sum(st.seconds["serving_ready"] for st in self._states)
+            out = {
+                "replicas_total": n,
+                "replicas_ready": sum(
+                    1 for st in self._states
+                    if st.status == "serving" and st.ready
+                ),
+                "replicas_serving": sum(
+                    1 for st in self._states if st.status == "serving"
+                ),
+                "replicas_ejected": sum(
+                    1 for st in self._states if st.status == "ejected"
+                ),
+                "deploy_generations": {
+                    st.replica.name: st.stats.get("deploy_generation")
+                    for st in self._states
+                },
+                "events": dict(sorted(self._counters.items())),
+                "elapsed_s": round(elapsed, 6),
+                "replica_ready_s": round(ready_s, 6),
+                "replica_seconds": {
+                    st.replica.name: {
+                        k: round(v, 6) for k, v in st.seconds.items()
+                    }
+                    for st in self._states
+                },
+                "fleet_goodput_fraction": (
+                    round(ready_s / (elapsed * n), 6)
+                    if elapsed > 0 and n else None
+                ),
+            }
+        return out
+
+    def health(self) -> tuple[int, dict]:
+        s = self.fleet_stats()
+        doc = {
+            "healthy": s["replicas_ready"] > 0,
+            "replicas_ready": s["replicas_ready"],
+            "replicas_total": s["replicas_total"],
+        }
+        return (200 if doc["healthy"] else 503), doc
+
+    def render_metrics(self) -> str:
+        s = self.fleet_stats()
+        families: list = [
+            ("nanodiloco_fleet_replicas_ready", "gauge",
+             "replicas serving AND ready (routing candidates)",
+             [(None, s["replicas_ready"])]),
+            ("nanodiloco_fleet_replicas_serving", "gauge",
+             "replicas not ejected (draining included)",
+             [(None, s["replicas_serving"])]),
+            ("nanodiloco_fleet_replicas_total", "gauge",
+             "replicas this router was configured with",
+             [(None, s["replicas_total"])]),
+        ]
+        gens = [(name, g) for name, g in
+                sorted(s["deploy_generations"].items()) if g is not None]
+        if gens:
+            families.append((
+                "nanodiloco_deploy_generation", "gauge",
+                "weight generation each replica serves (bumped by every "
+                "hot swap)",
+                [({"replica": name}, g) for name, g in gens],
+            ))
+        families.append((
+            "nanodiloco_fleet_events", "counter",
+            "deploy events by kind (promote/rollback/eject/drain/swap/"
+            "canary)",
+            [({"event": k}, v) for k, v in sorted(s["events"].items())]
+            + [(None, sum(s["events"].values()))],
+        ))
+        if s["fleet_goodput_fraction"] is not None:
+            families.append((
+                "nanodiloco_fleet_goodput_fraction", "gauge",
+                "replica-seconds serving-and-ready / (wall-clock x "
+                "replicas) — the fleet's every-second-accounted "
+                "availability number",
+                [(None, s["fleet_goodput_fraction"])],
+            ))
+        return render_exposition(families)

@@ -26,6 +26,7 @@ from nanodiloco_tpu.serve.client import http_get, http_post_json
 from nanodiloco_tpu.serve.engine import InferenceEngine
 from nanodiloco_tpu.serve.prefix_cache import PrefixCache
 from nanodiloco_tpu.serve.scheduler import (
+    ControlHandle,
     GenRequest,
     QueueFull,
     Scheduler,
@@ -38,6 +39,7 @@ __all__ = [
     "PromptLookupProposer",
     "BlockPool",
     "BlocksExhausted",
+    "ControlHandle",
     "InferenceEngine",
     "http_get",
     "http_post_json",

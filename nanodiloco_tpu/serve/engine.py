@@ -96,6 +96,18 @@ stays UNsharded: a block id names the same physical block on every
 shard, so allocation, copy-on-write sharing, and rejection rollback are
 degree-independent by construction.
 
+Hot-swap weight deployment (``swap_weights``, fleet/): new params from
+the latest training checkpoint replace the serving params atomically —
+the KV arenas, block pool, and slot state are untouched (only params
+change). Slots tag the weight GENERATION they were admitted under: a
+stream in flight at the swap keeps dispatching its own generation's
+params (one extra masked dispatch per tick during the transition
+window) and finishes bit-identical to solo ``generate()`` on the OLD
+weights, while every post-swap admission runs — bit-identically — on
+the new ones. The prefix cache is invalidated at the swap: its K/V was
+computed under the old params. No recompile: the programs are keyed by
+config/shape, and a swap changes neither.
+
 Known divergence, inherited from ``generate`` and narrowed here: dense-
 dispatch token-choice MoE sizes expert capacity from the tokens in the
 call, so a decode tick routes over B slots where ``generate`` routes
@@ -349,6 +361,16 @@ class InferenceEngine:
         self.decode_ticks = 0                      # every decode tick
         self.hist_spec_tokens_per_tick = Histogram(_SPEC_BUCKETS)
 
+        # hot-swap weight generations (fleet/): ``swap_weights`` bumps
+        # ``deploy_generation`` and stages the new params; slots tag the
+        # generation they were ADMITTED under, so during a transition
+        # window live streams keep decoding on the weights they started
+        # with while new admissions take the new ones — a swap never
+        # drops (or silently reweights) an in-flight request.
+        self.deploy_generation = 0
+        self._params_by_gen: dict[int, object] = {0: self.params}
+        self._slot_gen = [0] * b
+
         s = self.max_len
         self._tokens = np.zeros(b, np.int32)       # next input token per slot
         self._pos = np.zeros(b, np.int32)          # next cache write position
@@ -408,6 +430,77 @@ class InferenceEngine:
         if self.mesh is None:
             return jnp.asarray(arr)
         return jax.device_put(arr, self._replicated)
+
+    # -- hot-swap weight deployment (fleet/) ---------------------------------
+
+    def swap_weights(self, params) -> int:
+        """Atomically deploy new params without dropping in-flight
+        requests. The new tree must match the serving params leaf for
+        leaf (same structure, shapes, dtypes — validated LOUDLY here, at
+        the swap, never as a shape error out of the next tick); with a
+        mesh it is ``device_put`` into the SAME serving layout boot
+        established, so the first post-swap tick never pays a resharding
+        transfer. The KV arenas are untouched — only params change — so
+        live slots keep their cache rows and finish on the weights they
+        were admitted under (their generation's params stay resident
+        until the last such slot retires), while every later admission
+        runs on the new weights. The prefix cache is INVALIDATED: its
+        K/V was computed under the old params, and a post-swap hit
+        would splice stale rows into a new-weight stream. Must be
+        called from the tick thread (``Scheduler.call_on_tick`` hands a
+        swap over from HTTP threads). Returns the new generation."""
+        old = jax.tree_util.tree_flatten_with_path(self.params)[0]
+        new = jax.tree_util.tree_flatten_with_path(params)[0]
+        if [p for p, _ in old] != [p for p, _ in new]:
+            raise ValueError(
+                "swap_weights: new params tree structure does not match "
+                "the serving params (different architecture?)"
+            )
+        for (path, a), (_, b) in zip(old, new):
+            if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
+                name = "/".join(str(getattr(k, "key", k)) for k in path)
+                raise ValueError(
+                    f"swap_weights: leaf {name} is "
+                    f"{tuple(b.shape)}:{b.dtype} but the serving engine "
+                    f"holds {tuple(a.shape)}:{a.dtype} — the checkpoint "
+                    "does not fit this engine's compiled programs"
+                )
+        if self.mesh is not None:
+            from nanodiloco_tpu.parallel.sharding import named, param_specs
+
+            params = jax.device_put(
+                params, named(self.mesh, param_specs(self.cfg))
+            )
+        self.deploy_generation += 1
+        self._params_by_gen[self.deploy_generation] = params
+        self.params = params
+        if self.prefix_cache is not None:
+            # cached K/V was computed under the old weights; reusing it
+            # would break the bit-parity contract (paged mode derefs the
+            # cached blocks through on_evict, exactly like LRU eviction)
+            self.prefix_cache.clear()
+        self._prune_param_generations()
+        return self.deploy_generation
+
+    def _prune_param_generations(self) -> None:
+        """Drop param generations no live (or mid-prefill) slot
+        references — an old snapshot stays resident only while a stream
+        admitted under it is still running."""
+        live = {self.deploy_generation}
+        for s in range(self.num_slots):
+            if self._active[s] or self._prefills[s] is not None:
+                live.add(self._slot_gen[s])
+        for g in [g for g in self._params_by_gen if g not in live]:
+            del self._params_by_gen[g]
+
+    def _gen_groups(self) -> dict[int, list[int]]:
+        """Live slots grouped by the weight generation they were
+        admitted under (one group in the steady state)."""
+        groups: dict[int, list[int]] = {}
+        for s in range(self.num_slots):
+            if self._active[s]:
+                groups.setdefault(self._slot_gen[s], []).append(s)
+        return groups
 
     # -- request validation (shared with the server's 400 path) -------------
 
@@ -519,6 +612,10 @@ class InferenceEngine:
                     self._jarr(i * self.chunk_size, np.int32),
                 )
             done = len(blocks) * self.chunk_size
+        # the request is admitted under the CURRENT weights; every chunk
+        # and decode tick of its life dispatches this generation's
+        # params, even if a hot swap lands mid-stream
+        self._slot_gen[slot] = self.deploy_generation
         self._prefills[slot] = _Prefill(request, ids, done)
         return -(-(len(ids) - done) // self.chunk_size)
 
@@ -527,6 +624,7 @@ class InferenceEngine:
         """Dispatch one (bucketed) chunk through the mode's compiled
         program; returns (token scalar, logits [1, V])."""
         self._buckets.setdefault("prefill_chunk", set()).add(len(chunk))
+        params = self._params_by_gen[self._slot_gen[slot]]
         args = (
             self._jarr([chunk], np.int32), self._jarr(valid),
             self._jarr(pos, np.int32), self._jarr(last, np.int32),
@@ -536,12 +634,12 @@ class InferenceEngine:
         )
         if self.paged:
             tok, logits, self.pool = self._chunk_paged(
-                self.params, self.pool,
+                params, self.pool,
                 self._jarr(self._tables[slot]), *args,
             )
         else:
             tok, logits, self.cache = self._chunk(
-                self.params, self.cache, args[0], args[1],
+                params, self.cache, args[0], args[1],
                 self._jarr(slot, np.int32), *args[2:],
             )
         return tok, logits
@@ -644,6 +742,12 @@ class InferenceEngine:
         if (
             self.prefix_cache is not None
             and getattr(req, "prefix_cache", True)
+            # a slot admitted before a hot swap computed these K/V rows
+            # under the OLD weights: inserting them into the (cleared,
+            # current-generation) cache would hand stale rows to the
+            # next same-prefix request — the exact corruption clear()
+            # exists to prevent
+            and self._slot_gen[slot] == self.deploy_generation
         ):
             # explicit admission: every completed (non-opted-out)
             # prefill offers its whole-chunk prefix; only chunks not
@@ -748,6 +852,28 @@ class InferenceEngine:
             return self._step_plain()
         return self._step_verify(drafts, k_tick)
 
+    def _gen_dispatches(self, dev) -> list[tuple[object, list[int], object]]:
+        """(params, slots, active array) per weight generation with a
+        live slot. The steady state — every live slot on one generation
+        — is ONE dispatch reusing the cached device-resident mask, the
+        exact pre-hot-swap behavior. During a swap's transition window
+        there is one masked dispatch per generation: each stream runs
+        under the weights it was admitted with, and the dispatches
+        compose because inactive slots' cache writes are masked/dropped
+        (the PR-6 fix) and routing masks dead slots out entirely."""
+        groups = self._gen_groups()
+        if len(groups) <= 1:
+            slots = next(iter(groups.values())) if groups else []
+            gen = next(iter(groups)) if groups else self.deploy_generation
+            return [(self._params_by_gen[gen], slots, dev["active"])]
+        out = []
+        for gen in sorted(groups):
+            act = np.zeros(self.num_slots, np.int32)
+            act[groups[gen]] = 1
+            out.append((self._params_by_gen[gen], groups[gen],
+                        self._jarr(act)))
+        return out
+
     def _step_plain(self) -> list[list[int]]:
         b = self.num_slots
         keys_now = np.empty((b, 2), np.uint32)
@@ -759,33 +885,33 @@ class InferenceEngine:
                 keys_now[s] = self._dummy_key
         self._buckets.setdefault("decode", set()).add(1)
         dev = self._stage_dev()
-        if self.paged:
-            nxt, self.pool = self._decode_paged(
-                self.params, self.pool, dev["tables"],
-                self._jarr(self._tokens), self._jarr(self._pos),
-                self._jarr(keys_now),
-                dev["temp"], dev["topk"], dev["topp"], dev["active"],
-            )
-        else:
-            nxt, self.cache = self._decode(
-                self.params, self.cache,
-                self._jarr(self._tokens), self._jarr(self._pos),
-                dev["key_valid"], self._jarr(keys_now),
-                dev["temp"], dev["topk"], dev["topp"], dev["active"],
-            )
-        nxt = np.asarray(nxt)
-        out: list[list[int]] = []
-        for s in range(b):
-            if self._active[s]:
+        tokens = self._jarr(self._tokens)
+        pos = self._jarr(self._pos)
+        keys = self._jarr(keys_now)
+        out: list[list[int]] = [[] for _ in range(b)]
+        for params, slots, active in self._gen_dispatches(dev):
+            if self.paged:
+                nxt, self.pool = self._decode_paged(
+                    params, self.pool, dev["tables"],
+                    tokens, pos, keys,
+                    dev["temp"], dev["topk"], dev["topp"], active,
+                )
+            else:
+                nxt, self.cache = self._decode(
+                    params, self.cache,
+                    tokens, pos,
+                    dev["key_valid"], keys,
+                    dev["temp"], dev["topk"], dev["topp"], active,
+                )
+            nxt = np.asarray(nxt)
+            for s in slots:
                 self._pos[s] += 1
                 self._step_idx[s] += 1
                 self._tokens[s] = nxt[s]
                 tok = int(nxt[s])
                 if self._spec_ok[s]:
                     self.speculator.observe(s, [tok])
-                out.append([tok])
-            else:
-                out.append([])
+                out[s] = [tok]
         return out
 
     def _step_verify(self, drafts: list[list[int]], k_tick: int) -> list[list[int]]:
@@ -819,48 +945,49 @@ class InferenceEngine:
                     keys_now[s, :n] = ks[lo:lo + n]
         self._buckets.setdefault("verify", set()).add(t)
         dev = self._stage_dev()
-        args = (
-            self._jarr(tokens), self._jarr(self._pos),
-            self._jarr(dlen), self._jarr(keys_now),
-            dev["temp"], dev["topk"], dev["topp"], dev["active"],
-        )
-        if self.paged:
-            sampled, counts, self.pool = self._verify(
-                self.params, self.pool, dev["tables"], *args,
-            )
-        else:
-            sampled, counts, self.cache = self._verify(
-                self.params, self.cache, args[0], args[1], args[2],
-                dev["key_valid"], *args[3:],
-            )
-        sampled = np.asarray(sampled)
-        counts = np.asarray(counts)
-        out: list[list[int]] = []
-        for s in range(b):
-            if not self._active[s]:
-                out.append([])
-                continue
-            c = int(counts[s])
-            emitted = [int(v) for v in sampled[s, :c]]
-            self._pos[s] += c
-            self._step_idx[s] += c
-            self._tokens[s] = emitted[-1]
-            proposed = int(dlen[s])
-            accepted = c - 1
-            self.spec_draft_tokens += proposed
-            self.spec_accepted_tokens += accepted
-            self.spec_rejected_tokens += proposed - accepted
-            if proposed:
-                # drafting slots only: a no-draft neighbour riding the
-                # verify tick emits 1 by construction, and counting it
-                # would make the gated tokens-per-tick economics measure
-                # batch composition instead of speculation quality
-                self.hist_spec_tokens_per_tick.observe(c)
-            if self._spec_ok[s]:
+        jtokens = self._jarr(tokens)
+        jpos = self._jarr(self._pos)
+        jdlen = self._jarr(dlen)
+        jkeys = self._jarr(keys_now)
+        out: list[list[int]] = [[] for _ in range(b)]
+        for params, slots, active in self._gen_dispatches(dev):
+            if self.paged:
+                sampled, counts, self.pool = self._verify(
+                    params, self.pool, dev["tables"],
+                    jtokens, jpos, jdlen, jkeys,
+                    dev["temp"], dev["topk"], dev["topp"], active,
+                )
+            else:
+                sampled, counts, self.cache = self._verify(
+                    params, self.cache, jtokens, jpos, jdlen,
+                    dev["key_valid"], jkeys,
+                    dev["temp"], dev["topk"], dev["topp"], active,
+                )
+            sampled = np.asarray(sampled)
+            counts = np.asarray(counts)
+            for s in slots:
+                c = int(counts[s])
+                emitted = [int(v) for v in sampled[s, :c]]
+                self._pos[s] += c
+                self._step_idx[s] += c
+                self._tokens[s] = emitted[-1]
+                proposed = int(dlen[s])
+                accepted = c - 1
+                self.spec_draft_tokens += proposed
+                self.spec_accepted_tokens += accepted
+                self.spec_rejected_tokens += proposed - accepted
                 if proposed:
-                    self.speculator.feedback(s, proposed, accepted)
-                self.speculator.observe(s, emitted)
-            out.append(emitted)
+                    # drafting slots only: a no-draft neighbour riding
+                    # the verify tick emits 1 by construction, and
+                    # counting it would make the gated tokens-per-tick
+                    # economics measure batch composition instead of
+                    # speculation quality
+                    self.hist_spec_tokens_per_tick.observe(c)
+                if self._spec_ok[s]:
+                    if proposed:
+                        self.speculator.feedback(s, proposed, accepted)
+                    self.speculator.observe(s, emitted)
+                out[s] = emitted
         self.spec_ticks += 1
         return out
 
@@ -971,6 +1098,9 @@ class InferenceEngine:
             self._slot_blocks[slot] = []
             self._tables[slot] = self.block_pool.num_blocks
         self._dev = None
+        # a retiring slot may have been the last reference to a
+        # pre-swap weight generation — release the old snapshot
+        self._prune_param_generations()
 
     def _evict_prefix_blocks(self, blocks) -> None:
         """Prefix-cache LRU eviction hook (paged): drop the cache's

@@ -62,6 +62,14 @@ class PrefixCache:
         self.hit_tokens = 0      # prompt tokens NOT re-prefilled
         self.insertions = 0      # chunks inserted
         self.evictions = 0       # chunks LRU-evicted
+        # weight-generation tag: bumped by ``clear()`` (a serve-weight
+        # hot swap invalidates every entry — cached K/V was computed
+        # under the OLD params, and a post-swap hit would splice
+        # old-weight rows into a new-weight stream, breaking the
+        # bit-parity contract). The tag lets tests and gauges pin that
+        # a post-swap lookup can never see pre-swap KV.
+        self.generation = 0
+        self.invalidations = 0   # chunks dropped by clear()
 
     @property
     def cached_tokens(self) -> int:
@@ -129,6 +137,25 @@ class PrefixCache:
                     self.on_evict(evicted)
         return inserted
 
+    def clear(self) -> int:
+        """Invalidate EVERY cached chunk and bump ``generation`` — the
+        weight hot-swap path (``InferenceEngine.swap_weights``): cached
+        K/V rows were computed under the old params and are garbage
+        under the new ones, so reuse across a swap would break the
+        streams-bit-identical-to-solo-``generate()`` contract in the
+        quietest possible way (a plausible-looking stream computed from
+        stale keys). Runs ``on_evict`` per entry, so the paged engine's
+        block references are released exactly as LRU eviction would.
+        Returns the number of chunks dropped."""
+        n = len(self._blocks)
+        while self._blocks:
+            _key, evicted = self._blocks.popitem(last=False)
+            if self.on_evict is not None:
+                self.on_evict(evicted)
+        self.invalidations += n
+        self.generation += 1
+        return n
+
     def evict_lru(self) -> bool:
         """Evict exactly the LRU entry (False when empty) — the paged
         engine's reclaim-under-pressure path: cached blocks are a
@@ -150,6 +177,8 @@ class PrefixCache:
             "hit_tokens": self.hit_tokens,
             "insertions": self.insertions,
             "evictions": self.evictions,
+            "generation": self.generation,
+            "invalidations": self.invalidations,
             "cached_tokens": self.cached_tokens,
             "capacity_tokens": self.capacity_tokens,
             "chunk_tokens": self.chunk_tokens,

@@ -145,6 +145,27 @@ class Ticket:
         return self.result
 
 
+class ControlHandle:
+    """Handle for a function handed to the tick thread via
+    ``Scheduler.call_on_tick``: ``wait(timeout)`` blocks until the tick
+    loop has run it (returns True), then ``result``/``error`` carry the
+    outcome. Exists because the engine belongs to the tick thread — a
+    weight hot-swap arriving over HTTP must run BETWEEN ticks, never
+    concurrently with a compiled dispatch."""
+
+    def __init__(self, fn: Callable[[], object]) -> None:
+        self.fn = fn
+        self.result: object | None = None
+        self.error: str | None = None
+        self._event = threading.Event()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._event.wait(timeout)
+
+
 @dataclasses.dataclass
 class _Queued:
     ticket: Ticket
@@ -228,6 +249,16 @@ class Scheduler:
         self._queue: collections.deque[_Queued] = collections.deque()
         self._lock = threading.Lock()
         self._next_rid = 0
+        # drain state (fleet weight pushes): True stops ADMISSION only —
+        # queued requests stay queued (deadlines still expire them),
+        # in-flight prefills and streams run to completion. The serving
+        # replica reports not-READY while draining but stays LIVE: the
+        # router must stop routing to it, not eject it as dead.
+        self._draining = False
+        # control queue: functions other threads hand to the tick thread
+        # (weight swaps mutate the engine, which is single-threaded by
+        # construction); run at the top of the next tick
+        self._control: collections.deque[ControlHandle] = collections.deque()
         # stats (read by the server's gauges; written by the tick thread
         # except rejected, which submit bumps under the queue lock)
         self._served = 0
@@ -277,6 +308,37 @@ class Scheduler:
             self._queue.append(_Queued(ticket, request, now, deadline))
         return ticket
 
+    # -- drain + tick-thread control (any thread) ----------------------------
+
+    def drain(self) -> None:
+        """Stop admitting queued requests (in-flight streams finish;
+        the queue keeps accepting submissions and keeps expiring
+        deadlines). The replica's /readyz flips not-ready so the fleet
+        router routes around it during a weight push."""
+        self._draining = True
+
+    def resume(self) -> None:
+        self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def in_flight(self) -> int:
+        """Slots holding a request (prefilling or decoding) — what a
+        drain waits on before a weight push proceeds."""
+        return sum(1 for s in self._slots if s is not None)
+
+    def call_on_tick(self, fn: Callable[[], object]) -> ControlHandle:
+        """Schedule ``fn`` onto the tick thread (run before the next
+        tick's scheduling passes). The returned handle carries the
+        result — or the error: a control function raising must report
+        to ITS caller, never kill the serving loop."""
+        handle = ControlHandle(fn)
+        with self._lock:
+            self._control.append(handle)
+        return handle
+
     # -- the tick loop (one thread) ------------------------------------------
 
     def tick(self) -> int:
@@ -284,6 +346,20 @@ class Scheduler:
         Returns the number of occupied slots (prefilling or decoding)
         after the tick, so a serving loop can idle when there is no
         work."""
+        # 0. run control functions handed over from other threads (a
+        # weight hot-swap): they mutate the backend, which belongs to
+        # this thread; an error is the CALLER's to read, never fatal to
+        # the serving loop
+        while True:
+            with self._lock:
+                if not self._control:
+                    break
+                handle = self._control.popleft()
+            try:
+                handle.result = handle.fn()
+            except Exception as e:
+                handle.error = f"{type(e).__name__}: {e}"
+            handle._event.set()
         now = self._clock()
         # 1. drop queued requests whose deadline passed or whose client
         # cancelled (they never held a slot)
@@ -343,7 +419,11 @@ class Scheduler:
         # the stall is counted under its own reason.
         slot = 0
         blocked_on_blocks = False
-        while slot < len(self._slots):
+        # a draining scheduler admits NOTHING (the whole point of the
+        # drain: in-flight streams finish, the queue holds) — and the
+        # stall counters stay quiet: a drain is an operator action, not
+        # a capacity signal
+        while not self._draining and slot < len(self._slots):
             if self._slots[slot] is not None:
                 slot += 1
                 continue
@@ -395,7 +475,8 @@ class Scheduler:
                 t_admit, chunks,
             )
             slot += 1
-        if (not blocked_on_blocks and self.queue_depth() > 0
+        if (not self._draining and not blocked_on_blocks
+                and self.queue_depth() > 0
                 and all(s is not None for s in self._slots)):
             self._blocked_no_slot += 1
 
@@ -719,6 +800,13 @@ class Scheduler:
         tp = getattr(self.backend, "tp", None)
         if tp is not None:
             out["tp_degree"] = int(tp)
+        # hot-swap deployment state (fleet/): which weight generation
+        # this replica serves, and whether it is draining for a push.
+        # Fake/scripted backends without the attribute omit the key.
+        out["draining"] = self._draining
+        gen = getattr(self.backend, "deploy_generation", None)
+        if gen is not None:
+            out["deploy_generation"] = int(gen)
         prefix_stats = getattr(self.backend, "prefix_stats", None)
         if prefix_stats is not None:
             ps = prefix_stats()

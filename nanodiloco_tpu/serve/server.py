@@ -21,8 +21,25 @@ Endpoints:
   (queued/TTFT/decode seconds). 400 on a malformed request, 429 when
   the admission queue is full (backpressure — the client retries
   later), 503 once the engine loop has died.
-- ``GET /healthz`` — 200 while the tick loop is alive, 503 after it
-  died; body carries queue depth and slot occupancy.
+- ``GET /healthz`` — LIVENESS: 200 while the tick loop is alive, 503
+  after it died; body carries queue depth, slot occupancy, the KV
+  block-pool free count, and the deploy generation (the fleet router's
+  routing inputs). ``?ready=1`` answers the READINESS contract instead.
+- ``GET /readyz`` — READINESS: 200 only when the loop is alive AND the
+  scheduler is not draining. A replica draining for a weight push is
+  alive-but-not-ready — the router must route around it, not eject it
+  as dead (liveness and readiness are different questions, and
+  conflating them turns every deploy into a false crash).
+- ``POST /admin/drain`` / ``POST /admin/resume`` — stop/resume
+  admission (in-flight streams always finish); the fleet router brackets
+  a weight push with these.
+- ``POST /admin/swap`` — ``{"checkpoint_dir": str, "step": int?}``:
+  load that checkpoint's merged snapshot (the ``restore_raw``
+  self-describing path) and hot-swap it into the engine between ticks
+  (``swap_weights``) — the KV pool survives, in-flight streams finish
+  on the old weights, the prefix cache is invalidated. 404 unless the
+  server was built with a ``swap_loader`` (the serve CLI wires one; a
+  bare embedded server is not remotely re-weightable by default).
 - ``GET /metrics`` — OpenMetrics serve gauges (queue depth, slot
   occupancy, TTFT last/p50/p95, decode tokens/s), counters (requests
   by outcome, tokens), and real histograms (cumulative buckets +
@@ -41,6 +58,7 @@ import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 from nanodiloco_tpu.obs.telemetry import (
     OPENMETRICS_CONTENT_TYPE,
@@ -69,12 +87,19 @@ class ServeServer:
         default_deadline_s: float | None = None,
         idle_sleep_s: float = 0.002,
         profile_dir: str | None = None,
+        swap_loader=None,
+        swap_timeout_s: float = 120.0,
     ) -> None:
         self._scheduler = scheduler
         self._tokenizer = tokenizer
         # POST /debug/profile?seconds=N target directory (None = the
         # endpoint answers 404; live profiling is an operator opt-in)
         self.profile_dir = profile_dir
+        # POST /admin/swap loader: (checkpoint_dir, step|None) -> params
+        # matching the engine's serving config (raise ValueError when it
+        # does not — the handler's 400). None = the endpoint answers 404.
+        self._swap_loader = swap_loader
+        self._swap_timeout_s = float(swap_timeout_s)
         self._default_new = int(default_max_new_tokens)
         self._cap_new = int(max_new_tokens_cap)
         self._timeout_s = float(request_timeout_s)
@@ -103,10 +128,19 @@ class ServeServer:
                             "application/json")
 
             def do_GET(self):
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
                     self._reply(200, server.render_metrics().encode(),
                                 OPENMETRICS_CONTENT_TYPE)
+                elif path == "/readyz" or (
+                    # parsed, not substring-matched: a stray query
+                    # whose TEXT contains "ready=1" (?thready=1) must
+                    # not silently flip a liveness probe to readiness
+                    path == "/healthz"
+                    and "1" in parse_qs(query).get("ready", [])
+                ):
+                    code, doc = server.readiness()
+                    self._reply_json(code, doc)
                 elif path == "/healthz":
                     code, doc = server.health()
                     self._reply_json(code, doc)
@@ -119,6 +153,18 @@ class ServeServer:
                     code, out = handle_profile_request(
                         server.profile_dir, self.path
                     )
+                    self._reply_json(code, out)
+                    return
+                if path in ("/admin/drain", "/admin/resume", "/admin/swap"):
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        doc = json.loads(self.rfile.read(n) or b"{}")
+                        if not isinstance(doc, dict):
+                            raise ValueError("body must be a JSON object")
+                    except ValueError as e:
+                        self._reply_json(400, {"error": f"bad JSON: {e}"})
+                        return
+                    code, out = server.handle_admin(path, doc)
                     self._reply_json(code, out)
                     return
                 if path != "/v1/generate":
@@ -193,7 +239,12 @@ class ServeServer:
                 except Exception:
                     pass
                 return
-            if live == 0 and self._scheduler.queue_depth() == 0:
+            if live == 0 and (
+                self._scheduler.queue_depth() == 0
+                or getattr(self._scheduler, "draining", False)
+            ):
+                # a draining scheduler admits nothing: spinning on a
+                # non-empty queue would be a busy loop going nowhere
                 time.sleep(self._idle_sleep_s)
 
     def loop_alive(self) -> bool:
@@ -332,6 +383,60 @@ class ServeServer:
             speculate=speculate,
         )
 
+    # -- fleet control plane -------------------------------------------------
+
+    def handle_admin(self, path: str, doc: dict) -> tuple[int, dict]:
+        """The drain/resume/swap endpoints the fleet router drives
+        (fleet/router.py) — a replica's side of a weight push."""
+        sched = self._scheduler
+        if path == "/admin/drain":
+            sched.drain()
+            return 200, {"draining": True, "in_flight": sched.in_flight()}
+        if path == "/admin/resume":
+            sched.resume()
+            return 200, {"draining": False}
+        # /admin/swap
+        if self._swap_loader is None:
+            return 404, {
+                "error": "this server has no swap loader (the serve CLI "
+                         "configures one; embedded servers pass "
+                         "swap_loader=)"
+            }
+        backend = sched.backend
+        if not hasattr(backend, "swap_weights"):
+            return 404, {"error": "backend does not support weight swaps"}
+        if not self.loop_alive():
+            return 503, {"error": "engine loop is not running",
+                         "detail": self._loop_error}
+        ckpt = doc.get("checkpoint_dir")
+        step = doc.get("step")
+        if not isinstance(ckpt, str) or not ckpt:
+            return 400, {"error": "checkpoint_dir must be a non-empty string"}
+        if step is not None and (isinstance(step, bool)
+                                 or not isinstance(step, int)):
+            return 400, {"error": f"step must be an integer; got {step!r}"}
+        try:
+            # the LOAD runs on this HTTP thread (disk + host work); only
+            # the swap itself crosses to the tick thread
+            params = self._swap_loader(ckpt, step)
+        except (ValueError, FileNotFoundError, KeyError, SystemExit) as e:
+            return 400, {"error": f"cannot load checkpoint: {e}"}
+        handle = sched.call_on_tick(lambda: backend.swap_weights(params))
+        if not handle.wait(self._swap_timeout_s):
+            return 504, {"error": "swap did not run within "
+                                  f"{self._swap_timeout_s:.0f}s (tick "
+                                  "loop wedged?)"}
+        if handle.error:
+            # swap_weights validates loudly (tree/shape mismatch) — the
+            # checkpoint is the problem, not the server
+            return 400, {"error": handle.error}
+        return 200, {
+            "swapped": True,
+            "deploy_generation": handle.result,
+            "checkpoint_dir": ckpt,
+            **({"step": step} if step is not None else {}),
+        }
+
     # -- observability -------------------------------------------------------
 
     def health(self) -> tuple[int, dict]:
@@ -343,10 +448,40 @@ class ServeServer:
             "slots_busy": s["slots_busy"],
             "slots_total": s["slots_total"],
             "served": s["served"],
+            # the fleet router's routing inputs ride on the liveness
+            # body (one GET per health tick, no /metrics parse): current
+            # load, KV headroom, drain state, deploy generation
+            "draining": s.get("draining", False),
         }
+        kv = s.get("kv_pool")
+        if isinstance(kv, dict) and kv.get("blocks_free") is not None:
+            doc["kv_blocks_free"] = kv["blocks_free"]
+        if s.get("deploy_generation") is not None:
+            doc["deploy_generation"] = s["deploy_generation"]
         if self._loop_error:
             doc["error"] = self._loop_error
         return (200 if alive else 503), doc
+
+    def readiness(self) -> tuple[int, dict]:
+        """READINESS, split from liveness: can this replica take NEW
+        traffic right now? A draining replica is alive (/healthz 200 —
+        the router must not eject it as dead) but not ready (503 here)
+        until its weight push resumes it."""
+        alive = self.loop_alive()
+        sched = self._scheduler
+        draining = bool(getattr(sched, "draining", False))
+        doc = {
+            "ready": alive and not draining,
+            "draining": draining,
+            "in_flight": sched.in_flight(),
+            "queue_depth": sched.queue_depth(),
+        }
+        gen = getattr(sched.backend, "deploy_generation", None)
+        if gen is not None:
+            doc["deploy_generation"] = int(gen)
+        if self._loop_error:
+            doc["error"] = self._loop_error
+        return (200 if doc["ready"] else 503), doc
 
     def render_metrics(self) -> str:
         s = self._scheduler.stats()
@@ -375,6 +510,14 @@ class ServeServer:
             ("nanodiloco_serve_tp_degree",
              "tensor-parallel shards the decode tick spans (1 = "
              "unsharded)", s.get("tp_degree")),
+            ("nanodiloco_deploy_generation",
+             "weight generation this replica serves (bumped by every "
+             "hot swap; 0 = the boot checkpoint)",
+             s.get("deploy_generation")),
+            ("nanodiloco_serve_draining",
+             "1 while admission is drained for a weight push (alive "
+             "but not ready)", int(s["draining"]) if "draining" in s
+             else None),
         ]
         families: list = [
             (name, "gauge", help_text, [(None, value)])

@@ -390,6 +390,40 @@ def summarize_run(path: str) -> dict[str, Any]:
             ):
                 if spec.get(key) is not None:
                     out[out_key] = spec[key]
+    # fleet deployment (nanodiloco_tpu/fleet): the deploy-event timeline
+    # a `fleet --events-jsonl` session writes — promote/rollback/eject
+    # counts, the last promoted step, and the router's final fleet-
+    # goodput record. Keys appear only when the JSONL carries deploy
+    # records; older JSONLs summarize unchanged.
+    deploys = [r for r in recs if r.get("deploy_event")]
+    if deploys:
+        out["deploy_events"] = len(deploys)
+        dkinds: dict[str, int] = {}
+        for d in deploys:
+            dkinds[d["deploy_event"]] = dkinds.get(d["deploy_event"], 0) + 1
+        out["deploy_kinds"] = dkinds
+        for kind, key in (("promote", "fleet_promotes"),
+                          ("rollback", "fleet_rollbacks"),
+                          ("eject", "fleet_ejections")):
+            if dkinds.get(kind):
+                out[key] = dkinds[kind]
+        promoted = [d.get("step") for d in deploys
+                    if d.get("deploy_event") == "promote"
+                    and d.get("step") is not None]
+        if promoted:
+            out["deployed_step_last"] = int(promoted[-1])
+    fleet = [r["fleet_goodput"] for r in recs
+             if isinstance(r.get("fleet_goodput"), dict)]
+    if fleet:
+        last = fleet[-1]
+        if last.get("fleet_goodput_fraction") is not None:
+            out["fleet_goodput_fraction"] = last["fleet_goodput_fraction"]
+        if last.get("replicas_total") is not None:
+            out["fleet_replicas"] = last["replicas_total"]
+        if last.get("replicas_ejected"):
+            out["fleet_replicas_ejected"] = last["replicas_ejected"]
+        if last.get("replica_ready_s") is not None:
+            out["fleet_replica_ready_s"] = last["replica_ready_s"]
     # goodput ledger (obs/goodput): stitch the per-lifetime snapshots —
     # a supervised crash-loopy run appends several lifetimes to ONE
     # JSONL, and the honest number is the merged fraction including the
@@ -490,6 +524,16 @@ _COMPARE_METRICS = [
     # summaries carry them (training compares are untouched).
     ("outer_sync_share_sync", True),
     ("outer_sync_share_async", True),
+    # canary quality (fleet/deploy.py canary_bench): held-out eval loss
+    # of the checkpoint under canary — the deploy controller's verdict
+    # runs THROUGH compare_runs, so the promotion gate and the CLI gate
+    # are one implementation. Loss direction, loss threshold. Gated
+    # only when both summaries carry it.
+    ("canary_eval_loss", True),
+    # fleet goodput (fleet/router.py): replica-seconds serving-and-
+    # ready over wall-clock x replicas — a share like comm_share
+    # (ABSOLUTE threshold), higher is better (a drop is the regression).
+    ("fleet_goodput_fraction", False),
     # goodput fraction (obs/goodput ledger, stitched across restarts):
     # a share of wall-clock like comm_share, so it gates on an ABSOLUTE
     # move past max_comm_share_increase — but HIGHER is better (a drop
@@ -501,7 +545,8 @@ _COMPARE_METRICS = [
 # move past max_comm_share_increase, never a relative one; the
 # regression direction follows the key's lower_better flag
 _SHARE_KEYS = {"comm_share_last", "outer_sync_share_sync",
-               "outer_sync_share_async", "goodput_fraction"}
+               "outer_sync_share_async", "goodput_fraction",
+               "fleet_goodput_fraction"}
 
 # serve latency keys (seconds, lower better) that use the dedicated
 # latency threshold instead of the loss one

@@ -61,6 +61,16 @@ ceremony:
      speedup claim — the chip sitting is what pins serving models
      bigger than one chip).
 
+  11. a continuous-deployment drill: a 2-replica `serve` fleet behind
+     the `fleet` router CLI with the canary controller watching a live
+     training checkpoint dir — a fresh checkpoint is canaried and
+     promoted fleet-wide (traffic 200 throughout, post-promote stream
+     bit-matched against solo ``generate()`` on the promoted
+     checkpoint), a SIGABRT'd replica is ejected with its black box
+     attached to the ejection event, and a poisoned (NaN) checkpoint is
+     rolled back by the canary gate — the train->serve loop closed on
+     the live backend.
+
 Usage (each phase also runs alone):
     python scripts/chip_agenda.py               # everything
     python scripts/chip_agenda.py bench sweep   # named phases
@@ -1820,6 +1830,353 @@ def phase_tp_decode() -> None:
     })
 
 
+def phase_fleet() -> None:
+    """Continuous-deployment drill on this backend: train a tiny model
+    (two committed checkpoints), boot a 2-replica `serve` fleet behind
+    the `fleet` router CLI with the canary controller watching the
+    checkpoint dir, and drive the whole train->serve loop end to end —
+    the fresh checkpoint is canaried and PROMOTED fleet-wide (traffic
+    through the router stays 200 throughout; a post-promote greedy
+    stream is replayed through solo ``generate()`` on the promoted
+    checkpoint for bit-parity), a SIGABRT'd replica is EJECTED with its
+    flight-recorder black box attached to the ejection event, and a
+    deliberately poisoned (NaN-snapshot) checkpoint is ROLLED BACK by
+    the canary gate with the verdict in the deploy JSONL. On CPU this
+    pins the control plane + correctness; fleet throughput claims
+    belong to the chip sitting (PERF.md)."""
+    import signal as _signal
+    import socket
+    import tempfile
+
+    from nanodiloco_tpu.obs.telemetry import parse_metrics_text
+    from nanodiloco_tpu.serve.client import http_get, http_post_json
+
+    live = chip_is_live()
+    tmp = tempfile.mkdtemp(prefix="nanodiloco-fleet-")
+    ckpt = os.path.join(tmp, "ckpt")
+    deploy_jsonl = os.path.join(tmp, "deploy.jsonl")
+    model_cfg = os.path.join(tmp, "model.json")
+    with open(model_cfg, "w") as f:
+        json.dump({
+            "vocab_size": 2048, "hidden_size": 128, "intermediate_size": 256,
+            "num_attention_heads": 4, "num_hidden_layers": 2,
+            "max_position_embeddings": 256,
+        }, f)
+    budget = float(os.environ.get("NANODILOCO_AGENDA_TIMEOUT_FLEET", "1800"))
+    # two committed checkpoints from ONE run (steps 2 and 4): the fleet
+    # boots on step 2, and step 4 is the "fresh checkpoint" the
+    # controller discovers and canaries
+    train = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu",
+         "--total-steps", "4", "--inner-steps", "2",
+         "--batch-size", "8", "--per-device-batch-size", "4",
+         "--seq-length", "256", "--warmup-steps", "2",
+         "--llama-config-file", model_cfg, "--no-measure-comm",
+         "--no-cost-analysis", "--quiet",
+         "--checkpoint-dir", ckpt, "--checkpoint-every", "1",
+         "--log-dir", tmp, "--run-name", "fleet-probe"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=budget * 0.3,
+    )
+    if train.returncode != 0:
+        record({"phase": "fleet",
+                "error": (train.stderr or train.stdout)[-400:]})
+        raise SystemExit(1)
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    ports = [free_port() for _ in range(3)]
+    blackboxes = [os.path.join(tmp, f"r{i}-blackbox.json")
+                  for i in range(2)]
+    replicas = []
+    for i in range(2):
+        replicas.append(subprocess.Popen(
+            [sys.executable, "-m", "nanodiloco_tpu", "serve",
+             "--checkpoint-dir", ckpt, "--step", "2",
+             "--port", str(ports[i]), "--host", "127.0.0.1",
+             "--slots", "2", "--max-len", "192", "--chunk-size", "16",
+             "--kv-block-size", "16", "--prefix-cache-tokens", "256",
+             "--max-new-tokens-cap", "96",
+             "--blackbox", blackboxes[i]],
+            cwd=REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        ))
+    fleet_proc = None
+
+    def stop(proc, sig=None):
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(sig or _signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def events():
+        if not os.path.exists(deploy_jsonl):
+            return []
+        out = []
+        with open(deploy_jsonl) as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+        return out
+
+    def wait_event(kind, deadline, **match):
+        while time.time() < deadline:
+            for e in events():
+                if e.get("deploy_event") == kind and all(
+                    e.get(k) == v for k, v in match.items()
+                ):
+                    return e
+            time.sleep(0.3)
+        return None
+
+    try:
+        deadline = time.time() + budget * 0.25
+        for i, port in enumerate(ports[:2]):
+            up = False
+            while time.time() < deadline and replicas[i].poll() is None:
+                try:
+                    up = http_get(f"http://127.0.0.1:{port}/healthz",
+                                  timeout=3)[0] == 200
+                except OSError:
+                    up = False
+                if up:
+                    break
+                time.sleep(0.3)
+            if not up:
+                record({"phase": "fleet",
+                        "error": f"replica {i} never answered /healthz"})
+                raise SystemExit(1)
+        fleet_port = ports[2]
+        fleet_proc = subprocess.Popen(
+            [sys.executable, "-m", "nanodiloco_tpu", "fleet",
+             "--replica", f"http://127.0.0.1:{ports[0]},{blackboxes[0]}",
+             "--replica", f"http://127.0.0.1:{ports[1]},{blackboxes[1]}",
+             "--port", str(fleet_port), "--host", "127.0.0.1",
+             "--events-jsonl", deploy_jsonl,
+             "--watch-checkpoint-dir", ckpt, "--initial-step", "2",
+             "--poll-interval-s", "1", "--health-interval-s", "0.3",
+             "--drain-timeout-s", "15",
+             "--canary-clients", "2", "--canary-requests", "1",
+             "--canary-max-new-tokens", "8"],
+            cwd=REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        url = f"http://127.0.0.1:{fleet_port}"
+        # traffic through the router WHILE the canary/promote machinery
+        # runs: every request must answer 200 (zero dropped), and each
+        # greedy stream must bit-match solo generate() on whichever
+        # checkpoint its admission generation carried (step 2 pre-swap,
+        # step 4 post-swap — the replay below checks membership)
+        racing_doc = {"token_ids": [(i * 13 + 3) % 256 for i in range(18)],
+                      "max_new_tokens": 24, "temperature": 0.0,
+                      "seed": 5, "stop": False, "prefix_cache": False}
+        deadline = time.time() + budget * 0.2
+        racing = None
+        while racing is None and time.time() < deadline:
+            try:
+                code, out = http_post_json(url + "/v1/generate",
+                                           racing_doc, timeout=120)
+            except OSError:
+                time.sleep(0.3)
+                continue
+            if code == 200:
+                racing = out
+            elif code == 503:
+                time.sleep(0.3)  # router still probing replicas up
+            else:
+                record({"phase": "fleet",
+                        "error": f"racing request failed {code}: {out}"})
+                raise SystemExit(1)
+        if racing is None:
+            record({"phase": "fleet",
+                    "error": "router never served the racing request"})
+            raise SystemExit(1)
+        promote = wait_event("promote", time.time() + budget * 0.25,
+                             step=4)
+        if promote is None:
+            tail = "\n".join(json.dumps(e) for e in events()[-8:])
+            record({"phase": "fleet",
+                    "error": f"no promote event for step 4; tail:\n{tail}"})
+            raise SystemExit(1)
+        code, post_promote = http_post_json(url + "/v1/generate",
+                                            racing_doc, timeout=120)
+        if code != 200:
+            record({"phase": "fleet",
+                    "error": f"post-promote request failed {code}"})
+            raise SystemExit(1)
+
+        # bit-parity replay: solo generate() on the step-2 and step-4
+        # checkpoints; the racing stream must match ONE of them exactly
+        # (its admission generation decides which), the post-promote
+        # stream must match step 4
+        probe = subprocess.run(
+            [sys.executable, "-c", (
+                "import json, sys\n"
+                "import jax, jax.numpy as jnp, numpy as np\n"
+                "from nanodiloco_tpu.cli import _load_checkpoint_snapshot\n"
+                "from nanodiloco_tpu.models import generate\n"
+                "doc = json.loads(sys.argv[1])\n"
+                "outs = {}\n"
+                "for step in (2, 4):\n"
+                "    cfg, _sc, params = _load_checkpoint_snapshot("
+                "sys.argv[2], step)\n"
+                "    out = generate(params, jnp.asarray([doc['token_ids']],"
+                " jnp.int32), cfg, doc['max_new_tokens'],"
+                " temperature=0.0, key=jax.random.key(doc['seed']))\n"
+                "    outs[str(step)] = np.asarray(out[0]).tolist()\n"
+                "print(json.dumps(outs))\n"
+            ), json.dumps(racing_doc), ckpt],
+            cwd=REPO_ROOT, capture_output=True, text=True,
+            timeout=budget * 0.2,
+        )
+        if probe.returncode != 0:
+            record({"phase": "fleet",
+                    "error": f"solo replay failed: "
+                             f"{probe.stdout[-200:]}{probe.stderr[-200:]}"})
+            raise SystemExit(1)
+        solo = json.loads(probe.stdout.strip().splitlines()[-1])
+        if racing["token_ids"] not in (solo["2"], solo["4"]):
+            record({"phase": "fleet",
+                    "error": "racing stream matches NEITHER checkpoint",
+                    "served": racing["token_ids"]})
+            raise SystemExit(1)
+        if post_promote["token_ids"] != solo["4"]:
+            record({"phase": "fleet",
+                    "error": "post-promote stream is not the promoted "
+                             "checkpoint's solo stream",
+                    "served": post_promote["token_ids"],
+                    "solo": solo["4"]})
+            raise SystemExit(1)
+
+        # crash injection: SIGABRT the NON-canary replica — its armed
+        # fatal-signal handler dumps the black box, the router's health
+        # loop sees the dead socket and ejects with the dump attached
+        replicas[1].send_signal(_signal.SIGABRT)
+        eject = wait_event("eject", time.time() + budget * 0.15,
+                           replica="r1")
+        if eject is None:
+            record({"phase": "fleet", "error": "no eject event for r1"})
+            raise SystemExit(1)
+        if not (eject.get("blackbox") or {}).get("path"):
+            record({"phase": "fleet",
+                    "error": "ejection event has no blackbox attached",
+                    "event": eject})
+            raise SystemExit(1)
+        render = subprocess.run(
+            [sys.executable, "-m", "nanodiloco_tpu", "report", "blackbox",
+             eject["blackbox"]["path"], "-n", "5"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
+        )
+        if render.returncode != 0 or "blackbox:" not in render.stdout:
+            record({"phase": "fleet",
+                    "error": f"report blackbox failed: "
+                             f"{render.stdout[-200:]}{render.stderr[-200:]}"})
+            raise SystemExit(1)
+
+        # poisoned checkpoint: NaN LM HEAD saved as step 6 — the canary
+        # gate must catch it (non-finite eval loss is an automatic
+        # regression) and roll the canary back to step 4. The head ONLY,
+        # deliberately: NaN logits poison the eval loss while K/V stays
+        # finite — a full-NaN snapshot would write NaN rows into the
+        # canary's shared KV pool during the canary bench, and NaN
+        # defeats causal masking (0 x NaN = NaN) for later
+        # sentinel-clamped paged reads, contaminating post-rollback
+        # streams (observed on the first CPU dry-run; PERF.md fleet
+        # entry).
+        poison = subprocess.run(
+            [sys.executable, "-c", (
+                "import sys\n"
+                "import numpy as np\n"
+                "from nanodiloco_tpu.training.checkpoint import "
+                "CheckpointManager\n"
+                "m = CheckpointManager(sys.argv[1])\n"
+                "state = m.restore_raw(4)\n"
+                "head = np.asarray(state['snapshot']['lm_head'])\n"
+                "state['snapshot']['lm_head'] = np.full(\n"
+                "    head.shape, np.nan, head.dtype)\n"
+                "m.save(6, state)\n"
+                "m.wait()\n"
+                "m.close()\n"
+                "print('poisoned step 6 (NaN lm_head)')\n"
+            ), ckpt],
+            cwd=REPO_ROOT, capture_output=True, text=True,
+            timeout=budget * 0.15,
+        )
+        if poison.returncode != 0:
+            record({"phase": "fleet",
+                    "error": f"poison save failed: "
+                             f"{poison.stdout[-200:]}{poison.stderr[-300:]}"})
+            raise SystemExit(1)
+        rollback = wait_event("rollback", time.time() + budget * 0.2,
+                              step=6)
+        if rollback is None:
+            tail = "\n".join(json.dumps(e) for e in events()[-8:])
+            record({"phase": "fleet",
+                    "error": f"no rollback event for step 6; tail:\n{tail}"})
+            raise SystemExit(1)
+        # post-rollback: the surviving replica serves step 4 again
+        code, after = http_post_json(url + "/v1/generate", racing_doc,
+                                     timeout=120)
+        if code != 200 or after["token_ids"] != solo["4"]:
+            record({"phase": "fleet",
+                    "error": "post-rollback stream is not the restored "
+                             "checkpoint's solo stream",
+                    "code": code})
+            raise SystemExit(1)
+        m = parse_metrics_text(http_get(url + "/metrics", timeout=5)[1])
+        scraped = {k: m[k] for k in (
+            "nanodiloco_fleet_replicas_ready",
+            "nanodiloco_fleet_replicas_serving",
+            'nanodiloco_deploy_generation{replica="r0"}',
+            'nanodiloco_fleet_events_total{event="promote"}',
+            'nanodiloco_fleet_events_total{event="rollback"}',
+            'nanodiloco_fleet_events_total{event="eject"}',
+            "nanodiloco_fleet_goodput_fraction",
+        ) if k in m}
+        if (m.get("nanodiloco_fleet_replicas_ready") != 1
+                or not m.get('nanodiloco_fleet_events_total{event="eject"}')
+                or not m.get(
+                    'nanodiloco_fleet_events_total{event="promote"}')):
+            record({"phase": "fleet",
+                    "error": "fleet gauges missing or inconsistent",
+                    "scraped": scraped})
+            raise SystemExit(1)
+    finally:
+        stop(fleet_proc)
+        for proc in replicas:
+            stop(proc)
+
+    # the stopped router appended its final fleet_goodput record: the
+    # deploy JSONL must summarize with the standard tooling
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    summary = summarize_run(deploy_jsonl)
+    if not (summary.get("fleet_promotes") and summary.get("fleet_rollbacks")
+            and summary.get("fleet_ejections")):
+        record({"phase": "fleet",
+                "error": "summarize_run missing fleet keys",
+                "summary": {k: v for k, v in summary.items()
+                            if k.startswith(("fleet", "deploy"))}})
+        raise SystemExit(1)
+    record({
+        "phase": "fleet",
+        "backend_live": live,
+        "promote_step": promote["step"],
+        "rollback_step": rollback["step"],
+        "ejected_replica": eject["replica"],
+        "blackbox_attached": eject["blackbox"]["path"],
+        "parity_post_promote_tokens": len(post_promote["token_ids"]),
+        "fleet_goodput_fraction": summary.get("fleet_goodput_fraction"),
+        "scraped": scraped,
+    })
+
+
 PHASES = {
     "bench": phase_bench,
     "sweep": phase_sweep,
@@ -1836,6 +2193,7 @@ PHASES = {
     "kv_paging": phase_kv_paging,
     "spec_decode": phase_spec_decode,
     "tp_decode": phase_tp_decode,
+    "fleet": phase_fleet,
 }
 
 
@@ -1883,6 +2241,7 @@ PHASE_TIMEOUT_S = {
     "kv_paging": 900,
     "spec_decode": 900,
     "tp_decode": 1200,
+    "fleet": 1800,
 }
 
 

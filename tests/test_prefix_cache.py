@@ -138,6 +138,31 @@ def test_stats_shape():
     s = c.stats()
     assert s == {
         "hits": 0, "misses": 0, "hit_tokens": 0, "insertions": 0,
-        "evictions": 0, "cached_tokens": 0, "capacity_tokens": 16,
+        "evictions": 0, "generation": 0, "invalidations": 0,
+        "cached_tokens": 0, "capacity_tokens": 16,
         "chunk_tokens": 4,
     }
+
+
+def test_clear_invalidates_everything_and_bumps_generation():
+    """The weight hot-swap hook: ``clear()`` drops EVERY entry (cached
+    K/V was computed under the old weights), runs on_evict per entry —
+    the paged engine's block derefs — and bumps the generation tag so a
+    post-swap lookup can provably never see pre-swap KV."""
+    evicted = []
+    c = PrefixCache(capacity_tokens=64, chunk_tokens=4,
+                    on_evict=evicted.append)
+    prompt = list(range(12))
+    _fill(c, prompt, 3)
+    assert c.clear() == 3
+    assert len(evicted) == 3               # every block handed back
+    assert c.cached_tokens == 0
+    assert c.generation == 1
+    # a post-clear lookup of the SAME prompt is a miss — never served
+    # from pre-swap KV
+    assert c.match(prompt + [9]) == []
+    s = c.stats()
+    assert s["invalidations"] == 3 and s["misses"] == 1
+    # clear is idempotent on empty and keeps counting generations
+    assert c.clear() == 0
+    assert c.generation == 2
