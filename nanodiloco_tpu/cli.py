@@ -604,6 +604,13 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--force-cpu-devices", type=int, default=None, metavar="N",
                    help="serve on N virtual CPU devices instead of the "
                         "accelerator")
+    p.add_argument("--inject-tick-delay-s", type=float, default=0.0,
+                   metavar="S",
+                   help="DRILL HOOK: sleep this long before every "
+                        "scheduling tick, inflating TTFT/decode latency "
+                        "without touching correctness — makes this "
+                        "replica a straggler for the SLO burn-rate drill "
+                        "(chip_agenda slo_watch); 0 (default) disables")
     return p
 
 
@@ -679,6 +686,7 @@ def serve_main(argv: list[str]) -> None:
         default_deadline_s=args.deadline_s,
         profile_dir=args.profile_dir,
         swap_loader=swap_loader,
+        tick_delay_s=args.inject_tick_delay_s,
     ).start()
     print(
         f"serving {args.checkpoint_dir} on {args.host}:{server.port} "
@@ -825,6 +833,14 @@ def build_fleet_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-latency-increase", type=float, default=0.5,
                    help="relative canary TTFT increase that blocks "
                         "promotion")
+    p.add_argument("--trace-out", type=str, default=None, metavar="JSON",
+                   help="export the router's per-request route/forward "
+                        "spans (tagged with the request_id join key) as "
+                        "a Chrome trace-event JSON at shutdown — `report "
+                        "merge-trace` folds it with the replicas' serve "
+                        "shards so one Perfetto timeline shows client "
+                        "wait vs router hop vs queue vs prefill vs "
+                        "decode per request")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -844,6 +860,15 @@ def fleet_main(argv: list[str]) -> None:
             name=f"r{i}", url=url.rstrip("/"),
             blackbox=blackbox or None,
         ))
+    tracer = None
+    if args.trace_out:
+        from nanodiloco_tpu.obs import SpanTracer
+
+        # SAME clock as the router (time.monotonic, its default); a
+        # distinct process name keeps the router lane labeled when
+        # merged with the replicas' serve shards
+        tracer = SpanTracer(clock=time.monotonic,
+                            process_name="nanodiloco router")
     router = FleetRouter(
         replicas,
         port=args.port, host=args.host,
@@ -851,6 +876,7 @@ def fleet_main(argv: list[str]) -> None:
         health_interval_s=args.health_interval_s,
         eject_after_failures=args.eject_after,
         drain_timeout_s=args.drain_timeout_s,
+        tracer=tracer,
         quiet=args.quiet,
     ).start()
     print(
@@ -901,8 +927,209 @@ def fleet_main(argv: list[str]) -> None:
         if controller_thread is not None:
             controller_thread.join(timeout=10)
         router.stop()
+        if tracer is not None:
+            try:
+                tracer.export_chrome(args.trace_out)
+                print(f"router span trace -> {args.trace_out}", flush=True)
+            except OSError:
+                pass  # a full disk must not mask the shutdown
         if args.events_jsonl:
             print(f"deploy events -> {args.events_jsonl}", flush=True)
+
+
+def build_obs_watch_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nanodiloco_tpu obs-watch",
+        description="Fleet observability plane (nanodiloco_tpu/obs): "
+                    "scrape a set of /metrics endpoints into bounded "
+                    "time series, evaluate multi-window SLO burn rates, "
+                    "emit slo_alert JSONL records, and post burn "
+                    "transitions to the fleet router (route-around + "
+                    "canary gate).",
+    )
+    p.add_argument("--target", action="append", required=True,
+                   metavar="NAME=URL",
+                   help="a scrape target's name and base URL, e.g. "
+                        "r0=http://127.0.0.1:8101 — repeat per target "
+                        "(replicas, the router, the trainer's "
+                        "--metrics-port). Replica names must match the "
+                        "router's (r0, r1, ...) for route-around to "
+                        "land on the right replica")
+    p.add_argument("--interval-s", type=float, default=1.0,
+                   help="scrape + evaluation cadence")
+    p.add_argument("--duration-s", type=float, default=0.0,
+                   help="stop after this long (0 = run until SIGTERM)")
+    p.add_argument("--series-jsonl", type=str, default=None, metavar="JSONL",
+                   help="append one snapshot record per scrape per "
+                        "target — `report timeseries` renders the "
+                        "incident timeline from it after the fact")
+    p.add_argument("--alerts-jsonl", type=str, default=None, metavar="JSONL",
+                   help="append slo_alert firing/resolved records plus "
+                        "the final slo_summary — readable by `report "
+                        "faults` / summarize_run / `report compare`")
+    p.add_argument("--router-url", type=str, default=None, metavar="URL",
+                   help="fleet router base URL: burn transitions POST to "
+                        "its /fleet/slo endpoint (replica-scope rules "
+                        "mark the replica not-preferred; fleet-scope "
+                        "rules defer canaries). Unset = observe only")
+    p.add_argument("--port", type=int, default=None,
+                   help="serve the watcher's OWN /metrics "
+                        "(nanodiloco_slo_alerts_total{rule}, burn "
+                        "seconds, scrape counters) on this port; 0 "
+                        "picks a free port; unset = no endpoint")
+    p.add_argument("--host", type=str, default="0.0.0.0")
+    p.add_argument("--maxlen", type=int, default=2048,
+                   help="ring-buffer bound per series (oldest evicted)")
+    # rule thresholds (unset = that rule is off)
+    p.add_argument("--ttft-p95-max", type=float, default=None, metavar="S",
+                   help="TTFT p95 ceiling per replica (seconds)")
+    p.add_argument("--decode-tps-min", type=float, default=None,
+                   help="decode tokens/s floor per replica")
+    p.add_argument("--error-rate-max", type=float, default=None,
+                   help="error-outcome share ceiling over the window, "
+                        "from requests_by_outcome counter increases")
+    p.add_argument("--kv-blocks-free-min", type=float, default=None,
+                   help="KV block headroom floor per replica")
+    p.add_argument("--fleet-goodput-min", type=float, default=None,
+                   help="fleet goodput fraction floor (fleet scope: "
+                        "gates canaries)")
+    p.add_argument("--outer-staleness-max", type=float, default=None,
+                   help="trainer outer-staleness ceiling (fleet scope)")
+    # burn-rate windows
+    p.add_argument("--fast-window-s", type=float, default=5.0,
+                   help="fast burn window: trips quickly on a live burn")
+    p.add_argument("--slow-window-s", type=float, default=30.0,
+                   help="slow burn window: confirms it is not a blip")
+    p.add_argument("--fast-burn", type=float, default=0.5,
+                   help="breach fraction of the fast window that trips")
+    p.add_argument("--slow-burn", type=float, default=0.25,
+                   help="breach fraction of the slow window that confirms")
+    p.add_argument("--clear-debounce-s", type=float, default=5.0,
+                   help="the fast window must stay clean this long "
+                        "before an alert resolves (flap protection)")
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def obs_watch_main(argv: list[str]) -> None:
+    args = build_obs_watch_parser().parse_args(argv)
+    import signal
+    import threading
+    import time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from nanodiloco_tpu.obs.collector import Collector
+    from nanodiloco_tpu.obs.slo import (
+        SLOMonitor,
+        router_action_hook,
+        standard_rules,
+    )
+    from nanodiloco_tpu.obs.telemetry import OPENMETRICS_CONTENT_TYPE
+
+    targets = []
+    for spec in args.target:
+        name, sep, url = spec.partition("=")
+        if not sep or not name or not url:
+            raise SystemExit(f"--target must be NAME=URL; got {spec!r}")
+        targets.append((name, url))
+    rules = standard_rules(
+        ttft_p95_max_s=args.ttft_p95_max,
+        decode_tps_min=args.decode_tps_min,
+        error_rate_max=args.error_rate_max,
+        kv_blocks_free_min=args.kv_blocks_free_min,
+        fleet_goodput_min=args.fleet_goodput_min,
+        outer_staleness_max=args.outer_staleness_max,
+        fast_window_s=args.fast_window_s,
+        slow_window_s=args.slow_window_s,
+        fast_burn=args.fast_burn,
+        slow_burn=args.slow_burn,
+        clear_debounce_s=args.clear_debounce_s,
+    )
+    if not rules:
+        raise SystemExit(
+            "no SLO rule configured — pass at least one threshold "
+            "(--ttft-p95-max, --error-rate-max, ...)"
+        )
+    collector = Collector(
+        targets, interval_s=args.interval_s, maxlen=args.maxlen,
+        series_jsonl=args.series_jsonl,
+    )
+    on_alert = None
+    if args.router_url:
+        from nanodiloco_tpu.serve.client import http_post_json
+
+        on_alert = router_action_hook(
+            lambda url, doc: http_post_json(url, doc, timeout=10.0),
+            args.router_url,
+        )
+    monitor = SLOMonitor(
+        collector.store, rules, [n for n, _ in targets],
+        alerts_jsonl=args.alerts_jsonl, on_alert=on_alert,
+        quiet=args.quiet,
+    )
+
+    httpd = None
+    if args.port is not None:
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # scrapes must not spam stdout
+                pass
+
+            def do_GET(self):
+                if self.path.split("?", 1)[0] != "/metrics":
+                    body, code, ctype = b"not found\n", 404, "text/plain"
+                else:
+                    body = (collector.render_metrics().rstrip("\n")
+                            .rsplit("# EOF", 1)[0]
+                            + monitor.render_metrics()).encode()
+                    code, ctype = 200, OPENMETRICS_CONTENT_TYPE
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer((args.host, args.port), Handler)
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever,
+                         name="nanodiloco-obs-watch-http",
+                         daemon=True).start()
+        print(f"obs-watch /metrics on {args.host}:"
+              f"{httpd.server_address[1]}", flush=True)
+
+    print(
+        f"obs-watch: {len(targets)} target(s), {len(rules)} rule(s) "
+        f"[{', '.join(r.name for r in rules)}], "
+        f"windows {args.fast_window_s:g}s/{args.slow_window_s:g}s",
+        flush=True,
+    )
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:  # not the main thread (embedded use)
+            break
+    deadline = (time.monotonic() + args.duration_s
+                if args.duration_s > 0 else None)
+
+    def on_scrape(_result):
+        monitor.evaluate()
+        if deadline is not None and time.monotonic() >= deadline:
+            stop.set()
+
+    try:
+        collector.run(stop, on_scrape=on_scrape)
+    finally:
+        summary = monitor.finalize()
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if not args.quiet:
+            print(f"obs-watch summary: "
+                  f"{json.dumps(summary['slo_summary'])}", flush=True)
+        if args.alerts_jsonl:
+            print(f"slo alerts -> {args.alerts_jsonl}", flush=True)
+        if args.series_jsonl:
+            print(f"series -> {args.series_jsonl}", flush=True)
 
 
 def _load_checkpoint_snapshot(checkpoint_dir: str, step: int | None):
@@ -1020,6 +1247,10 @@ def report_main(argv: list[str]) -> None:
     event timeline (obs/flightrec) — the spans, heartbeats, alarms, and
     records a dying process managed to dump.
 
+    ``report timeseries SERIES.jsonl``: ASCII sparkline timeline per
+    scraped series from an ``obs-watch --series-jsonl`` artifact — the
+    after-the-fact view of an incident's gauges (obs/collector).
+
     ``report drift RUN.jsonl``: the run's DiLoCo dynamics timeline —
     per-sync cross-worker drift, per-worker pseudo-gradient norms,
     outer-momentum norm, and pseudo-gradient/update cosine (the
@@ -1045,6 +1276,9 @@ def report_main(argv: list[str]) -> None:
         return
     if argv[:1] == ["faults"]:
         report_faults_main(argv[1:])
+        return
+    if argv[:1] == ["timeseries"]:
+        report_timeseries_main(argv[1:])
         return
     p = argparse.ArgumentParser(prog="nanodiloco_tpu report")
     p.add_argument("jsonl", help="metrics JSONL written by training")
@@ -1082,6 +1316,10 @@ def report_compare_main(argv: list[str]) -> None:
                    help="relative serve-latency (TTFT percentile) increase "
                         "that counts as a regression (default 50%% — "
                         "closed-loop CPU latency is noisy)")
+    p.add_argument("--max-slo-burn-increase-s", type=float, default=5.0,
+                   help="ABSOLUTE slo_burn_seconds increase that counts "
+                        "as a regression (default +5 s — an incident "
+                        "budget, not a ratio)")
     p.add_argument("--json", action="store_true",
                    help="print the full diff as one JSON object")
     args = p.parse_args(argv)
@@ -1095,6 +1333,7 @@ def report_compare_main(argv: list[str]) -> None:
         max_tps_drop=args.max_tps_drop,
         max_comm_share_increase=args.max_comm_share_increase,
         max_latency_increase=args.max_latency_increase,
+        max_slo_burn_increase_s=args.max_slo_burn_increase_s,
     )
     if args.json:
         print(json.dumps(diff))
@@ -1146,6 +1385,62 @@ def report_merge_trace_main(argv: list[str]) -> None:
         f"merged {len(docs)} shard(s) -> {args.out} "
         f"({spans} spans across {len(pids)} process(es))"
     )
+
+
+def report_timeseries_main(argv: list[str]) -> None:
+    """``report timeseries SERIES.jsonl``: one sparkline per scraped
+    series from the collector's snapshot JSONL — the operator's
+    after-the-fact incident timeline (what did TTFT, the queue, and
+    the KV pool do while the alert burned), no plotting stack needed."""
+    p = argparse.ArgumentParser(prog="nanodiloco_tpu report timeseries")
+    p.add_argument("jsonl", help="series JSONL written by `obs-watch "
+                                 "--series-jsonl` (obs/collector "
+                                 "snapshot records)")
+    p.add_argument("--key", type=str, default=None, metavar="SUBSTR",
+                   help="only series whose key contains this substring "
+                        "(e.g. ttft, r1:, _total)")
+    p.add_argument("--width", type=int, default=60,
+                   help="sparkline width in characters")
+    p.add_argument("--all", action="store_true",
+                   help="include constant series (hidden by default — "
+                        "a flat gauge is rarely the incident)")
+    p.add_argument("--json", action="store_true",
+                   help="print {key: {n, first, last, min, max}} as one "
+                        "JSON object")
+    args = p.parse_args(argv)
+
+    from nanodiloco_tpu.obs.collector import read_series_jsonl, sparkline
+
+    series = read_series_jsonl(args.jsonl)
+    if args.key:
+        series = {k: v for k, v in series.items() if args.key in k}
+    if not series:
+        raise SystemExit(
+            f"no matching series in {args.jsonl}"
+            + (f" for key substring {args.key!r}" if args.key else "")
+        )
+    out = {}
+    for key in sorted(series):
+        vals = [v for _, v in series[key]]
+        if not args.all and min(vals) == max(vals):
+            continue
+        out[key] = {
+            "n": len(vals),
+            "first": vals[0], "last": vals[-1],
+            "min": min(vals), "max": max(vals),
+        }
+    if args.json:
+        print(json.dumps(out))
+        return
+    if not out:
+        print("every series is constant (pass --all to show them)")
+        return
+    span = max(len(k) for k in out)
+    for key, st in out.items():
+        spark = sparkline([v for _, v in series[key]], width=args.width)
+        print(f"{key:>{span}} |{spark}| "
+              f"min={st['min']:.4g} max={st['max']:.4g} "
+              f"last={st['last']:.4g} n={st['n']}")
 
 
 def report_cost_main(argv: list[str]) -> None:
@@ -1251,6 +1546,23 @@ def report_faults_main(argv: list[str]) -> None:
             # width change absorbed at resume, an H-schedule reset
             events.append({"event": "elastic", "kind": r["elastic"],
                            **{k: v for k, v in r.items() if k != "elastic"}})
+        elif r.get("slo_alert"):
+            # SLO burn-rate transitions (obs/slo): firing/resolved per
+            # rule and target, with the burn seconds on resolve. The
+            # record's own "kind" is the rule DIRECTION (ceiling/floor)
+            # — renamed so it cannot shadow the rule name in the label
+            events.append({"event": "slo_alert", "kind": r["slo_alert"],
+                           **{("direction" if k == "kind" else k): v
+                              for k, v in r.items()
+                              if k != "slo_alert"}})
+        elif r.get("deploy_event") in ("slo_burn", "slo_clear",
+                                       "canary_deferred"):
+            # the router's side of the same incident: route-around
+            # marks and deferred canaries, from a deploy JSONL passed
+            # here directly
+            events.append({"event": r["deploy_event"],
+                           **{k: v for k, v in r.items()
+                              if k != "deploy_event"}})
         elif r.get("event") in ("scale_up", "scale_down"):
             # a supervisor --events-jsonl passed here directly: the
             # symmetric width-change events read like any other
@@ -1467,6 +1779,11 @@ def main(argv: list[str] | None = None) -> None:
         # multi-replica serve router + canary-gated continuous
         # deployment (nanodiloco_tpu/fleet)
         fleet_main(argv[1:])
+        return
+    if argv and argv[0] == "obs-watch":
+        # fleet observability plane: scrape collector + SLO burn-rate
+        # alerting over live /metrics endpoints (nanodiloco_tpu/obs)
+        obs_watch_main(argv[1:])
         return
     if argv and argv[0] == "export-hf":
         export_hf_main(argv[1:])

@@ -196,6 +196,7 @@ class DeployController:
         max_tps_drop: float = 0.2,
         max_latency_increase: float = 0.5,
         bench_kwargs: dict | None = None,
+        slo_gate: Callable[[], bool] | None = None,
     ) -> None:
         self.router = router
         self.checkpoint_dir = checkpoint_dir
@@ -220,6 +221,18 @@ class DeployController:
             "max_latency_increase": max_latency_increase,
         }
         self._baseline: dict | None = None
+        # canary SLO gate: a callable answering "is a fleet-scope SLO
+        # burning right now?" — default the router's own slo_burning()
+        # (wired by obs-watch through POST /fleet/slo). While it
+        # answers True, deploy() DEFERS: pushing new weights into a
+        # live incident conflates two changes and makes the canary
+        # verdict meaningless (the burn would fail a good checkpoint,
+        # or mask a bad one). The step is NOT blacklisted — the next
+        # poll retries it once the burn clears.
+        self._slo_gate = slo_gate if slo_gate is not None else getattr(
+            router, "slo_burning", None
+        )
+        self._deferred_step: int | None = None
         # rolled-back steps: never re-canaried — a broken checkpoint
         # must not trap the fleet in a canary->rollback loop
         self.failed_steps: set[int] = set()
@@ -242,8 +255,8 @@ class DeployController:
 
     def poll_once(self) -> str | None:
         """One watch step: deploy the newest unseen checkpoint, if any.
-        Returns the action taken ("promote"/"rollback"/"canary_failed")
-        or None when there was nothing new."""
+        Returns the action taken ("promote"/"rollback"/"canary_failed"/
+        "canary_deferred") or None when there was nothing new."""
         try:
             step = latest_checkpoint_step(self.checkpoint_dir)
         except Exception:
@@ -267,6 +280,17 @@ class DeployController:
         harness), push the candidate to the canary, measure, and
         promote fleet-wide or roll back on the verdict."""
         router = self.router
+        if self._slo_gate is not None and self._slo_gate():
+            # deferred, not failed: logged ONCE per step (the watch
+            # loop re-polls every interval — a long burn must not spam
+            # the deploy timeline), retried when the burn clears
+            if self._deferred_step != step:
+                self._deferred_step = step
+                router.log_event("canary_deferred", step=step,
+                                 replica=self.canary,
+                                 reason="fleet SLO burning")
+            return "canary_deferred"
+        self._deferred_step = None
         router.log_event("canary_start", step=step, replica=self.canary,
                          baseline_step=self.deployed_step)
         url = self._canary_url()

@@ -66,6 +66,7 @@ EVENT_KINDS = (
     "promote", "rollback", "rollback_failed", "eject", "drain", "swap",
     "swap_failed", "canary_start", "canary_baseline",
     "canary_baseline_failed", "canary_verdict", "canary_failed",
+    "canary_deferred", "slo_burn", "slo_clear",
 )
 
 
@@ -144,6 +145,7 @@ class FleetRouter:
         drain_timeout_s: float = 30.0,
         request_timeout_s: float = 600.0,
         events_jsonl: str | None = None,
+        tracer=None,
         quiet: bool = False,
     ) -> None:
         if not replicas:
@@ -166,7 +168,28 @@ class FleetRouter:
         self.drain_timeout_s = float(drain_timeout_s)
         self._request_timeout_s = float(request_timeout_s)
         self.events_jsonl = events_jsonl
+        # per-request span sink (obs/tracer.SpanTracer or None): the
+        # router records route/forward spans via record_span with ITS
+        # OWN clock's timestamps, tagged with the request_id join key —
+        # construct the tracer with the same clock callable. Exported
+        # through `fleet --trace-out` + `report merge-trace`, these put
+        # the router hop on the same Perfetto timeline as the replica's
+        # queued/prefill/decode spans for the same request.
+        self.tracer = tracer
         self.quiet = quiet
+        # SLO burn state (obs/slo action hook, via set_slo_burning or
+        # POST /fleet/slo): replica-scope rules make that replica
+        # NOT-PREFERRED (routed to only when no clean replica is ready
+        # — route-around before any 503-ejection: a burning replica is
+        # slow, not dead); fleet-scope rules gate the deploy
+        # controller's canary (slo_burning()).
+        self._slo_not_preferred: dict[str, set] = {}   # replica -> rule names
+        # burning fleet-scope alerts, keyed (rule, target): the monitor
+        # fires per (rule, target) pair, and collapsing to rule names
+        # would let one target's resolve clear the canary gate while
+        # another target's alert still burns
+        self._slo_fleet: set = set()                   # {(rule, target)}
+        self._req_seq = 0
         self._states = [_ReplicaState(r, clock) for r in replicas]
         self._by_name = {st.replica.name: st for st in self._states}
         # reentrant: the health tick ejects (and so logs/counts an
@@ -227,6 +250,9 @@ class FleetRouter:
                     self._reply_json(code, out)
                 elif path == "/fleet/push":
                     code, out = router.handle_push(doc)
+                    self._reply_json(code, out)
+                elif path == "/fleet/slo":
+                    code, out = router.handle_slo(doc)
                     self._reply_json(code, out)
                 else:
                     self._reply(404, b"not found\n", "text/plain")
@@ -386,26 +412,60 @@ class FleetRouter:
         name for determinism."""
         return self._pick_excluding(set())
 
+    def _span(self, name: str, t0: float, t1: float, request_id: str,
+              **args) -> None:
+        if self.tracer is not None:
+            self.tracer.record_span(
+                name, t0, t1, request_id=request_id, **args
+            )
+
     def handle_generate(self, doc: dict) -> tuple[int, dict]:
         """Forward one request to the least-loaded ready replica; one
         retry on a DIFFERENT replica when the first answers 503/429 or
         the socket fails (the health loop owns ejection — a forward
         failure only counts against the failure budget; a 429 means
         THAT replica's queue is full, and the router's load view can be
-        a health-tick stale, so another replica may have headroom)."""
+        a health-tick stale, so another replica may have headroom).
+
+        The ``request_id`` join key is stamped HERE when the client did
+        not supply one, and the SAME body — same id — rides every
+        attempt: stamping per-attempt would hand the retry replica a
+        different id and break the router-span/replica-span trace join
+        for exactly the requests that needed diagnosing. The response
+        echoes ``served_by`` (which replica actually answered — on a
+        retry that is NOT the replica the router first picked)."""
+        rid = doc.get("request_id")
+        if not isinstance(rid, str) or not rid:
+            with self._lock:
+                self._req_seq += 1
+                rid = f"rtr-{self._req_seq}"
+        doc = {**doc, "request_id": rid}
+        t_route = self._clock()
         tried: set[str] = set()
         last_429: tuple[int, dict] | None = None
-        for _ in range(2):
+        for attempt in range(2):
             st = self._pick_excluding(tried)
             if st is None:
+                self._span("route", t_route, self._clock(), rid,
+                           outcome="no_ready_replica")
                 return 503, {"error": "no ready replica",
+                             "request_id": rid,
                              **({"tried": sorted(tried)} if tried else {})}
             name = st.replica.name
             tried.add(name)
             with self._lock:
                 st.router_inflight += 1
+            t0 = self._clock()
             try:
-                code, out = self._post(st.replica, "/v1/generate", doc)
+                try:
+                    code, out = self._post(st.replica, "/v1/generate", doc)
+                finally:
+                    # finally, not per-path: an exception outside the
+                    # routed-around classes below must never leak the
+                    # in-flight count (it feeds the load key — a leak
+                    # penalizes this replica forever)
+                    with self._lock:
+                        st.router_inflight -= 1
             except (OSError, ValueError):
                 # ValueError = a non-JSON body (misconfigured URL, an
                 # intermediary's error page): route around it — a bad
@@ -414,10 +474,12 @@ class FleetRouter:
                 with self._lock:
                     st.failures += 1
                     st.set(ready=False)
+                self._span("forward", t0, self._clock(), rid,
+                           replica=name, retry=attempt > 0,
+                           outcome="error")
                 continue
-            finally:
-                with self._lock:
-                    st.router_inflight -= 1
+            self._span("forward", t0, self._clock(), rid, replica=name,
+                       retry=attempt > 0, code=code)
             if code == 503:
                 # the replica's loop is dead or it is draining: route
                 # around it now; the health loop decides ejection
@@ -427,17 +489,24 @@ class FleetRouter:
             if code == 429:
                 # queue full HERE, not fleet-wide: try another replica;
                 # if every candidate is saturated, the client gets the
-                # honest 429 (backpressure), never a fake 503
-                last_429 = (code, {**out, "replica": name}
+                # honest 429 (backpressure), never a fake 503 — with
+                # the join key, so the overload is traceable
+                last_429 = (code, {**out, "replica": name,
+                                   "request_id": rid}
                             if isinstance(out, dict) else out)
                 continue
             if isinstance(out, dict):
-                out = {**out, "replica": name}
+                out = {**out, "replica": name, "served_by": name}
+                out.setdefault("request_id", rid)
+            self._span("route", t_route, self._clock(), rid,
+                       served_by=name, attempts=attempt + 1)
             return code, out
+        self._span("route", t_route, self._clock(), rid,
+                   outcome="exhausted", attempts=len(tried))
         if last_429 is not None:
             return last_429
         return 503, {"error": "no replica could take the request",
-                     "tried": sorted(tried)}
+                     "request_id": rid, "tried": sorted(tried)}
 
     def _pick_excluding(self, names: set[str]) -> _ReplicaState | None:
         with self._lock:
@@ -452,10 +521,93 @@ class FleetRouter:
                 load = ((s.get("queue_depth") or 0)
                         + (s.get("slots_busy") or 0) + st.router_inflight)
                 free = s.get("kv_blocks_free")
-                return (load, -(free if free is not None else -1),
+                # SLO route-around FIRST: a replica burning an SLO is
+                # picked only when no clean candidate exists (degraded
+                # beats 503); load order is unchanged within each class
+                return (st.replica.name in self._slo_not_preferred,
+                        load, -(free if free is not None else -1),
                         st.replica.name)
 
             return min(cands, key=key)
+
+    # -- SLO burn state (obs/slo action hook) --------------------------------
+
+    def handle_slo(self, doc: dict) -> tuple[int, dict]:
+        """POST /fleet/slo: ``{"rule", "target", "scope", "firing"}`` —
+        the wire form of the SLO monitor's action hook (an external
+        ``obs-watch`` process observes the fleet and posts burn
+        transitions here)."""
+        rule = doc.get("rule")
+        if not isinstance(rule, str) or not rule:
+            return 400, {"error": "rule must be a non-empty string"}
+        firing = doc.get("firing")
+        if not isinstance(firing, bool):
+            return 400, {"error": f"firing must be a boolean; got {firing!r}"}
+        scope = doc.get("scope", "replica")
+        if scope not in ("replica", "fleet"):
+            return 400, {"error": f"scope must be replica|fleet; got {scope!r}"}
+        target = doc.get("target")
+        if scope == "replica" and target not in self._by_name:
+            return 400, {"error": f"unknown replica {target!r}; "
+                                  f"replicas are {self.replica_names()}"}
+        self.set_slo_burning(rule, target, firing, scope=scope)
+        return 200, {"ok": True, **self.slo_state()}
+
+    def set_slo_burning(self, rule: str, target: str | None, firing: bool,
+                        *, scope: str = "replica") -> None:
+        """Apply one SLO transition. Replica scope: mark/unmark
+        ``target`` not-preferred (route-around). Fleet scope: add/
+        remove ``rule`` from the set gating the deploy controller's
+        canary. Idempotent — only an actual state change logs a
+        ``slo_burn``/``slo_clear`` deploy event."""
+        with self._lock:
+            if scope == "fleet":
+                key = (rule, target or "")
+                changed = (key in self._slo_fleet) != firing
+                if firing:
+                    self._slo_fleet.add(key)
+                else:
+                    self._slo_fleet.discard(key)
+                tgt = target or "fleet"
+            else:
+                rules = self._slo_not_preferred.setdefault(target, set())
+                changed = (rule in rules) != firing
+                if firing:
+                    rules.add(rule)
+                else:
+                    rules.discard(rule)
+                    if not rules:
+                        del self._slo_not_preferred[target]
+                tgt = target
+        if changed:
+            self.log_event("slo_burn" if firing else "slo_clear",
+                           rule=rule, target=tgt, scope=scope)
+
+    def slo_burning(self) -> bool:
+        """True while any FLEET-scope SLO rule burns — the deploy
+        controller's canary gate (replica-scope burns route around,
+        they do not block deployment: one slow replica must not freeze
+        the train->serve loop)."""
+        with self._lock:
+            return bool(self._slo_fleet)
+
+    def slo_state(self) -> dict:
+        with self._lock:
+            return self._slo_state_locked()
+
+    def _slo_state_locked(self) -> dict:
+        return {
+            "slo_fleet_burning": sorted(
+                rule if not target else f"{rule}@{target}"
+                for rule, target in self._slo_fleet
+            ),
+            "slo_not_preferred": {
+                name: sorted(rules)
+                for name, rules in sorted(
+                    self._slo_not_preferred.items()
+                )
+            },
+        }
 
     # -- drain/refill weight pushes ------------------------------------------
 
@@ -664,6 +816,7 @@ class FleetRouter:
                     round(ready_s / (elapsed * n), 6)
                     if elapsed > 0 and n else None
                 ),
+                **self._slo_state_locked(),
             }
         return out
 
@@ -712,5 +865,18 @@ class FleetRouter:
                 "replicas) — the fleet's every-second-accounted "
                 "availability number",
                 [(None, s["fleet_goodput_fraction"])],
+            ))
+        families.append((
+            "nanodiloco_fleet_slo_burning", "gauge",
+            "1 while any fleet-scope SLO rule burns (the canary gate)",
+            [(None, int(bool(s["slo_fleet_burning"])))],
+        ))
+        if s["slo_not_preferred"]:
+            families.append((
+                "nanodiloco_fleet_replica_not_preferred", "gauge",
+                "replicas routed around for a burning replica-scope SLO "
+                "(still serving — route-around, not ejection)",
+                [({"replica": name}, 1)
+                 for name in sorted(s["slo_not_preferred"])],
             ))
         return render_exposition(families)

@@ -19,6 +19,12 @@ retries, resumes, supervisor restarts) alongside the training gauges —
 see ``nanodiloco_tpu/resilience``.
 """
 
+from nanodiloco_tpu.obs.collector import (
+    Collector,
+    SeriesStore,
+    flatten_families,
+    parse_exposition,
+)
 from nanodiloco_tpu.obs.flightrec import FlightRecorder
 from nanodiloco_tpu.obs.goodput import CAUSES as GOODPUT_CAUSES
 from nanodiloco_tpu.obs.goodput import GoodputLedger, stitch_goodput_records
@@ -30,6 +36,7 @@ from nanodiloco_tpu.obs.tracer import (
     trace_shard_path,
     trace_span,
 )
+from nanodiloco_tpu.obs.slo import SLOMonitor, SLORule, standard_rules
 from nanodiloco_tpu.obs.watchdog import Watchdog, WatchdogConfig
 from nanodiloco_tpu.obs.telemetry import (
     Histogram,
@@ -40,6 +47,13 @@ from nanodiloco_tpu.obs.telemetry import (
 )
 
 __all__ = [
+    "Collector",
+    "SeriesStore",
+    "flatten_families",
+    "parse_exposition",
+    "SLOMonitor",
+    "SLORule",
+    "standard_rules",
     "FlightRecorder",
     "GoodputLedger",
     "GOODPUT_CAUSES",

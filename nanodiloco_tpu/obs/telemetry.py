@@ -577,20 +577,30 @@ def _fmt(v: float) -> str:
 
 def escape_label_value(v: Any) -> str:
     """OpenMetrics label-value escaping: backslash, double-quote, and
-    line feed are the three characters the text format cannot carry
-    raw (ABNF: escaped-char). Everything else passes through."""
+    line feed are the three characters the spec's ABNF escapes. A
+    CARRIAGE RETURN is escaped too (``\\r``, a dialect extension the
+    parser in ``obs/collector`` inverts): the spec simply forbids raw
+    CR, and emitting one TEARS the line-oriented exposition for every
+    ``splitlines()``-based consumer — a label value fed from operator
+    input (an error string off an HTTP response ends ``\\r\\n``) used
+    to silently corrupt the scrape into garbage keys. Everything else
+    passes through."""
     return (
         str(v)
         .replace("\\", "\\\\")
         .replace('"', '\\"')
         .replace("\n", "\\n")
+        .replace("\r", "\\r")
     )
 
 
 def _escape_help(text: str) -> str:
-    """HELP-text escaping (backslash and line feed; quotes are legal in
-    help)."""
-    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+    """HELP-text escaping (backslash and line feed — CR too, same
+    torn-line hazard as label values; quotes are legal in help)."""
+    return (
+        str(text).replace("\\", "\\\\").replace("\n", "\\n")
+        .replace("\r", "\\r")
+    )
 
 
 def _render_labels(labels: dict[str, Any]) -> str:
@@ -650,18 +660,23 @@ def render_exposition(families) -> str:
 
 def parse_metrics_text(text: str) -> dict[str, float]:
     """Parse an OpenMetrics exposition into ``{sample_name: value}``
-    with the label set kept verbatim in the key (e.g.
+    with the label set in the key in CANONICAL rendered form (e.g.
     ``nanodiloco_alarms_total{kind="nan_loss"}``). The consumer half of
     the scrape loop (tests, chip_agenda's telemetry phase) — tolerant
-    of unknown lines, strict about nothing."""
+    of unknown lines. Built on the structured scanner in
+    ``obs/collector``: the old ``rpartition(" ")`` shortcut silently
+    mis-keyed any sample whose label VALUE carried an escaped newline
+    (the rendered ``\\n`` splits the line in ``splitlines``-based
+    consumers) and could not tell an escaped quote from the value
+    delimiter — the renderer escapes correctly, so the parser must
+    unescape correctly or the dialect does not round-trip."""
+    from nanodiloco_tpu.obs.collector import parse_sample_line, sample_key
+
     out: dict[str, float] = {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        name, _, value = line.rpartition(" ")
+    for line in text.split("\n"):
         try:
-            out[name] = float(value)
-        except ValueError:
+            name, labels, value = parse_sample_line(line)
+        except (ValueError, IndexError):
             continue
+        out[sample_key(name, labels)] = value
     return out

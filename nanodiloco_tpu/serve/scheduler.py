@@ -766,6 +766,16 @@ class Scheduler:
             "expired": self._expired,
             "cancelled": self._cancelled,
             "errors": self._errors,
+            # the same five outcomes as ONE dict — the shape the serve
+            # /metrics outcome family and the SLO error-rate rule
+            # (obs/slo) consume, so the label set has a single source
+            "requests_by_outcome": {
+                "served": self._served,
+                "rejected": self._rejected,
+                "expired": self._expired,
+                "cancelled": self._cancelled,
+                "error": self._errors,
+            },
             # admission stalls split by cause: slots exhausted vs the
             # paged backend's KV block pool exhausted — the 429/backlog
             # diagnosis gauge pair

@@ -89,6 +89,7 @@ class ServeServer:
         profile_dir: str | None = None,
         swap_loader=None,
         swap_timeout_s: float = 120.0,
+        tick_delay_s: float = 0.0,
     ) -> None:
         self._scheduler = scheduler
         self._tokenizer = tokenizer
@@ -105,6 +106,13 @@ class ServeServer:
         self._timeout_s = float(request_timeout_s)
         self._default_deadline_s = default_deadline_s
         self._idle_sleep_s = float(idle_sleep_s)
+        # straggler INJECTION (serve --inject-tick-delay-s): sleep this
+        # long before every scheduling tick, inflating TTFT and decode
+        # latency without touching correctness — the serve-side twin of
+        # the trainer's stall fault (resilience/faults), used by the
+        # SLO drill (chip_agenda slo_watch) to make one replica burn
+        # its latency budget while staying alive and routable
+        self._tick_delay_s = float(tick_delay_s)
         self._stop = threading.Event()
         self._loop_thread: threading.Thread | None = None
         self._http_thread: threading.Thread | None = None
@@ -219,6 +227,8 @@ class ServeServer:
         """The engine's single driver thread: tick until stopped; idle
         politely when no slot is live and the queue is empty."""
         while not self._stop.is_set():
+            if self._tick_delay_s > 0:
+                time.sleep(self._tick_delay_s)
             try:
                 live = self._scheduler.tick()
             except Exception as e:
@@ -524,14 +534,12 @@ class ServeServer:
             for name, help_text, value in gauges
             if value is not None
         ]
-        outcomes = [("served", s["served"]), ("rejected", s["rejected"]),
-                    ("expired", s["expired"]), ("cancelled", s["cancelled"]),
-                    ("error", s["errors"])]
+        outcomes = s["requests_by_outcome"]
         families.append((
             "nanodiloco_serve_requests", "counter",
             "requests by terminal outcome",
-            [({"outcome": k}, v) for k, v in outcomes]
-            + [(None, sum(v for _, v in outcomes))],
+            [({"outcome": k}, v) for k, v in outcomes.items()]
+            + [(None, sum(outcomes.values()))],
         ))
         families.append((
             "nanodiloco_serve_tokens", "counter",

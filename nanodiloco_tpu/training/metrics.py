@@ -285,6 +285,41 @@ def summarize_run(path: str) -> dict[str, Any]:
     if ent:
         out["moe_router_entropy_last"] = round(ent[-1], 4)
         out["moe_router_entropy_min"] = round(min(ent), 4)
+    # SLO burn-rate alerts (obs/slo, written by obs-watch): fired
+    # count, cumulative burn seconds (the compare-gated incident cost),
+    # and the worst-burning rule. The monitor's final slo_summary
+    # record is authoritative when present; without one (the monitor
+    # died mid-run) the numbers are rebuilt from the alert records
+    # themselves. Keys appear only when the JSONL carries SLO records —
+    # older JSONLs summarize unchanged.
+    slo_alerts = [r for r in recs if r.get("slo_alert")]
+    slo_summary = next(
+        (r["slo_summary"] for r in reversed(recs)
+         if isinstance(r.get("slo_summary"), dict)),
+        None,
+    )
+    if slo_alerts or slo_summary:
+        if slo_summary:
+            out["slo_alerts_total"] = int(slo_summary.get("alerts_total", 0))
+            out["slo_burn_seconds"] = float(
+                slo_summary.get("burn_seconds_total", 0.0)
+            )
+            if slo_summary.get("worst_rule"):
+                out["slo_worst_rule"] = slo_summary["worst_rule"]
+        else:
+            fired = [r for r in slo_alerts if r.get("state") == "firing"]
+            out["slo_alerts_total"] = len(fired)
+            burn: dict[str, float] = {}
+            for r in slo_alerts:
+                if r.get("state") == "resolved" and isinstance(
+                    r.get("burn_s"), (int, float)
+                ):
+                    burn[r["slo_alert"]] = (
+                        burn.get(r["slo_alert"], 0.0) + float(r["burn_s"])
+                    )
+            out["slo_burn_seconds"] = round(sum(burn.values()), 3)
+            if burn:
+                out["slo_worst_rule"] = max(burn, key=burn.get)
     # observability stack (PR: obs/): alarms, wire bytes, phase budget
     alarms = [r for r in recs if r.get("alarm")]
     if alarms:
@@ -539,6 +574,13 @@ _COMPARE_METRICS = [
     # move past max_comm_share_increase — but HIGHER is better (a drop
     # is the regression). Only gated when both summaries carry it.
     ("goodput_fraction", False),
+    # SLO burn seconds (obs/slo alerts in the run's JSONL): cumulative
+    # firing time across rules — gated ABSOLUTE like the share class
+    # (seconds are already a budget, a relative threshold would let a
+    # near-zero baseline hide a real incident), lower is better, its
+    # own threshold (max_slo_burn_increase_s). Gated only when both
+    # summaries carry it, so SLO-less runs compare untouched.
+    ("slo_burn_seconds", True),
 ]
 
 # share-of-wall-clock keys (already ratios): regress on an ABSOLUTE
@@ -551,6 +593,11 @@ _SHARE_KEYS = {"comm_share_last", "outer_sync_share_sync",
 # serve latency keys (seconds, lower better) that use the dedicated
 # latency threshold instead of the loss one
 _LATENCY_KEYS = {"ttft_p50_s", "ttft_p95_s", "short_ttft_p95_s"}
+
+# SLO burn keys (seconds, absolute threshold, share-class semantics —
+# regress on an absolute move past max_slo_burn_increase_s in the key's
+# lower_better direction)
+_SLO_BURN_KEYS = {"slo_burn_seconds"}
 
 
 def load_comparable(path: str) -> dict[str, Any]:
@@ -581,6 +628,7 @@ def compare_runs(
     max_tps_drop: float = 0.2,
     max_comm_share_increase: float = 0.05,
     max_latency_increase: float = 0.5,
+    max_slo_burn_increase_s: float = 5.0,
 ) -> dict[str, Any]:
     """Diff two run summaries and flag regressions — the gate that turns
     a bench trajectory into an enforced contract (``report compare``
@@ -593,7 +641,9 @@ def compare_runs(
     (shares are already ratios); serve latency percentiles (TTFT keys)
     regress when they increase by more than ``max_latency_increase``
     relative — a wide default (+50%), because closed-loop CPU latency
-    is far noisier run to run than a loss trajectory. Metrics present
+    is far noisier run to run than a loss trajectory; SLO burn seconds
+    regress when they increase by more than ``max_slo_burn_increase_s``
+    ABSOLUTE (an incident budget, not a ratio of one). Metrics present
     in only one summary are reported but never gate — a baseline
     without eval numbers must not fail every candidate that has them."""
     metrics: dict[str, Any] = {}
@@ -610,6 +660,11 @@ def compare_runs(
             regressed = (
                 delta > max_comm_share_increase if lower_better
                 else -delta > max_comm_share_increase
+            )
+        elif key in _SLO_BURN_KEYS:
+            regressed = (
+                delta > max_slo_burn_increase_s if lower_better
+                else -delta > max_slo_burn_increase_s
             )
         elif key in _LATENCY_KEYS:
             regressed = delta > max_latency_increase * max(abs(b), 1e-12)

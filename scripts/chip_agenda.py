@@ -71,6 +71,12 @@ ceremony:
      rolled back by the canary gate — the train->serve loop closed on
      the live backend.
 
+  12. a fleet OBSERVABILITY drill (`slo_watch`): 2 replicas (one an
+     injected straggler) + router + `obs-watch` — the TTFT burn-rate
+     alert fires, the router routes around the burning replica before
+     any ejection, the merged trace joins router and replica spans on
+     the request_id key, and the alert counters scrape over the wire.
+
 Usage (each phase also runs alone):
     python scripts/chip_agenda.py               # everything
     python scripts/chip_agenda.py bench sweep   # named phases
@@ -2177,6 +2183,383 @@ def phase_fleet() -> None:
     })
 
 
+def phase_slo_watch() -> None:
+    """Fleet observability drill on this backend: train a tiny
+    checkpoint, boot a 2-replica `serve` fleet behind the `fleet`
+    router, point `obs-watch` (scrape collector + multi-window SLO
+    burn rates) at the replicas and the router, and INJECT a straggler
+    (`--inject-tick-delay-s` on r1 — the serve-side stall hook). The
+    drill asserts the operability loop end to end over real sockets:
+    the TTFT burn-rate alert FIRES into the alerts JSONL, the router
+    ROUTES AROUND the burning replica (served_by=r0 while r1 stays
+    serving — route-around before any 503-ejection), the merged
+    Perfetto trace JOINS the router's route/forward spans with the
+    replica's queued/prefill/decode spans on the request_id key, the
+    gauges and alert counters scrape over the wire, and `report
+    timeseries` renders the incident from the series JSONL. On CPU
+    this pins the alert logic, trace joins, and route-around ordering;
+    what burn thresholds mean under REAL load belongs to the chip
+    sitting (PERF.md)."""
+    import signal as _signal
+    import socket
+    import tempfile
+    import threading
+
+    from nanodiloco_tpu.obs.telemetry import parse_metrics_text
+    from nanodiloco_tpu.serve.client import http_get, http_post_json
+
+    live = chip_is_live()
+    tmp = tempfile.mkdtemp(prefix="nanodiloco-slo-")
+    ckpt = os.path.join(tmp, "ckpt")
+    alerts_jsonl = os.path.join(tmp, "alerts.jsonl")
+    series_jsonl = os.path.join(tmp, "series.jsonl")
+    deploy_jsonl = os.path.join(tmp, "deploy.jsonl")
+    traces = {n: os.path.join(tmp, f"{n}-trace.json")
+              for n in ("r0", "r1", "router")}
+    model_cfg = os.path.join(tmp, "model.json")
+    with open(model_cfg, "w") as f:
+        json.dump({
+            "vocab_size": 2048, "hidden_size": 128, "intermediate_size": 256,
+            "num_attention_heads": 4, "num_hidden_layers": 2,
+            "max_position_embeddings": 256,
+        }, f)
+    budget = float(
+        os.environ.get("NANODILOCO_AGENDA_TIMEOUT_SLO_WATCH", "1500")
+    )
+    train = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu",
+         "--total-steps", "2", "--inner-steps", "2",
+         "--batch-size", "8", "--per-device-batch-size", "4",
+         "--seq-length", "256", "--warmup-steps", "2",
+         "--llama-config-file", model_cfg, "--no-measure-comm",
+         "--no-cost-analysis", "--quiet",
+         "--checkpoint-dir", ckpt, "--log-dir", tmp,
+         "--run-name", "slo-probe"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=budget * 0.3,
+    )
+    if train.returncode != 0:
+        record({"phase": "slo_watch",
+                "error": (train.stderr or train.stdout)[-400:]})
+        raise SystemExit(1)
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    ports = {n: free_port() for n in ("r0", "r1", "router", "watch")}
+    procs: dict = {}
+    # r1 is the STRAGGLER: every scheduling tick sleeps 0.25 s, so its
+    # TTFT sits far above the 0.12 s SLO while r0's (post-warmup) sits
+    # far below — alive, routable, and burning
+    for name, extra in (("r0", []),
+                        ("r1", ["--inject-tick-delay-s", "0.25"])):
+        procs[name] = subprocess.Popen(
+            [sys.executable, "-m", "nanodiloco_tpu", "serve",
+             "--checkpoint-dir", ckpt,
+             "--port", str(ports[name]), "--host", "127.0.0.1",
+             "--slots", "2", "--max-len", "128", "--chunk-size", "16",
+             "--max-new-tokens-cap", "64",
+             "--trace-out", traces[name]] + extra,
+            cwd=REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+
+    def stop(proc):
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(_signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def wait_alert(deadline):
+        while time.time() < deadline:
+            if os.path.exists(alerts_jsonl):
+                with open(alerts_jsonl) as f:
+                    for line in f:
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        if (rec.get("slo_alert") == "short_ttft_p95_s"
+                                and rec.get("state") == "firing"
+                                and rec.get("target") == "r1"):
+                            return rec
+            time.sleep(0.3)
+        return None
+
+    try:
+        deadline = time.time() + budget * 0.25
+        for name in ("r0", "r1"):
+            up = False
+            while time.time() < deadline and procs[name].poll() is None:
+                try:
+                    up = http_get(
+                        f"http://127.0.0.1:{ports[name]}/healthz",
+                        timeout=3,
+                    )[0] == 200
+                except OSError:
+                    up = False
+                if up:
+                    break
+                time.sleep(0.3)
+            if not up:
+                record({"phase": "slo_watch",
+                        "error": f"replica {name} never answered /healthz"})
+                raise SystemExit(1)
+        # WARM-UP before the watcher starts: the first requests compile
+        # (one-off TTFT spikes — the first dry-run measured TWO spiked
+        # admissions on r0, so its 25-sample p95 was still the 1.4 s
+        # spike); r0 gets enough post-compile samples that its rolling
+        # nearest-rank p95 skips several outliers (64 warm requests ->
+        # p95 is the 3rd-largest sample), r1 just compiles — its gauge
+        # SHOULD burn
+        warm_doc = {"token_ids": [(i * 7 + 3) % 256 for i in range(8)],
+                    "max_new_tokens": 4, "temperature": 0.0,
+                    "stop": False, "prefix_cache": False}
+        code, _ = http_post_json(
+            f"http://127.0.0.1:{ports['r1']}/v1/generate", warm_doc,
+            timeout=120,
+        )
+        if code != 200:
+            record({"phase": "slo_watch",
+                    "error": f"r1 warmup failed {code}"})
+            raise SystemExit(1)
+        for i in range(64):
+            code, _ = http_post_json(
+                f"http://127.0.0.1:{ports['r0']}/v1/generate",
+                {**warm_doc, "seed": i}, timeout=120,
+            )
+            if code != 200:
+                record({"phase": "slo_watch",
+                        "error": f"r0 warmup request {i} failed {code}"})
+                raise SystemExit(1)
+        procs["router"] = subprocess.Popen(
+            [sys.executable, "-m", "nanodiloco_tpu", "fleet",
+             "--replica", f"http://127.0.0.1:{ports['r0']}",
+             "--replica", f"http://127.0.0.1:{ports['r1']}",
+             "--port", str(ports["router"]), "--host", "127.0.0.1",
+             "--events-jsonl", deploy_jsonl,
+             "--health-interval-s", "0.3",
+             "--trace-out", traces["router"], "--quiet"],
+            cwd=REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        # the router process imports the package (seconds): wait for
+        # its socket before the watcher starts, or the first burn
+        # transition races the boot (the monitor retries failed hook
+        # posts anyway — this just keeps the drill's timeline tight)
+        deadline = time.time() + budget * 0.2
+        router_up = False
+        while time.time() < deadline and procs["router"].poll() is None:
+            try:
+                http_get(f"http://127.0.0.1:{ports['router']}/healthz",
+                         timeout=3)
+                router_up = True
+                break
+            except OSError:
+                time.sleep(0.3)
+        if not router_up:
+            record({"phase": "slo_watch",
+                    "error": "router never opened its socket"})
+            raise SystemExit(1)
+        procs["watch"] = subprocess.Popen(
+            [sys.executable, "-m", "nanodiloco_tpu", "obs-watch",
+             "--target", f"r0=http://127.0.0.1:{ports['r0']}",
+             "--target", f"r1=http://127.0.0.1:{ports['r1']}",
+             "--target", f"router=http://127.0.0.1:{ports['router']}",
+             "--router-url", f"http://127.0.0.1:{ports['router']}",
+             "--port", str(ports["watch"]), "--host", "127.0.0.1",
+             "--interval-s", "0.4",
+             "--ttft-p95-max", "0.12",
+             "--fast-window-s", "2", "--slow-window-s", "5",
+             "--fast-burn", "0.5", "--slow-burn", "0.3",
+             "--alerts-jsonl", alerts_jsonl,
+             "--series-jsonl", series_jsonl],
+            cwd=REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        # burn traffic straight at the straggler: each request's TTFT
+        # carries the injected tick delay, poisoning r1's p95 window
+        burn_errors = []
+
+        def burn(i):
+            try:
+                code, _ = http_post_json(
+                    f"http://127.0.0.1:{ports['r1']}/v1/generate",
+                    {**warm_doc, "seed": 100 + i}, timeout=120,
+                )
+                if code != 200:
+                    burn_errors.append(code)
+            except OSError as e:
+                burn_errors.append(str(e))
+
+        threads = [threading.Thread(target=burn, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if burn_errors:
+            record({"phase": "slo_watch",
+                    "error": f"burn traffic failed: {burn_errors[:3]}"})
+            raise SystemExit(1)
+        alert = wait_alert(time.time() + budget * 0.25)
+        if alert is None:
+            tail = ""
+            if os.path.exists(alerts_jsonl):
+                tail = open(alerts_jsonl).read()[-400:]
+            record({"phase": "slo_watch",
+                    "error": f"TTFT burn alert never fired; tail: {tail}"})
+            raise SystemExit(1)
+        # the alert record lands in the JSONL BEFORE the hook's POST
+        # reaches the router: wait for the route-around mark to apply
+        not_preferred: dict = {}
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            code, body = http_get(
+                f"http://127.0.0.1:{ports['router']}/fleet/status",
+                timeout=5,
+            )
+            not_preferred = json.loads(body).get("slo_not_preferred", {})
+            if "r1" in not_preferred:
+                break
+            time.sleep(0.3)
+        # the burn must be r1's ALONE: if r0's gauge also breached (the
+        # warm-up failed to dilute its compile spikes) the route-around
+        # assertion below would be meaningless — fail here with the
+        # measured series instead of a confusing served_by mix
+        if "r0" in not_preferred:
+            record({"phase": "slo_watch",
+                    "error": "r0 burned the TTFT SLO too (warm-up did "
+                             "not clean its p95 window) — the drill "
+                             "needs exactly one burning replica",
+                    "slo_not_preferred": not_preferred})
+            raise SystemExit(1)
+        # route-around: post-alert traffic through the ROUTER must land
+        # on r0 (served_by echoed), while r1 stays serving — the
+        # route-around-before-ejection ordering over the real wire
+        served_by = []
+        for i in range(4):
+            code, out = http_post_json(
+                f"http://127.0.0.1:{ports['router']}/v1/generate",
+                {**warm_doc, "seed": 200 + i,
+                 "request_id": f"drill-join-{i}"}, timeout=120,
+            )
+            if code != 200:
+                record({"phase": "slo_watch",
+                        "error": f"post-alert request {i} failed {code}"})
+                raise SystemExit(1)
+            served_by.append(out.get("served_by"))
+        if set(served_by) != {"r0"}:
+            record({"phase": "slo_watch",
+                    "error": "router did not route around the burning "
+                             "replica", "served_by": served_by})
+            raise SystemExit(1)
+        code, body = http_get(
+            f"http://127.0.0.1:{ports['router']}/fleet/status", timeout=5
+        )
+        status = json.loads(body)
+        # r1 must still be SERVING (not ejected): the fleet gauge is
+        # the authoritative count
+        code, m_text = http_get(
+            f"http://127.0.0.1:{ports['router']}/metrics", timeout=5
+        )
+        m = parse_metrics_text(m_text)
+        if m.get("nanodiloco_fleet_replicas_serving") != 2:
+            record({"phase": "slo_watch",
+                    "error": "burning replica was ejected instead of "
+                             "routed around",
+                    "metrics": {k: v for k, v in m.items()
+                                if "replicas" in k}})
+            raise SystemExit(1)
+        if "r1" not in status["slo_not_preferred"]:
+            record({"phase": "slo_watch",
+                    "error": "router never marked r1 not-preferred",
+                    "status": status})
+            raise SystemExit(1)
+        # the watcher's own counters scrape over the wire
+        code, w_text = http_get(
+            f"http://127.0.0.1:{ports['watch']}/metrics", timeout=5
+        )
+        w = parse_metrics_text(w_text)
+        alerts_total = w.get(
+            'nanodiloco_slo_alerts_total{rule="short_ttft_p95_s"}'
+        )
+        if not alerts_total:
+            record({"phase": "slo_watch",
+                    "error": "obs-watch /metrics missing the alert "
+                             "counter",
+                    "scraped": {k: v for k, v in w.items()
+                                if "slo" in k or "obs" in k}})
+            raise SystemExit(1)
+    finally:
+        for name in ("watch", "router", "r1", "r0"):
+            stop(procs.get(name))
+
+    # artifacts after shutdown: merged trace joins the tiers on the
+    # request_id key; report timeseries renders the incident
+    merged_path = os.path.join(tmp, "merged-trace.json")
+    merge = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu", "report", "merge-trace",
+         traces["router"], traces["r0"], traces["r1"],
+         "-o", merged_path],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    if merge.returncode != 0:
+        record({"phase": "slo_watch",
+                "error": f"merge-trace failed: {merge.stdout[-200:]}"
+                         f"{merge.stderr[-200:]}"})
+        raise SystemExit(1)
+    with open(merged_path) as f:
+        merged = json.load(f)
+    join_pids = {
+        e["pid"] for e in merged["traceEvents"]
+        if e.get("ph") == "X"
+        and str((e.get("args") or {}).get("request_id", "")
+                ).startswith("drill-join-")
+    }
+    if len(join_pids) < 2:
+        record({"phase": "slo_watch",
+                "error": "merged trace does not join router and replica "
+                         "spans on the drill request_ids",
+                "join_pids": sorted(join_pids)})
+        raise SystemExit(1)
+    ts = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu", "report", "timeseries",
+         series_jsonl, "--key", "ttft"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60,
+    )
+    if ts.returncode != 0 or "r1:nanodiloco_serve_ttft_p95_seconds" \
+            not in ts.stdout:
+        record({"phase": "slo_watch",
+                "error": f"report timeseries failed: {ts.stdout[-200:]}"
+                         f"{ts.stderr[-200:]}"})
+        raise SystemExit(1)
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    summary = summarize_run(alerts_jsonl)
+    if not summary.get("slo_alerts_total"):
+        record({"phase": "slo_watch",
+                "error": "summarize_run missing slo keys",
+                "summary": {k: v for k, v in summary.items()
+                            if k.startswith("slo")}})
+        raise SystemExit(1)
+    record({
+        "phase": "slo_watch",
+        "backend_live": live,
+        "alert_rule": alert["slo_alert"],
+        "alert_target": alert["target"],
+        "served_by_after_alert": served_by,
+        "slo_alerts_total": summary.get("slo_alerts_total"),
+        "slo_burn_seconds": summary.get("slo_burn_seconds"),
+        "slo_worst_rule": summary.get("slo_worst_rule"),
+        "trace_join_pids": len(join_pids),
+        "obs_watch_alert_counter": alerts_total,
+    })
+
+
 PHASES = {
     "bench": phase_bench,
     "sweep": phase_sweep,
@@ -2194,6 +2577,7 @@ PHASES = {
     "spec_decode": phase_spec_decode,
     "tp_decode": phase_tp_decode,
     "fleet": phase_fleet,
+    "slo_watch": phase_slo_watch,
 }
 
 
@@ -2242,6 +2626,7 @@ PHASE_TIMEOUT_S = {
     "spec_decode": 900,
     "tp_decode": 1200,
     "fleet": 1800,
+    "slo_watch": 1500,
 }
 
 

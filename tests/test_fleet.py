@@ -580,6 +580,86 @@ def test_generate_routes_and_retries_on_503(tmp_path):
     assert code == 200 and out["replica"] == "r0"
 
 
+def test_generate_request_id_rides_the_retry_and_served_by_is_echoed(
+    tmp_path,
+):
+    """The request_id propagation regression: a client-supplied
+    request_id must be forwarded on BOTH attempts — the retry replica
+    used to be the one place the join key could vanish, which broke
+    the router-span/replica-span trace join for exactly the requests
+    that needed diagnosing. The response names the replica that
+    actually served it (served_by), not just the first pick."""
+    router, fleet, _ = _router(tmp_path)
+    router.health_tick()
+    # r0 is the pick (least loaded) but answers 503 -> retry on r1
+    fleet.docs["r1"]["stats"].update(queue_depth=5)
+    router.health_tick()
+    fleet.generate_reply["r0"] = (503, {"error": "draining"})
+    code, out = router.handle_generate(
+        {"token_ids": [1], "request_id": "cli-77"}
+    )
+    assert code == 200
+    assert out["served_by"] == "r1" and out["replica"] == "r1"
+    assert out["request_id"] == "cli-77"
+    gen_posts = [(n, d) for n, p, d in fleet.posts if p == "/v1/generate"]
+    assert [n for n, _ in gen_posts] == ["r0", "r1"]
+    assert all(d["request_id"] == "cli-77" for _, d in gen_posts)
+
+
+def test_generate_stamps_one_request_id_when_client_sent_none(tmp_path):
+    """No client id: the router stamps ONE rtr-<n> id that rides every
+    attempt and is echoed in the response — the cross-tier join key
+    exists for every request, not just the well-behaved clients'."""
+    router, fleet, _ = _router(tmp_path)
+    router.health_tick()
+    fleet.generate_reply["r0"] = (503, {"error": "draining"})
+    fleet.docs["r1"]["stats"].update(queue_depth=5)
+    router.health_tick()
+    code, out = router.handle_generate({"token_ids": [1]})
+    assert code == 200
+    gen_posts = [d for _n, p, d in fleet.posts if p == "/v1/generate"]
+    assert len(gen_posts) == 2
+    stamped = gen_posts[0]["request_id"]
+    assert stamped.startswith("rtr-")
+    assert gen_posts[1]["request_id"] == stamped  # SAME id on the retry
+    assert out["request_id"] == stamped
+    # and the no-replica failure still names the id for client logs
+    fleet.generate_reply["r1"] = (503, {"error": "dead"})
+    router.health_tick()
+    code, out = router.handle_generate({"token_ids": [1]})
+    assert code == 503 and out["request_id"].startswith("rtr-")
+
+
+def test_router_records_route_and_forward_spans_with_request_id(tmp_path):
+    from nanodiloco_tpu.obs import SpanTracer
+
+    clock = FakeClock()
+    fleet = ScriptedFleet(("r0", "r1"))
+    tracer = SpanTracer(clock=clock, process_name="nanodiloco router")
+    router = FleetRouter(
+        [Replica("r0", "http://fake/r0"), Replica("r1", "http://fake/r1")],
+        probe=fleet.probe, post=fleet.post, clock=clock,
+        sleep=lambda s: clock.advance(s), tracer=tracer, quiet=True,
+    )
+    router.health_tick()
+    fleet.generate_reply["r0"] = (503, {"error": "draining"})
+    fleet.docs["r1"]["stats"].update(queue_depth=5)
+    router.health_tick()
+    code, out = router.handle_generate(
+        {"token_ids": [1], "request_id": "trace-me"}
+    )
+    assert code == 200
+    spans = {(e["name"], e["args"].get("replica"))
+             for e in tracer.events
+             if e.get("args", {}).get("request_id") == "trace-me"}
+    # one forward per attempt (the retry flagged), one route envelope
+    assert ("forward", "r0") in spans and ("forward", "r1") in spans
+    assert ("route", None) in spans
+    retry_flags = [e["args"]["retry"] for e in tracer.events
+                   if e["name"] == "forward"]
+    assert retry_flags == [False, True]
+
+
 def test_fleet_goodput_partitions_replica_seconds(tmp_path):
     """Every replica-second lands in a state bucket; the fleet goodput
     fraction is ready-seconds / (elapsed x replicas)."""

@@ -1,0 +1,411 @@
+"""Collector tests (nanodiloco_tpu/obs/collector).
+
+The load-bearing contract is the exposition ROUND TRIP:
+``render_exposition(parse_exposition(text)) == text`` byte-for-byte for
+everything this repo's endpoints emit — gauges, counters with labeled
+samples plus the unlabeled aggregate, labeled histogram families, and
+label values carrying every escaped character. Property-style: a seeded
+generator builds randomized families (nasty label values included) and
+asserts the round trip on each. On top of that: the flat
+``parse_metrics_text`` fix (escape-correct keys), ring-buffer bounds,
+the window/rate/percentile queries the SLO engine uses, the scripted
+scrape loop, and the ``report timeseries`` rendering path.
+"""
+
+import json
+import random
+
+import pytest
+
+from nanodiloco_tpu.cli import report_timeseries_main
+from nanodiloco_tpu.obs.collector import (
+    Collector,
+    SeriesStore,
+    flatten_families,
+    parse_exposition,
+    parse_sample_line,
+    read_series_jsonl,
+    sample_key,
+    sparkline,
+)
+from nanodiloco_tpu.obs.telemetry import (
+    Histogram,
+    parse_metrics_text,
+    render_exposition,
+)
+
+
+# -- exposition round trip ----------------------------------------------------
+
+NASTY_VALUES = [
+    "plain",
+    "with space",
+    'quoted "value"',
+    "back\\slash",
+    "multi\nline",
+    "trailing backslash\\",
+    "\\n literal backslash-n",
+    'all \\ of " it\nat once',
+    "carriage\rreturn",
+    "",
+]
+
+
+def _random_families(rng: random.Random) -> list:
+    families = []
+    for i in range(rng.randint(1, 6)):
+        name = f"nanodiloco_prop_{rng.choice(['a', 'b', 'c'])}{i}"
+        kind = rng.choice(["gauge", "counter", "histogram"])
+        help_text = rng.choice([
+            "plain help", "help with \\ backslash", "help\nnewline", name,
+        ])
+        if kind == "histogram":
+            series = []
+            for s in range(rng.randint(1, 3)):
+                h = Histogram(buckets=(0.001, 0.5, 2.5, 60.0))
+                for _ in range(rng.randint(0, 8)):
+                    h.observe(rng.uniform(0, 100))
+                labels = None if s == 0 and rng.random() < 0.5 else {
+                    "priority": str(s),
+                    **({"tag": rng.choice(NASTY_VALUES)}
+                       if rng.random() < 0.5 else {}),
+                }
+                series.append((labels, h.snapshot()))
+            families.append((name, kind, help_text, series))
+            continue
+        samples = []
+        for s in range(rng.randint(1, 4)):
+            labels = None if rng.random() < 0.3 else {
+                "kind": rng.choice(NASTY_VALUES),
+                **({"worker": str(s)} if rng.random() < 0.5 else {}),
+            }
+            value = rng.choice([
+                0, 1, 7, rng.uniform(-10, 10), 1234567.25, 0.001,
+            ])
+            samples.append((labels, value))
+        families.append((name, kind, help_text, samples))
+    return families
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_exposition_round_trips_byte_exact(seed):
+    """render -> parse -> render reproduces the exposition exactly:
+    the scrape path and the exposition path speak ONE dialect by
+    construction, not by convention."""
+    rng = random.Random(seed)
+    families = _random_families(rng)
+    text = render_exposition(families)
+    text2 = render_exposition(parse_exposition(text))
+    assert text2 == text
+
+
+def test_round_trip_preserves_values_and_label_content():
+    """Beyond the textual identity: parsed values and UNESCAPED label
+    values match what the renderer was handed."""
+    families = [
+        ("nanodiloco_x", "counter", "h",
+         [({"kind": v}, i + 0.5) for i, v in enumerate(NASTY_VALUES[:-1])]
+         + [(None, 99)]),
+    ]
+    parsed = parse_exposition(render_exposition(families))
+    (name, mtype, help_text, samples), = parsed
+    assert (name, mtype, help_text) == ("nanodiloco_x", "counter", "h")
+    assert [s[0]["kind"] for s in samples[:-1]] == NASTY_VALUES[:-1]
+    assert samples[-1] == (None, 99)
+    assert [s[1] for s in samples[:-1]] == [
+        i + 0.5 for i in range(len(NASTY_VALUES) - 1)
+    ]
+
+
+def test_round_trip_real_endpoint_dialects():
+    """The actual families our endpoints render (telemetry gauge set,
+    serve outcome counters, labeled queue-wait histograms) round-trip —
+    the regression pin for every /metrics in the project."""
+    h0, h1 = Histogram(), Histogram()
+    for v in (0.004, 0.2, 3.0):
+        h0.observe(v)
+    h1.observe(0.05)
+    families = [
+        ("nanodiloco_loss", "gauge", "last logged training loss",
+         [(None, 2.125)]),
+        ("nanodiloco_alarms", "counter", "watchdog alarms by kind",
+         [({"kind": "nan_loss"}, 1), ({"kind": "stall"}, 2), (None, 3)]),
+        ("nanodiloco_serve_requests", "counter",
+         "requests by terminal outcome",
+         [({"outcome": k}, v) for k, v in
+          (("served", 10), ("rejected", 1), ("expired", 0),
+           ("cancelled", 2), ("error", 1))] + [(None, 14)]),
+        ("nanodiloco_serve_queue_wait_by_priority_seconds", "histogram",
+         "slot wait split by SLO priority class",
+         [({"priority": "0"}, h0.snapshot()),
+          ({"priority": "1"}, h1.snapshot())]),
+        ("nanodiloco_serve_ttft_histogram_seconds", "histogram",
+         "time to first token", h0.snapshot()),
+        ("nanodiloco_kv_blocks_free_per_shard", "gauge",
+         "KV blocks free per tensor-parallel shard",
+         [({"shard": "0"}, 12), ({"shard": "1"}, 12)]),
+    ]
+    text = render_exposition(families)
+    assert render_exposition(parse_exposition(text)) == text
+    # and the flat view exposes the exact rendered keys
+    flat = flatten_families(parse_exposition(text))
+    assert flat['nanodiloco_serve_requests_total{outcome="error"}'] == 1.0
+    assert flat["nanodiloco_serve_requests_total"] == 14.0
+    assert flat[
+        'nanodiloco_serve_queue_wait_by_priority_seconds_bucket'
+        '{priority="0",le="0.25"}'
+    ] == 2.0
+    assert flat["nanodiloco_serve_ttft_histogram_seconds_count"] == 3.0
+
+
+def test_parse_metrics_text_unescapes_label_values_correctly():
+    """The flat parser fix: escaped quotes/backslashes/newlines inside
+    label values parse to the CANONICAL key (re-escaped), and a literal
+    backslash-n is not corrupted into a newline — the single-pass
+    unescape the naive replace() chain gets wrong."""
+    families = [
+        ("m", "gauge", "h",
+         [({"k": 'a "b" c'}, 1.0), ({"k": "line\nbreak"}, 2.0),
+          ({"k": "\\n"}, 3.0)]),
+    ]
+    text = render_exposition(families)
+    flat = parse_metrics_text(text)
+    assert flat['m{k="a \\"b\\" c"}'] == 1.0
+    assert flat['m{k="line\\nbreak"}'] == 2.0
+    assert flat['m{k="\\\\n"}'] == 3.0
+    # the structured parse recovers the ORIGINAL values
+    (_n, _t, _h, samples), = parse_exposition(text)
+    assert [s[0]["k"] for s in samples] == ['a "b" c', "line\nbreak", "\\n"]
+
+
+def test_carriage_return_no_longer_tears_the_exposition():
+    """The render/parse asymmetry this PR found and fixed: a raw CR in
+    a label value (an HTTP error string ends ``\\r\\n``) used to land
+    UNESCAPED in the exposition — invalid OpenMetrics, and torn into
+    garbage keys by any ``splitlines()``-based consumer. It now travels
+    as the ``\\r`` escape and round-trips."""
+    families = [("m", "gauge", "cr\rhelp", [({"k": "a\rb"}, 1.0)])]
+    text = render_exposition(families)
+    assert "\r" not in text  # never raw on the wire
+    assert render_exposition(parse_exposition(text)) == text
+    (_n, _t, help_text, samples), = parse_exposition(text)
+    assert help_text == "cr\rhelp"
+    assert samples[0][0]["k"] == "a\rb"
+    # the flat parser agrees (one canonical escaped key, right value)
+    assert parse_metrics_text(text)['m{k="a\\rb"}'] == 1.0
+
+
+def test_parse_sample_line_rejects_non_samples():
+    for line in ("", "# HELP x y", "# EOF", "justaname",
+                 'truncated{a="b"}'):  # torn line: ValueError, never
+        # IndexError (scrape_once's isolation only catches ValueError)
+        with pytest.raises(ValueError):
+            parse_sample_line(line)
+    assert parse_sample_line("x 1") == ("x", None, 1.0)
+    assert sample_key("x", None) == "x"
+
+
+def test_scrape_survives_a_torn_exposition(tmp_path):
+    """A target answering a truncated body (died mid-write) is a
+    counted scrape error, never a collector crash — per-target
+    isolation is the whole point of the error path."""
+    bodies = {"r0": 'ok_metric 1\ntruncated{a="b"}',
+              "r1": _exposition(0.01)}
+    col = Collector(
+        [("r0", "http://r0:1"), ("r1", "http://r1:1")],
+        fetch=lambda url, timeout: bodies[url.split("/")[-2].split(":")[0]],
+        clock=FakeClock(),
+    )
+    result = col.scrape_once()
+    # the torn LINE is skipped (tolerant line scanner), the good line
+    # and the healthy target both land
+    assert result["r0"] >= 1 and result["r1"] > 0
+    assert col.store.latest("r0:ok_metric") == (0.0, 1.0)
+
+
+def test_parser_tolerates_foreign_expositions():
+    """Unknown comments, junk lines, and samples without metadata must
+    not crash the scrape (a foreign exporter on the same port)."""
+    text = (
+        "# weird comment\n"
+        "no_metadata_metric 4\n"
+        "garbage line without value\n"
+        'labeled{a="1"} 2\n'
+        "# TYPE h histogram\n"
+        'h_bucket{oops="no le"} 3\n'   # bucket without le: skipped,
+        "h_count 3\n"                  # never a TypeError crash
+        "h_sum 1.5\n"
+    )
+    fams = parse_exposition(text)
+    flat = flatten_families(fams)
+    assert flat["no_metadata_metric"] == 4.0
+    assert flat['labeled{a="1"}'] == 2.0
+    assert flat["h_count"] == 3.0
+
+
+# -- series store -------------------------------------------------------------
+
+
+def test_series_store_bounds_every_ring():
+    store = SeriesStore(maxlen=8)
+    for i in range(100):
+        store.add("k", float(i), float(i))
+    samples = store.window("k", 0.0)
+    assert len(samples) == 8
+    assert samples[0] == (92.0, 92.0) and samples[-1] == (99.0, 99.0)
+
+
+def test_series_store_window_and_aggregates():
+    store = SeriesStore()
+    for i in range(10):
+        store.add("k", float(i), float(i * 10))
+    assert store.window("k", 7.0) == [(7.0, 70.0), (8.0, 80.0), (9.0, 90.0)]
+    assert store.agg("k", 2.5, now=9.0, fn="mean") == pytest.approx(80.0)
+    assert store.agg("k", 2.5, now=9.0, fn="max") == 90.0
+    assert store.agg("k", 2.5, now=9.0, fn="min") == 70.0
+    assert store.agg("k", 2.5, now=9.0, fn="last") == 90.0
+    assert store.agg("missing", 5.0, now=9.0) is None
+    assert store.latest("k") == (9.0, 90.0)
+
+
+def test_series_store_percentile_nearest_rank():
+    store = SeriesStore()
+    for i, v in enumerate([5.0, 1.0, 9.0, 3.0]):
+        store.add("k", float(i), v)
+    assert store.percentile("k", 0.5, window_s=10.0, now=3.0) == 3.0
+    assert store.percentile("k", 0.95, window_s=10.0, now=3.0) == 9.0
+    assert store.percentile("missing", 0.5, 10.0, 3.0) is None
+
+
+def test_series_store_counter_rate_survives_resets():
+    """A counter dropping (process restart) contributes NO negative
+    delta: the increase is the sum of positive moves only."""
+    store = SeriesStore()
+    for t, v in [(0, 100), (1, 110), (2, 120), (3, 5), (4, 15)]:
+        store.add("c", float(t), float(v))
+    assert store.increase("c", window_s=10.0, now=4.0) == pytest.approx(30.0)
+    assert store.rate("c", window_s=10.0, now=4.0) == pytest.approx(30.0 / 4)
+    # fewer than two samples in the window: no evidence, not zero
+    assert store.increase("c", window_s=0.5, now=4.0) is None
+
+
+# -- the scrape loop (scripted fetch, fake clock) -----------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _exposition(ttft, served=0, errors=0):
+    return render_exposition([
+        ("nanodiloco_serve_ttft_p95_seconds", "gauge", "p95 ttft",
+         [(None, ttft)]),
+        ("nanodiloco_serve_requests", "counter", "by outcome",
+         [({"outcome": "served"}, served), ({"outcome": "error"}, errors),
+          (None, served + errors)]),
+    ])
+
+
+def test_collector_scrapes_targets_into_prefixed_series(tmp_path):
+    clock = FakeClock()
+    docs = {"r0": _exposition(0.01, served=3),
+            "r1": _exposition(0.9, served=1, errors=2)}
+
+    def fetch(url, timeout):
+        name = url.split("/")[-2].split(":")[0]
+        return docs[name]
+
+    col = Collector(
+        [("r0", "http://r0:1"), ("r1", "http://r1:1")],
+        fetch=fetch, clock=clock,
+        wall=lambda: 1000.0 + clock.t,
+        series_jsonl=str(tmp_path / "series.jsonl"),
+    )
+    result = col.scrape_once()
+    assert result["r0"] > 0 and result["r1"] > 0
+    assert col.store.latest("r0:nanodiloco_serve_ttft_p95_seconds") == (
+        0.0, 0.01
+    )
+    assert col.store.latest(
+        'r1:nanodiloco_serve_requests_total{outcome="error"}'
+    ) == (0.0, 2.0)
+    # a dead target never aborts the sweep — the others' series land
+    def fetch2(url, timeout):
+        if "r1" in url:
+            raise OSError("connection refused")
+        return docs["r0"]
+
+    col._fetch = fetch2
+    clock.advance(1.0)
+    result = col.scrape_once()
+    assert result["r0"] > 0 and "error" in result["r1"]
+    assert col.scrape_errors == {"r1": 1}
+    assert col.store.latest("r0:nanodiloco_serve_ttft_p95_seconds")[0] == 1.0
+    # the snapshot JSONL reads back as per-key series
+    series = read_series_jsonl(str(tmp_path / "series.jsonl"))
+    assert series["r0:nanodiloco_serve_ttft_p95_seconds"] == [
+        (1000.0, 0.01), (1001.0, 0.01)
+    ]
+    assert len(series["r1:nanodiloco_serve_requests_total"]) == 1
+    # the collector's own exposition round-trips too
+    m = parse_metrics_text(col.render_metrics())
+    assert m["nanodiloco_obs_scrapes_total"] == 2.0
+    assert m['nanodiloco_obs_scrape_errors_total{target="r1"}'] == 1.0
+
+
+def test_collector_run_cadence_with_injected_sleep():
+    clock = FakeClock()
+    col = Collector(
+        [("r0", "http://r0:1")],
+        fetch=lambda url, timeout: _exposition(0.01),
+        clock=clock, interval_s=0.5,
+        sleep=lambda s: clock.advance(s),
+    )
+    seen = []
+    col.run(max_scrapes=4, on_scrape=lambda r: seen.append(dict(r)))
+    assert len(seen) == 4 and col.scrapes == 4
+    samples = col.store.window("r0:nanodiloco_serve_ttft_p95_seconds", 0.0)
+    assert [t for t, _ in samples] == [0.0, 0.5, 1.0, 1.5]
+
+
+# -- sparklines + report timeseries -------------------------------------------
+
+
+def test_sparkline_shape_and_resample():
+    assert sparkline([]) == ""
+    assert len(sparkline([1.0] * 5)) == 5
+    s = sparkline([0.0, 1.0, 2.0, 3.0], width=4)
+    assert s[0] == "▁" and s[-1] == "█"
+    assert len(sparkline(list(map(float, range(500))), width=40)) == 40
+
+
+def test_report_timeseries_renders_incident(tmp_path, capsys):
+    path = tmp_path / "series.jsonl"
+    with open(path, "w") as f:
+        for i in range(12):
+            f.write(json.dumps({
+                "series": "r1", "t_unix": 1000.0 + i, "t": float(i),
+                "samples": {
+                    "nanodiloco_serve_ttft_p95_seconds":
+                        0.01 if i < 6 else 0.8,
+                    "nanodiloco_serve_slots_total": 4,
+                },
+            }) + "\n")
+    report_timeseries_main([str(path), "--key", "ttft"])
+    out = capsys.readouterr().out
+    assert "r1:nanodiloco_serve_ttft_p95_seconds" in out
+    assert "▁" in out and "█" in out  # the step up is visible
+    assert "max=0.8" in out
+    # constant series hidden by default, shown with --all
+    report_timeseries_main([str(path), "--all"])
+    out = capsys.readouterr().out
+    assert "slots_total" in out
+    with pytest.raises(SystemExit):
+        report_timeseries_main([str(path), "--key", "nonexistent"])

@@ -1,0 +1,713 @@
+"""SLO burn-rate tests (nanodiloco_tpu/obs/slo) — model-free.
+
+Three layers:
+
+- BURN-RATE UNITS under an injected clock: the fast window trips only
+  once the slow window confirms, recovery clears only after the
+  debounce, a flapping signal emits one firing/resolved pair, burn
+  seconds accumulate while firing, and the derived error-rate rule
+  reads counter increases.
+- THE DRILL: a scripted 2-replica fleet (real FleetRouter with scripted
+  probes, real Collector with a scripted fetch, real SLOMonitor, real
+  DeployController with a scripted bench — one shared fake clock, no
+  sockets, no model). One replica burns TTFT: the multi-window alert
+  fires into the JSONL, the router routes around the burning replica
+  BEFORE any ejection (it stays serving), a fleet-scope burn defers the
+  canary, recovery clears everything, and the router+replica trace
+  shards join on ``request_id`` in one merged timeline.
+- SURFACES: ``summarize_run`` SLO keys (older JSONLs untouched) and the
+  ``slo_burn_seconds`` absolute compare gate, both directions.
+"""
+
+import json
+
+import pytest
+
+from nanodiloco_tpu.fleet import DeployController, FleetRouter, Replica
+from nanodiloco_tpu.obs.collector import Collector, SeriesStore
+from nanodiloco_tpu.obs.slo import (
+    SLOMonitor,
+    SLORule,
+    standard_rules,
+)
+from nanodiloco_tpu.obs.telemetry import render_exposition
+from nanodiloco_tpu.obs.tracer import SpanTracer, merge_chrome_traces
+from nanodiloco_tpu.training.metrics import compare_runs, summarize_run
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _store_with(clock, key, values, dt=1.0):
+    """A store holding one series: values at 1-sample/sec ending at the
+    clock's now."""
+    store = SeriesStore()
+    t0 = clock() - dt * (len(values) - 1)
+    for i, v in enumerate(values):
+        store.add(key, t0 + i * dt, float(v))
+    return store
+
+
+RULE = SLORule("ttft", "m_ttft", 0.5, "ceiling", "replica",
+               fast_window_s=5.0, slow_window_s=20.0,
+               fast_burn=0.5, slow_burn=0.25, clear_debounce_s=4.0)
+
+
+def _monitor(clock, store, rules=None, targets=("r1",), **kw):
+    return SLOMonitor(store, list(rules or [RULE]), list(targets),
+                      clock=clock, wall=lambda: 1000.0 + clock(), **kw)
+
+
+def _feed(mon, clock, target, key, value, ticks, dt=1.0):
+    """Advance the clock tick by tick, adding one sample and
+    evaluating; returns every record emitted."""
+    out = []
+    for _ in range(ticks):
+        clock.advance(dt)
+        mon.store.add(f"{target}:{key}", clock(), float(value))
+        out += mon.evaluate()
+    return out
+
+
+# -- burn-rate units ----------------------------------------------------------
+
+
+def test_fast_window_trips_only_after_slow_window_confirms():
+    """A short burst breaches the whole FAST window but not the SLOW
+    one — no alert (a blip must not page); a sustained burn crosses
+    both and fires exactly once."""
+    clock = FakeClock(100.0)
+    store = SeriesStore()
+    mon = _monitor(clock, store)
+    # 15 healthy samples, then the burn starts
+    assert _feed(mon, clock, "r1", "m_ttft", 0.01, 15) == []
+    recs = _feed(mon, clock, "r1", "m_ttft", 2.0, 3)
+    # 3 bad of last 5 (fast 0.6 >= 0.5) but 3/18-in-window slow ~0.17
+    assert recs == [] and mon.firing() == []
+    recs = _feed(mon, clock, "r1", "m_ttft", 2.0, 4)
+    assert [r["state"] for r in recs] == ["firing"]
+    assert recs[0]["slo_alert"] == "ttft" and recs[0]["target"] == "r1"
+    assert recs[0]["fast_burn"] >= RULE.fast_burn
+    assert mon.firing() == [("ttft", "r1")]
+    # steady burn: no re-fire spam
+    assert _feed(mon, clock, "r1", "m_ttft", 2.0, 5) == []
+    assert mon.alerts_fired == {"ttft": 1}
+
+
+def test_recovery_clears_only_after_debounce():
+    clock = FakeClock()
+    mon = _monitor(clock, SeriesStore())
+    _feed(mon, clock, "r1", "m_ttft", 2.0, 25)
+    assert mon.firing() == [("ttft", "r1")]
+    # clean samples, but the fast window still holds old breaches
+    recs = _feed(mon, clock, "r1", "m_ttft", 0.01, 5)
+    assert recs == []
+    # fast window now clean, debounce (4 s) not yet elapsed
+    recs = _feed(mon, clock, "r1", "m_ttft", 0.01, 3)
+    assert recs == []
+    recs = _feed(mon, clock, "r1", "m_ttft", 0.01, 2)
+    assert [r["state"] for r in recs] == ["resolved"]
+    assert recs[0]["burn_s"] > 0
+    assert mon.firing() == []
+
+
+def test_flapping_burn_resets_the_clean_timer_not_the_alert():
+    """Burn -> clean-for-less-than-debounce -> burn again: ONE firing
+    record, no resolve/fire storm."""
+    clock = FakeClock()
+    mon = _monitor(clock, SeriesStore())
+    recs = _feed(mon, clock, "r1", "m_ttft", 2.0, 25)
+    assert [r["state"] for r in recs] == ["firing"]
+    for _ in range(3):  # flap: 6 clean (fast window clears mid-way)...
+        assert _feed(mon, clock, "r1", "m_ttft", 0.01, 6) == []
+        assert _feed(mon, clock, "r1", "m_ttft", 2.0, 6) == []
+    assert mon.alerts_fired == {"ttft": 1}
+    assert mon.firing() == [("ttft", "r1")]
+
+
+def test_burn_seconds_accumulate_while_firing():
+    clock = FakeClock()
+    mon = _monitor(clock, SeriesStore())
+    _feed(mon, clock, "r1", "m_ttft", 2.0, 25)
+    b0 = mon.burn_seconds()["ttft"]
+    _feed(mon, clock, "r1", "m_ttft", 2.0, 10)
+    assert mon.burn_seconds()["ttft"] == pytest.approx(b0 + 10.0)
+
+
+def test_evidence_loss_resolves_and_freezes_burn_accrual():
+    """The remediation-starves-the-signal loop: route-around leaves a
+    burning replica's counters flat, so the error-rate evidence
+    VANISHES. The alert must resolve after the debounce (not burn
+    until shutdown), and burn seconds must stop accruing the moment
+    the evidence is gone — silence is not incident time."""
+    clock = FakeClock()
+    mon = _monitor(clock, SeriesStore())
+    _feed(mon, clock, "r1", "m_ttft", 0.01, 15)   # healthy history
+    _feed(mon, clock, "r1", "m_ttft", 2.0, 8)     # ~8 s real burn
+    assert mon.firing() == [("ttft", "r1")]
+    burn_during = mon.burn_seconds()["ttft"]
+    # evidence disappears: clock advances, NO new samples — old ones
+    # age out of the windows
+    recs = []
+    for _ in range(30):
+        clock.advance(1.0)
+        recs += mon.evaluate()
+    assert [r["state"] for r in recs] == ["resolved"]
+    assert mon.firing() == []
+    # accrual froze once the fast window emptied: at most the fast
+    # window's worth of silence was added, never the full 30 s
+    assert mon.burn_seconds()["ttft"] <= burn_during + RULE.fast_window_s + 1
+
+
+def test_error_rate_rule_reads_counter_increases():
+    clock = FakeClock(50.0)
+    rules = standard_rules(error_rate_max=0.2, fast_window_s=5.0,
+                           slow_window_s=10.0, slow_burn=0.5)
+    store = SeriesStore()
+    mon = SLOMonitor(store, rules, ["r0"], clock=clock)
+    total_key = "r0:nanodiloco_serve_requests_total"
+    err_key = 'r0:nanodiloco_serve_requests_total{outcome="error"}'
+    total, err = 0, 0
+    for _ in range(10):  # healthy: requests flow, no errors
+        clock.advance(1.0)
+        total += 5
+        store.add(total_key, clock(), total)
+        store.add(err_key, clock(), err)
+        assert mon.evaluate() == []
+    for i in range(12):  # half of new requests error
+        clock.advance(1.0)
+        total += 4
+        err += 2
+        store.add(total_key, clock(), total)
+        store.add(err_key, clock(), err)
+        recs = mon.evaluate()
+        if recs:
+            break
+    assert recs and recs[0]["slo_alert"] == "error_rate"
+    assert recs[0]["state"] == "firing"
+
+
+def test_absent_series_neither_trips_nor_clears():
+    clock = FakeClock()
+    mon = _monitor(clock, SeriesStore(), targets=("r1", "ghost"))
+    recs = _feed(mon, clock, "r1", "m_ttft", 2.0, 25)
+    # only r1 fires; the ghost target has no series and stays silent
+    assert [(r["slo_alert"], r["target"]) for r in recs] == [("ttft", "r1")]
+
+
+def test_finalize_resolves_open_alerts_and_writes_summary(tmp_path):
+    clock = FakeClock()
+    path = tmp_path / "alerts.jsonl"
+    mon = _monitor(clock, SeriesStore(), alerts_jsonl=str(path))
+    _feed(mon, clock, "r1", "m_ttft", 2.0, 25)
+    clock.advance(3.0)
+    summary = mon.finalize()
+    assert summary["slo_summary"]["alerts_total"] == 1
+    assert summary["slo_summary"]["worst_rule"] == "ttft"
+    assert summary["slo_summary"]["burn_seconds_total"] > 0
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r.get("state") for r in recs[:-1]] == ["firing", "resolved"]
+    assert recs[1]["reason"] == "shutdown" and recs[1]["burn_s"] > 0
+    assert "slo_summary" in recs[-1]
+
+
+def test_failed_hook_transition_is_retried_with_current_state():
+    """The action hook posting to a router that is still booting must
+    not lose the transition: failed calls queue and retry on every
+    evaluate — delivering the pair's CURRENT state, so a burn that
+    resolved while the router was unreachable arrives as a clear."""
+    clock = FakeClock()
+    calls = []
+    fail = {"on": True}
+
+    def hook(rule, target, firing):
+        if fail["on"]:
+            raise OSError("connection refused")
+        calls.append((rule.name, target, firing))
+
+    mon = _monitor(clock, SeriesStore(), on_alert=hook)
+    _feed(mon, clock, "r1", "m_ttft", 2.0, 25)
+    assert mon.firing() == [("ttft", "r1")]
+    assert calls == [] and mon.hook_errors >= 1
+    # router comes up: the next evaluate delivers the pending burn
+    fail["on"] = False
+    _feed(mon, clock, "r1", "m_ttft", 2.0, 1)
+    assert calls == [("ttft", "r1", True)]
+    # and a transition that RESOLVED while unreachable arrives as clear
+    fail["on"] = True
+    _feed(mon, clock, "r1", "m_ttft", 0.01, 15)
+    assert mon.firing() == []
+    fail["on"] = False
+    _feed(mon, clock, "r1", "m_ttft", 0.01, 1)
+    assert calls[-1] == ("ttft", "r1", False)
+
+
+def test_fleet_burn_state_is_per_target_not_per_rule(tmp_path):
+    """Two targets burning the SAME fleet-scope rule: one target's
+    resolve must NOT clear the canary gate while the other still
+    burns — the router tracks (rule, target) pairs like the monitor
+    does, not collapsed rule names."""
+    clock = FakeClock()
+    fleet = ScriptedFleet()
+    router = FleetRouter(
+        [Replica("r0", "http://r0"), Replica("r1", "http://r1")],
+        probe=fleet.probe, post=fleet.post, clock=clock,
+        sleep=lambda s: clock.advance(s),
+        events_jsonl=str(tmp_path / "deploy.jsonl"), quiet=True,
+    )
+    router.set_slo_burning("outer_staleness", "trainer0", True,
+                           scope="fleet")
+    router.set_slo_burning("outer_staleness", "trainer1", True,
+                           scope="fleet")
+    assert router.slo_burning()
+    router.set_slo_burning("outer_staleness", "trainer0", False,
+                           scope="fleet")
+    assert router.slo_burning()  # trainer1 still burns: gate HOLDS
+    assert router.slo_state()["slo_fleet_burning"] == [
+        "outer_staleness@trainer1"
+    ]
+    router.set_slo_burning("outer_staleness", "trainer1", False,
+                           scope="fleet")
+    assert not router.slo_burning()
+
+
+def test_router_action_hook_treats_http_errors_as_failures():
+    """http_post_json reports 4xx/5xx as return values, not raises: the
+    wire hook must turn a refused transition (bad target name, router
+    mid-restart) into a FAILURE the monitor's retry queue sees — a
+    silent 400 would mean the route-around never happens with zero
+    diagnostics."""
+    from nanodiloco_tpu.obs.slo import router_action_hook
+
+    posted = []
+
+    def post(url, doc):
+        posted.append((url, doc))
+        return 400, {"error": "unknown replica"}
+
+    hook = router_action_hook(post, "http://router:1/")
+    with pytest.raises(OSError):
+        hook(RULE, "r9", True)
+    assert posted[0][0] == "http://router:1/fleet/slo"
+    assert posted[0][1]["rule"] == "ttft" and posted[0][1]["firing"]
+    # a 200 passes through silently
+    hook2 = router_action_hook(lambda u, d: (200, {"ok": True}),
+                               "http://router:1")
+    hook2(RULE, "r1", False)
+
+
+def test_rule_validation_is_loud():
+    with pytest.raises(ValueError):
+        SLORule("x", "k", 1.0, kind="sideways")
+    with pytest.raises(ValueError):
+        SLORule("x", "k", 1.0, fast_window_s=10.0, slow_window_s=5.0)
+    with pytest.raises(ValueError):
+        SLORule("x", "k", 1.0, fast_burn=0.0)
+    with pytest.raises(ValueError):
+        _monitor(FakeClock(), SeriesStore(), rules=[RULE, RULE])
+
+
+# -- the scripted 2-replica drill ---------------------------------------------
+
+
+class ScriptedFleet:
+    """Scripted probe/post for the router (the test_fleet idiom), plus
+    the scripted /metrics expositions the collector scrapes."""
+
+    def __init__(self):
+        self.docs = {
+            n: {"reachable": True, "live": True, "ready": True,
+                "stats": {"queue_depth": 0, "slots_busy": 0,
+                          "kv_blocks_free": 10, "in_flight": 0}}
+            for n in ("r0", "r1")
+        }
+        self.ttft = {"r0": 0.01, "r1": 0.01}
+        self.staleness = 0.0
+        self.posts = []
+
+    def probe(self, replica):
+        d = self.docs[replica.name]
+        return {k: (dict(v) if isinstance(v, dict) else v)
+                for k, v in d.items()}
+
+    def post(self, replica, path, doc, timeout=None):
+        self.posts.append((replica.name, path, dict(doc)))
+        if path == "/v1/generate":
+            return 200, {"token_ids": [1], "ok": True}
+        if path == "/admin/swap":
+            return 200, {"swapped": True,
+                         "deploy_generation": doc.get("step", 0)}
+        return 200, {}
+
+    def fetch(self, url, timeout):
+        name = url.split("//")[1].split("/")[0]
+        if name == "trainer":
+            return render_exposition([
+                ("nanodiloco_outer_staleness", "gauge", "staleness",
+                 [(None, self.staleness)]),
+            ])
+        return render_exposition([
+            ("nanodiloco_serve_ttft_p95_seconds", "gauge", "p95",
+             [(None, self.ttft[name])]),
+        ])
+
+
+def _drill(tmp_path):
+    clock = FakeClock()
+    fleet = ScriptedFleet()
+    tracer = SpanTracer(clock=clock, process_name="nanodiloco router")
+    router = FleetRouter(
+        [Replica("r0", "http://r0"), Replica("r1", "http://r1")],
+        probe=fleet.probe, post=fleet.post, clock=clock,
+        sleep=lambda s: clock.advance(s), tracer=tracer,
+        events_jsonl=str(tmp_path / "deploy.jsonl"), quiet=True,
+    )
+    router.health_tick()
+    collector = Collector(
+        [("r0", "http://r0"), ("r1", "http://r1"),
+         ("trainer", "http://trainer")],
+        fetch=fleet.fetch, clock=clock, wall=lambda: 2000.0 + clock.t,
+        series_jsonl=str(tmp_path / "series.jsonl"),
+    )
+    rules = standard_rules(
+        ttft_p95_max_s=0.5, outer_staleness_max=2.0,
+        fast_window_s=5.0, slow_window_s=20.0,
+        fast_burn=0.5, slow_burn=0.25, clear_debounce_s=4.0,
+    )
+    monitor = SLOMonitor(
+        collector.store, rules, ["r0", "r1", "trainer"],
+        clock=clock, wall=lambda: 2000.0 + clock.t,
+        alerts_jsonl=str(tmp_path / "alerts.jsonl"),
+        on_alert=lambda rule, target, firing: router.set_slo_burning(
+            rule.name, target, firing, scope=rule.scope
+        ),
+    )
+
+    def tick(n=1):
+        for _ in range(n):
+            clock.advance(1.0)
+            collector.scrape_once()
+            monitor.evaluate()
+
+    return clock, fleet, router, collector, monitor, tick
+
+
+def _events(tmp_path):
+    path = tmp_path / "deploy.jsonl"
+    if not path.exists():
+        return []
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+def test_drill_burn_routes_around_before_ejection(tmp_path):
+    """THE incident: r1 burns TTFT -> the multi-window alert fires into
+    the JSONL -> the router marks r1 not-preferred and routes new
+    traffic to r0 while r1 STAYS SERVING (route-around, never a 503
+    ejection) -> recovery clears the mark and load-based routing
+    returns."""
+    clock, fleet, router, collector, monitor, tick = _drill(tmp_path)
+    tick(15)
+    assert monitor.firing() == []
+    # r1 looks LESS loaded — normally it would win the pick
+    fleet.docs["r0"]["stats"].update(queue_depth=3)
+    router.health_tick()
+    assert router.pick().replica.name == "r1"
+    # the burn: r1's TTFT gauge breaches for long enough
+    fleet.ttft["r1"] = 2.0
+    tick(7)
+    assert ("short_ttft_p95_s", "r1") in monitor.firing()
+    alerts = [json.loads(l)
+              for l in (tmp_path / "alerts.jsonl").read_text().splitlines()]
+    assert alerts[0]["slo_alert"] == "short_ttft_p95_s"
+    assert alerts[0]["state"] == "firing" and alerts[0]["target"] == "r1"
+    # route-around: r0 wins DESPITE heavier load; r1 is not ejected
+    assert router.pick().replica.name == "r0"
+    assert router.state_of("r1")["status"] == "serving"
+    code, out = router.handle_generate({"token_ids": [1]})
+    assert code == 200 and out["served_by"] == "r0"
+    burn_events = [e for e in _events(tmp_path)
+                   if e["deploy_event"] == "slo_burn"]
+    assert burn_events and burn_events[0]["target"] == "r1"
+    # a burning replica is still the LAST resort: with r0 gone it serves
+    fleet.docs["r0"].update(ready=False)
+    router.health_tick()
+    assert router.pick().replica.name == "r1"
+    fleet.docs["r0"].update(ready=True)
+    router.health_tick()
+    # recovery: clean TTFT + debounce -> resolved, mark cleared
+    fleet.ttft["r1"] = 0.01
+    tick(12)
+    assert monitor.firing() == []
+    assert router.pick().replica.name == "r1"  # load-based again
+    clear_events = [e for e in _events(tmp_path)
+                    if e["deploy_event"] == "slo_clear"]
+    assert clear_events and clear_events[0]["target"] == "r1"
+
+
+def test_drill_fleet_burn_defers_canary_until_clear(tmp_path):
+    """Fleet-scope burn (trainer staleness) -> DeployController DEFERS
+    the canary (one canary_deferred event, step not blacklisted) ->
+    burn clears -> the SAME step canaries and promotes."""
+    clock, fleet, router, collector, monitor, tick = _drill(tmp_path)
+    benched = []
+
+    def bench(url, ckpt, step):
+        benched.append(step)
+        return {"canary_eval_loss": 3.0, "ttft_p50_s": 0.05,
+                "client_tokens_per_sec": 100.0, "errors": 0}
+
+    ctl = DeployController(router, str(tmp_path / "ckpt"),
+                           initial_step=2, bench=bench)
+    tick(10)
+    fleet.staleness = 5.0
+    tick(10)
+    assert ("outer_staleness", "trainer") in monitor.firing()
+    assert router.slo_burning()
+    assert ctl.deploy(4) == "canary_deferred"
+    assert ctl.deploy(4) == "canary_deferred"  # retried, not blacklisted
+    assert benched == []  # the canary bench NEVER ran into the incident
+    deferred = [e for e in _events(tmp_path)
+                if e["deploy_event"] == "canary_deferred"]
+    assert len(deferred) == 1 and deferred[0]["step"] == 4  # no spam
+    assert not any(e["deploy_event"] == "canary_start"
+                   for e in _events(tmp_path))
+    # recovery: the gate opens, the same step deploys
+    fleet.staleness = 0.0
+    tick(12)
+    assert not router.slo_burning()
+    assert ctl.deploy(4) == "promote"
+    assert benched  # baseline + candidate benches ran
+
+
+def test_drill_trace_join_and_timeseries_render(tmp_path, capsys):
+    """The merged Perfetto timeline joins the router's route/forward
+    spans with the replica's queued/prefill/decode spans on ONE
+    request_id, and `report timeseries` renders the incident from the
+    collector's series JSONL."""
+    from nanodiloco_tpu.cli import report_timeseries_main
+
+    clock, fleet, router, collector, monitor, tick = _drill(tmp_path)
+    tick(15)
+    fleet.ttft["r1"] = 2.0
+    tick(7)
+    code, out = router.handle_generate(
+        {"token_ids": [1], "request_id": "drill-join-1"}
+    )
+    assert code == 200 and out["request_id"] == "drill-join-1"
+    # the replica's side of the same request (the scheduler's span
+    # machinery, stood in for here by a serve-named tracer shard)
+    serve_tracer = SpanTracer(clock=clock, process_name="nanodiloco serve")
+    serve_tracer.record_span("queued", clock.t - 0.2, clock.t - 0.1,
+                             request_id="drill-join-1", slot=0)
+    serve_tracer.record_span("decode", clock.t - 0.1, clock.t,
+                             request_id="drill-join-1", tokens=1)
+    merged = merge_chrome_traces([
+        router.tracer.to_chrome(), serve_tracer.to_chrome(),
+    ])
+    joined = [e for e in merged["traceEvents"]
+              if e.get("ph") == "X"
+              and (e.get("args") or {}).get("request_id") == "drill-join-1"]
+    assert {e["name"] for e in joined} >= {"route", "forward", "queued",
+                                           "decode"}
+    assert len({e["pid"] for e in joined}) == 2  # both tiers, one key
+    # the incident renders as a sparkline timeline
+    report_timeseries_main([str(tmp_path / "series.jsonl"),
+                            "--key", "ttft"])
+    rendered = capsys.readouterr().out
+    assert "r1:nanodiloco_serve_ttft_p95_seconds" in rendered
+    assert "█" in rendered and "max=2" in rendered
+
+
+def test_fleet_slo_endpoint_over_the_wire(tmp_path):
+    """POST /fleet/slo (the obs-watch action hook's wire form) flips
+    route-around and canary-gate state; bad bodies answer 400."""
+    from nanodiloco_tpu.serve.client import http_get, http_post_json
+
+    clock = FakeClock()
+    fleet = ScriptedFleet()
+    router = FleetRouter(
+        [Replica("r0", "http://r0"), Replica("r1", "http://r1")],
+        probe=fleet.probe, post=fleet.post, clock=clock,
+        sleep=lambda s: clock.advance(s),
+        events_jsonl=str(tmp_path / "deploy.jsonl"), quiet=True,
+        host="127.0.0.1",
+    ).start()
+    try:
+        url = f"http://127.0.0.1:{router.port}"
+        code, out = http_post_json(url + "/fleet/slo", {
+            "rule": "short_ttft_p95_s", "target": "r1",
+            "scope": "replica", "firing": True,
+        })
+        assert code == 200 and out["slo_not_preferred"] == {
+            "r1": ["short_ttft_p95_s"]
+        }
+        assert router.pick().replica.name == "r0"
+        code, out = http_post_json(url + "/fleet/slo", {
+            "rule": "fleet_goodput_fraction", "scope": "fleet",
+            "target": None, "firing": True,
+        })
+        assert code == 200 and router.slo_burning()
+        code, body = http_get(url + "/fleet/status")
+        doc = json.loads(body)
+        assert doc["slo_fleet_burning"] == ["fleet_goodput_fraction"]
+        assert doc["slo_not_preferred"] == {"r1": ["short_ttft_p95_s"]}
+        for bad in ({"rule": "", "firing": True},
+                    {"rule": "x", "firing": "yes"},
+                    {"rule": "x", "firing": True, "scope": "galaxy"},
+                    {"rule": "x", "firing": True, "target": "r9"}):
+            code, _ = http_post_json(url + "/fleet/slo", bad)
+            assert code == 400
+        m = http_get(url + "/metrics")[1]
+        assert "nanodiloco_fleet_slo_burning 1" in m
+        assert 'nanodiloco_fleet_replica_not_preferred{replica="r1"} 1' in m
+    finally:
+        router.stop()
+
+
+def test_obs_watch_cli_over_sockets(tmp_path):
+    """`obs-watch` wired end to end over real sockets: scrape a canned
+    burning /metrics endpoint, fire the alert into the alerts JSONL,
+    persist the series JSONL, finalize the summary."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from nanodiloco_tpu.cli import obs_watch_main
+
+    text = render_exposition([
+        ("nanodiloco_serve_ttft_p95_seconds", "gauge", "p95",
+         [(None, 3.0)]),
+    ])
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        obs_watch_main([
+            "--target", f"r1=http://127.0.0.1:{srv.server_address[1]}",
+            "--interval-s", "0.1", "--duration-s", "2.5",
+            "--fast-window-s", "0.5", "--slow-window-s", "1.0",
+            "--clear-debounce-s", "0.5",
+            "--ttft-p95-max", "0.5",
+            "--alerts-jsonl", str(tmp_path / "alerts.jsonl"),
+            "--series-jsonl", str(tmp_path / "series.jsonl"),
+            "--quiet",
+        ])
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    alerts = [json.loads(l)
+              for l in (tmp_path / "alerts.jsonl").read_text().splitlines()]
+    assert any(r.get("slo_alert") == "short_ttft_p95_s"
+               and r.get("state") == "firing" for r in alerts)
+    assert "slo_summary" in alerts[-1]
+    assert alerts[-1]["slo_summary"]["burn_seconds_total"] > 0
+    s = summarize_run(str(tmp_path / "alerts.jsonl"))
+    assert s["slo_alerts_total"] >= 1 and s["slo_burn_seconds"] > 0
+    from nanodiloco_tpu.obs.collector import read_series_jsonl
+
+    series = read_series_jsonl(str(tmp_path / "series.jsonl"))
+    key = "r1:nanodiloco_serve_ttft_p95_seconds"
+    assert key in series and len(series[key]) >= 5
+
+
+# -- summarize + compare surfaces ---------------------------------------------
+
+
+def _write_jsonl(path, recs):
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_summarize_run_surfaces_slo_keys_and_tolerates_old_jsonls(tmp_path):
+    path = tmp_path / "run.jsonl"
+    _write_jsonl(path, [
+        {"loss": 3.0, "step": 1},
+        {"slo_alert": "short_ttft_p95_s", "state": "firing",
+         "target": "r1", "t_unix": 1.0},
+        {"slo_alert": "short_ttft_p95_s", "state": "resolved",
+         "target": "r1", "burn_s": 7.5, "t_unix": 9.0},
+        {"slo_alert": "error_rate", "state": "firing", "target": "r0",
+         "t_unix": 10.0},
+        {"slo_alert": "error_rate", "state": "resolved", "target": "r0",
+         "burn_s": 2.0, "t_unix": 13.0},
+    ])
+    s = summarize_run(str(path))
+    assert s["slo_alerts_total"] == 2
+    assert s["slo_burn_seconds"] == pytest.approx(9.5)
+    assert s["slo_worst_rule"] == "short_ttft_p95_s"
+    # a final slo_summary record is authoritative when present
+    _write_jsonl(tmp_path / "run2.jsonl", [
+        {"slo_alert": "x", "state": "firing", "t_unix": 1.0},
+        {"slo_summary": {"alerts_total": 3, "burn_seconds_total": 12.25,
+                         "worst_rule": "error_rate"}},
+    ])
+    s2 = summarize_run(str(tmp_path / "run2.jsonl"))
+    assert s2["slo_alerts_total"] == 3
+    assert s2["slo_burn_seconds"] == 12.25
+    assert s2["slo_worst_rule"] == "error_rate"
+    # an OLD jsonl (no SLO records) gains no keys
+    _write_jsonl(tmp_path / "old.jsonl", [{"loss": 3.0, "step": 1}])
+    old = summarize_run(str(tmp_path / "old.jsonl"))
+    assert "slo_alerts_total" not in old
+    assert "slo_burn_seconds" not in old
+
+
+def test_compare_gates_slo_burn_seconds_absolute_both_directions():
+    base = {"final_loss": 3.0, "slo_burn_seconds": 1.0}
+    # a burn increase past the absolute threshold regresses
+    worse = compare_runs(base, {"final_loss": 3.0,
+                                "slo_burn_seconds": 10.0})
+    assert worse["regressions"] == ["slo_burn_seconds"]
+    # within the budget: no regression
+    ok = compare_runs(base, {"final_loss": 3.0, "slo_burn_seconds": 4.0})
+    assert ok["ok"]
+    # the other direction (burn DROPPED) is an improvement, never gated
+    better = compare_runs({"final_loss": 3.0, "slo_burn_seconds": 10.0},
+                          {"final_loss": 3.0, "slo_burn_seconds": 0.0})
+    assert better["ok"]
+    # threshold is configurable
+    tight = compare_runs(base, {"final_loss": 3.0,
+                                "slo_burn_seconds": 3.0},
+                         max_slo_burn_increase_s=1.0)
+    assert tight["regressions"] == ["slo_burn_seconds"]
+    # missing on either side: reported, never gated
+    half = compare_runs(base, {"final_loss": 3.0})
+    assert half["ok"]
+    assert half["metrics"]["slo_burn_seconds"]["gated"] is False
+
+
+def test_report_faults_lists_slo_alerts(tmp_path, capsys):
+    from nanodiloco_tpu.cli import report_faults_main
+
+    path = tmp_path / "run.jsonl"
+    _write_jsonl(path, [
+        {"slo_alert": "short_ttft_p95_s", "state": "firing",
+         "target": "r1", "t_unix": 1.0},
+        {"deploy_event": "canary_deferred", "step": 4, "t_unix": 2.0},
+        {"deploy_event": "slo_clear", "rule": "short_ttft_p95_s",
+         "target": "r1", "t_unix": 3.0},
+    ])
+    report_faults_main([str(path)])
+    out = capsys.readouterr().out
+    assert "slo_alert" in out and "short_ttft_p95_s" in out
+    assert "canary_deferred" in out and "slo_clear" in out
