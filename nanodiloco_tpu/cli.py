@@ -841,6 +841,57 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                         "shards so one Perfetto timeline shows client "
                         "wait vs router hop vs queue vs prefill vs "
                         "decode per request")
+    # predictive autoscaling (fleet/autoscaler.py): an embedded
+    # collector scrapes the replicas, obs/forecast's CapacityModel
+    # turns the series into exhaustion forecasts, and the control loop
+    # launches/retires serve subprocesses through the router's drain
+    # discipline — never from raw point gauges
+    p.add_argument("--autoscale-template", type=str, default=None,
+                   metavar="CMD",
+                   help="enable the predictive autoscaler: a shell "
+                        "command with a {port} placeholder that launches "
+                        "one serve replica, e.g. 'python -m "
+                        "nanodiloco_tpu serve --checkpoint-dir C --port "
+                        "{port}'. Children exiting with code 75 or by "
+                        "SIGTERM are treated as spot preemptions and "
+                        "relaunched immediately")
+    p.add_argument("--autoscale-min", type=int, default=1,
+                   help="fleet size floor (the seed --replica set "
+                        "counts toward it)")
+    p.add_argument("--autoscale-max", type=int, default=4,
+                   help="fleet size ceiling")
+    p.add_argument("--autoscale-interval-s", type=float, default=2.0,
+                   help="observe->decide->act cadence (also the "
+                        "embedded scrape cadence)")
+    p.add_argument("--autoscale-cooldown-s", type=float, default=20.0,
+                   help="minimum seconds between scale actions")
+    p.add_argument("--autoscale-max-step", type=int, default=1,
+                   help="replicas added/removed per action")
+    p.add_argument("--autoscale-hysteresis", type=int, default=2,
+                   help="consecutive agreeing ticks before a scale "
+                        "action (forecast noise must not flap the "
+                        "fleet)")
+    p.add_argument("--autoscale-horizon-s", type=float, default=60.0,
+                   help="scale out when a resource (kv_blocks_free, "
+                        "queue depth vs slots) is forecast to exhaust "
+                        "within this many seconds")
+    p.add_argument("--autoscale-idle-ticks", type=int, default=5,
+                   help="consecutive headroom ticks before scale-in")
+    p.add_argument("--autoscale-window-s", type=float, default=60.0,
+                   help="trend window for the capacity model's slope/"
+                        "exhaustion queries")
+    p.add_argument("--shed-horizon-s", type=float, default=10.0,
+                   help="with the fleet at --autoscale-max, forecasted "
+                        "exhaustion inside this horizon starts class-"
+                        "aware shedding (lowest class first, one class "
+                        "per tick)")
+    p.add_argument("--admission-max-priority", type=int, default=9,
+                   metavar="N",
+                   help="initial admission ceiling: requests with "
+                        "priority > N get a terminal shed 429 "
+                        "({\"shed\": true}); 9 (default) admits every "
+                        "class. The autoscaler moves this under "
+                        "pressure")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -914,6 +965,79 @@ def fleet_main(argv: list[str]) -> None:
             f"deployed_step={controller.deployed_step})",
             flush=True,
         )
+    if args.admission_max_priority != 9:
+        router.set_admission(args.admission_max_priority,
+                             reason="cli --admission-max-priority")
+    scaler_thread = None
+    provider = None
+    if args.autoscale_template:
+        from nanodiloco_tpu.fleet.autoscaler import (
+            Autoscaler,
+            ProcessReplicaProvider,
+        )
+        from nanodiloco_tpu.obs.collector import Collector
+        from nanodiloco_tpu.obs.forecast import CapacityModel
+
+        # the autoscaler never reads raw point gauges: an embedded
+        # collector turns replica /metrics scrapes into time series,
+        # and the capacity model turns those into slopes and
+        # exhaustion forecasts the control loop acts on
+        scrape_targets = [(r.name, r.url) for r in replicas]
+        collector = Collector(
+            scrape_targets, interval_s=args.autoscale_interval_s,
+        )
+        model = CapacityModel(
+            collector.store, window_s=args.autoscale_window_s,
+        )
+        provider = ProcessReplicaProvider(
+            args.autoscale_template, host=args.host,
+        )
+        scaler = Autoscaler(
+            router, model, provider,
+            min_replicas=args.autoscale_min,
+            max_replicas=args.autoscale_max,
+            interval_s=args.autoscale_interval_s,
+            cooldown_s=args.autoscale_cooldown_s,
+            max_step=args.autoscale_max_step,
+            hysteresis_ticks=args.autoscale_hysteresis,
+            scale_out_horizon_s=args.autoscale_horizon_s,
+            scale_in_idle_ticks=args.autoscale_idle_ticks,
+            shed_horizon_s=args.shed_horizon_s,
+        )
+
+        def _autoscale_loop() -> None:
+            while not stop.is_set():
+                # follow elastic membership: scrape exactly the
+                # replicas the router currently tracks
+                targets = []
+                for n in router.replica_names():
+                    try:
+                        targets.append((n, router.url_of(n)))
+                    except KeyError:
+                        continue  # removed between calls
+                if targets:
+                    try:
+                        collector.set_targets(targets)
+                        collector.scrape_once()
+                    except Exception:
+                        pass  # a bad scrape must not kill the loop
+                try:
+                    scaler.tick()
+                except Exception:
+                    pass
+                stop.wait(args.autoscale_interval_s)
+
+        scaler_thread = threading.Thread(
+            target=_autoscale_loop,
+            name="nanodiloco-fleet-autoscale", daemon=True,
+        )
+        scaler_thread.start()
+        print(
+            f"autoscaler on ({args.autoscale_min}..{args.autoscale_max} "
+            f"replicas, horizon {args.autoscale_horizon_s:g}s, "
+            f"shed horizon {args.shed_horizon_s:g}s)",
+            flush=True,
+        )
     for sig in (signal.SIGTERM, signal.SIGINT):
         try:
             signal.signal(sig, lambda *_: stop.set())
@@ -926,6 +1050,10 @@ def fleet_main(argv: list[str]) -> None:
         stop.set()
         if controller_thread is not None:
             controller_thread.join(timeout=10)
+        if scaler_thread is not None:
+            scaler_thread.join(timeout=10)
+        if provider is not None:
+            provider.stop_all()
         router.stop()
         if tracer is not None:
             try:
@@ -983,6 +1111,12 @@ def build_obs_watch_parser() -> argparse.ArgumentParser:
     # rule thresholds (unset = that rule is off)
     p.add_argument("--ttft-p95-max", type=float, default=None, metavar="S",
                    help="TTFT p95 ceiling per replica (seconds)")
+    p.add_argument("--class0-ttft-p95-max", type=float, default=None,
+                   metavar="S",
+                   help="TTFT p95 ceiling for priority class 0 only "
+                        "(seconds) — the SLO that class-aware shedding "
+                        "exists to protect: it must hold even while "
+                        "lower classes are shed with terminal 429s")
     p.add_argument("--decode-tps-min", type=float, default=None,
                    help="decode tokens/s floor per replica")
     p.add_argument("--error-rate-max", type=float, default=None,
@@ -1034,6 +1168,7 @@ def obs_watch_main(argv: list[str]) -> None:
         targets.append((name, url))
     rules = standard_rules(
         ttft_p95_max_s=args.ttft_p95_max,
+        class0_ttft_p95_max_s=args.class0_ttft_p95_max,
         decode_tps_min=args.decode_tps_min,
         error_rate_max=args.error_rate_max,
         kv_blocks_free_min=args.kv_blocks_free_min,

@@ -23,6 +23,11 @@ Three coupled pieces over the serving stack (nanodiloco_tpu/serve):
 the router (+ the controller with ``--watch-checkpoint-dir``).
 """
 
+from nanodiloco_tpu.fleet.autoscaler import (
+    Autoscaler,
+    ProcessReplicaProvider,
+    ReplicaProvider,
+)
 from nanodiloco_tpu.fleet.deploy import (
     DeployController,
     canary_bench,
@@ -32,10 +37,13 @@ from nanodiloco_tpu.fleet.deploy import (
 from nanodiloco_tpu.fleet.router import EVENT_KINDS, FleetRouter, Replica
 
 __all__ = [
+    "Autoscaler",
     "DeployController",
     "EVENT_KINDS",
     "FleetRouter",
+    "ProcessReplicaProvider",
     "Replica",
+    "ReplicaProvider",
     "canary_bench",
     "canary_eval_loss",
     "latest_checkpoint_step",

@@ -31,11 +31,20 @@ discipline, arXiv:2402.15627, applied to serving):
   correctness: the engine's weight-generation machinery makes a swap
   under stragglers safe (they finish on the old weights).
 - **Fleet goodput.** Every replica-second is attributed to a state
-  (serving-ready / serving-unready / draining / ejected), so ONE number
-  says what fraction of wall-clock x replicas was actually available to
-  serve tokens — the goodput ledger's discipline extended across the
-  fleet. Every promote/rollback/eject/drain/swap event lands in the
-  deploy JSONL (``events_jsonl``) read by ``summarize_run`` / ``report``.
+  (``obs.goodput.FLEET_STATE_CAUSES``: serving-ready / serving-unready
+  / draining / ejected / scaling-up / scaling-down), so ONE number says
+  what fraction of the fleet's tracked replica-seconds was actually
+  available to serve tokens — the goodput ledger's discipline extended
+  across the fleet, including the autoscaler's transition seconds.
+  Every promote/rollback/eject/drain/swap event lands in the deploy
+  JSONL (``events_jsonl``) read by ``summarize_run`` / ``report``.
+- **Elastic membership + class-aware admission.** ``add_replica`` /
+  ``remove_replica`` let the autoscaler (``fleet/autoscaler.py``) grow
+  and shrink the fleet through the same drain discipline as a weight
+  push, and ``set_admission`` / ``POST /fleet/admission`` sets the
+  priority ceiling above which requests are SHED with a terminal 429
+  (``"shed": true`` in the body — distinct from a busy 429, which is
+  retried on another replica).
 
 Testability follows the scheduler's discipline: the probe and post
 functions, clock, and sleep are injectable, so every routing and
@@ -54,6 +63,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
 from nanodiloco_tpu.obs import flightrec
+from nanodiloco_tpu.obs.goodput import FLEET_STATE_CAUSES
 from nanodiloco_tpu.obs.telemetry import (
     OPENMETRICS_CONTENT_TYPE,
     render_exposition,
@@ -67,6 +77,11 @@ EVENT_KINDS = (
     "swap_failed", "canary_start", "canary_baseline",
     "canary_baseline_failed", "canary_verdict", "canary_failed",
     "canary_deferred", "slo_burn", "slo_clear",
+    # elastic capacity (fleet/autoscaler.py): membership changes, the
+    # autoscaler's decisions, spot-preemption recoveries, and admission
+    # ceiling moves
+    "replica_added", "replica_removed", "scale_up", "scale_down",
+    "preempt", "preempt_resume", "shed_level",
 )
 
 
@@ -87,19 +102,22 @@ class _ReplicaState:
     per-state wall-clock seconds (the fleet goodput numerator). All
     mutation happens under the router's lock."""
 
-    def __init__(self, replica: Replica, clock: Callable[[], float]) -> None:
+    def __init__(self, replica: Replica, clock: Callable[[], float],
+                 status: str = "serving") -> None:
         self.replica = replica
-        self.status = "serving"        # serving | draining | ejected
+        # serving | draining | ejected | scaling_up | scaling_down —
+        # the latter two are the autoscaler's transition states: a
+        # launched-but-not-yet-ready replica and a retiring one. Their
+        # seconds land in their OWN goodput buckets (FLEET_STATE_CAUSES
+        # is the closed set), never silently folded into unready.
+        self.status = status
         self.ready = False             # last readiness probe
         self.failures = 0              # consecutive unreachable probes
         self.stats: dict = {}          # queue_depth/slots_busy/kv_blocks_free/...
         self.router_inflight = 0       # requests this router has in flight here
         self._clock = clock
         self._since = clock()
-        self.seconds = {
-            "serving_ready": 0.0, "serving_unready": 0.0,
-            "draining": 0.0, "ejected": 0.0,
-        }
+        self.seconds = {cause: 0.0 for cause in FLEET_STATE_CAUSES}
 
     def _bucket(self) -> str:
         if self.status == "serving":
@@ -200,6 +218,18 @@ class FleetRouter:
         self._push_lock = threading.Lock()
         self._events_lock = threading.Lock()
         self._counters: dict[str, int] = {}
+        # class-aware admission: classes ABOVE this ceiling are shed at
+        # the router (terminal 429 with "shed": true) — set by the
+        # autoscaler / POST /fleet/admission under fleet burn or
+        # forecasted exhaustion; 9 admits everything
+        self._admission_max_priority = 9
+        self._shed_by_class: dict[int, int] = {}
+        # goodput seconds of replicas REMOVED from the fleet (scale-in):
+        # retained so the fleet fraction stays every-second-accounted —
+        # a retired replica's serving life must not vanish from the
+        # denominator
+        self._departed_seconds = {cause: 0.0 for cause in FLEET_STATE_CAUSES}
+        self._departed_count = 0
         self._t0 = clock()
         self._stop = threading.Event()
         self._health_thread: threading.Thread | None = None
@@ -253,6 +283,9 @@ class FleetRouter:
                     self._reply_json(code, out)
                 elif path == "/fleet/slo":
                     code, out = router.handle_slo(doc)
+                    self._reply_json(code, out)
+                elif path == "/fleet/admission":
+                    code, out = router.handle_admission(doc)
                     self._reply_json(code, out)
                 else:
                     self._reply(404, b"not found\n", "text/plain")
@@ -347,7 +380,9 @@ class FleetRouter:
     def health_tick(self) -> None:
         """One probe sweep over the non-ejected replicas: refresh
         readiness + load stats, count consecutive failures, eject."""
-        for st in self._states:
+        with self._lock:
+            states = list(self._states)  # membership can change mid-sweep
+        for st in states:
             if st.status == "ejected":
                 continue
             r = self._probe(st.replica)
@@ -357,6 +392,16 @@ class FleetRouter:
                 stats = r.get("stats") or {}
                 if stats:
                     st.stats.update(stats)
+                if st.status == "scaling_up":
+                    # a booting replica is EXPECTED unreachable (process
+                    # start + compile): no failure budget until it has
+                    # joined. First live+ready probe promotes it to a
+                    # routing candidate and closes its scaling_up
+                    # seconds bucket.
+                    if r.get("live") and r.get("ready"):
+                        st.failures = 0
+                        st.set(status="serving", ready=True)
+                    continue
                 if r.get("live"):
                     st.failures = 0
                     # a replica draining ITSELF (a push in progress)
@@ -364,6 +409,8 @@ class FleetRouter:
                     st.set(ready=bool(r.get("ready"))
                            and st.status == "serving")
                     continue
+                if st.status == "scaling_down":
+                    continue  # retiring: unreachable is the expected end
                 if r.get("reachable"):
                     # an explicit /healthz 503: the engine loop DIED.
                     # It never comes back — eject now, don't wait out
@@ -403,6 +450,105 @@ class FleetRouter:
         except (OSError, json.JSONDecodeError, ValueError):
             return {"path": path}
 
+    # -- elastic membership (fleet/autoscaler.py) ----------------------------
+
+    def add_replica(self, replica: Replica, *,
+                    source: str = "autoscaler") -> None:
+        """Join a replica to the fleet in the ``scaling_up`` state: its
+        seconds are booked to the ``scaling_up`` goodput bucket until
+        the health loop sees it live AND ready, at which point it
+        becomes a routing candidate. Boot-time unreachability costs it
+        nothing (the failure budget starts once it has joined)."""
+        with self._lock:
+            if replica.name in self._by_name:
+                raise ValueError(
+                    f"replica {replica.name!r} is already in the fleet"
+                )
+            st = _ReplicaState(replica, self._clock, status="scaling_up")
+            self._states.append(st)
+            self._by_name[replica.name] = st
+        self.log_event("replica_added", replica=replica.name,
+                       url=replica.url, source=source)
+
+    def remove_replica(self, name: str, *, drain: bool = True,
+                       reason: str = "scale_down") -> dict:
+        """Retire a replica: flip it to ``scaling_down`` (unroutable),
+        optionally drain it and wait — bounded — for in-flight streams,
+        then drop it from the fleet. Its per-state seconds are folded
+        into the departed ledger so the fleet goodput fraction keeps
+        accounting for every second it existed."""
+        with self._lock:
+            st = self._by_name.get(name)
+            if st is None:
+                raise ValueError(f"unknown replica {name!r}; replicas "
+                                 f"are {self.replica_names()}")
+            was_ejected = st.status == "ejected"
+            if not was_ejected:
+                st.set(status="scaling_down", ready=False)
+        if drain and not was_ejected:
+            try:
+                self._post(st.replica, "/admin/drain", {}, timeout=30.0)
+                t0 = self._clock()
+                while self._clock() - t0 < self.drain_timeout_s:
+                    r = self._probe(st.replica)
+                    if not r.get("reachable"):
+                        break
+                    if (r.get("stats") or {}).get("in_flight", 0) == 0:
+                        break
+                    self._sleep(0.05)
+            except (OSError, ValueError):
+                pass  # an unreachable retiree is already as drained
+                # as it will ever be
+        with self._lock:
+            st.account()
+            for k, v in st.seconds.items():
+                self._departed_seconds[k] += v
+            self._departed_count += 1
+            self._states.remove(st)
+            del self._by_name[name]
+            seconds = {k: round(v, 6) for k, v in st.seconds.items()}
+        self.log_event("replica_removed", replica=name, reason=reason,
+                       seconds=seconds)
+        return {"replica": name, "seconds": seconds}
+
+    # -- class-aware admission (overload shedding) ---------------------------
+
+    def handle_admission(self, doc: dict) -> tuple[int, dict]:
+        """POST /fleet/admission: ``{"max_priority": N}`` — set the
+        class-shedding ceiling (classes above N get terminal shed
+        429s). The autoscaler's wire form; operators can use it too."""
+        try:
+            mp = self.set_admission(doc.get("max_priority"))
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        return 200, {"max_priority": mp, "shed_by_class": dict(
+            sorted(self._shed_by_class.items()))}
+
+    def set_admission(self, max_priority: int, *,
+                      reason: str | None = None) -> int:
+        """Set the admission ceiling; an actual change logs a
+        ``shed_level`` event (the honest record of when the fleet
+        started/stopped sacrificing which classes)."""
+        if not isinstance(max_priority, int) or isinstance(
+                max_priority, bool) or not -1 <= max_priority <= 9:
+            raise ValueError(
+                f"max_priority must be an integer in [-1, 9]; got "
+                f"{max_priority!r}"
+            )
+        with self._lock:
+            changed = self._admission_max_priority != max_priority
+            self._admission_max_priority = max_priority
+        if changed:
+            self.log_event(
+                "shed_level", max_priority=max_priority,
+                **({"reason": reason} if reason else {}),
+            )
+        return max_priority
+
+    def admission_max_priority(self) -> int:
+        with self._lock:
+            return self._admission_max_priority
+
     # -- routing -------------------------------------------------------------
 
     def pick(self) -> _ReplicaState | None:
@@ -441,6 +587,31 @@ class FleetRouter:
                 rid = f"rtr-{self._req_seq}"
         doc = {**doc, "request_id": rid}
         t_route = self._clock()
+        # class-aware shedding at the front door: a request whose class
+        # is above the admission ceiling never touches a replica — the
+        # 429 says so explicitly ("shed": true + the class), because it
+        # is fleet POLICY, not one replica's backpressure, and the
+        # client must not retry it anywhere
+        prio = doc.get("priority", 1)
+        if not isinstance(prio, int) or isinstance(prio, bool):
+            prio = 1  # malformed: let the replica's 400 handle it
+        with self._lock:
+            ceiling = self._admission_max_priority
+            if prio > ceiling:
+                self._shed_by_class[prio] = (
+                    self._shed_by_class.get(prio, 0) + 1
+                )
+        if prio > ceiling:
+            self._span("route", t_route, self._clock(), rid,
+                       outcome="shed", shed_class=prio)
+            return 429, {
+                "error": f"priority class {prio} is shed under overload "
+                         f"(admitting classes 0..{ceiling})",
+                "shed": True,
+                "shed_class": prio,
+                "max_priority": ceiling,
+                "request_id": rid,
+            }
         tried: set[str] = set()
         last_429: tuple[int, dict] | None = None
         for attempt in range(2):
@@ -487,10 +658,28 @@ class FleetRouter:
                     st.set(ready=False)
                 continue
             if code == 429:
-                # queue full HERE, not fleet-wide: try another replica;
-                # if every candidate is saturated, the client gets the
-                # honest 429 (backpressure), never a fake 503 — with
-                # the join key, so the overload is traceable
+                if isinstance(out, dict) and out.get("shed"):
+                    # a class-SHED 429 is terminal: the replica refused
+                    # this class as policy, and every other replica
+                    # enforces the same ceiling — retrying would
+                    # pointlessly hammer the fleet with traffic it is
+                    # deliberately sacrificing. Propagated verbatim
+                    # (shed class and ceiling in the body).
+                    with self._lock:
+                        sc = out.get("shed_class")
+                        sc = sc if isinstance(sc, int) else prio
+                        self._shed_by_class[sc] = (
+                            self._shed_by_class.get(sc, 0) + 1
+                        )
+                    self._span("route", t_route, self._clock(), rid,
+                               outcome="shed", replica=name)
+                    return 429, {**out, "replica": name,
+                                 "request_id": rid}
+                # busy 429: queue full HERE, not fleet-wide — try
+                # another replica; if every candidate is saturated, the
+                # client gets the honest 429 (backpressure), never a
+                # fake 503 — with the join key, so the overload is
+                # traceable
                 last_429 = (code, {**out, "replica": name,
                                    "request_id": rid}
                             if isinstance(out, dict) else out)
@@ -777,16 +966,26 @@ class FleetRouter:
     def fleet_stats(self) -> dict:
         """The fleet snapshot: readiness counts, per-replica deploy
         generations, event counters, and the fleet goodput fraction —
-        replica-seconds spent serving-AND-ready over wall-clock x
-        replicas (what fraction of the fleet's theoretical capacity was
-        actually available; drains, ejections, and dead time all show
-        up as the gap to 1.0)."""
+        replica-seconds spent serving-AND-ready over EVERY tracked
+        replica-second (what fraction of the fleet's capacity was
+        actually available; drains, ejections, scale transitions, and
+        dead time all show up as the gap to 1.0). The denominator is
+        the sum of all state buckets, live AND departed: for a static
+        fleet that equals wall-clock x replicas exactly, and for an
+        autoscaled fleet it keeps every second accounted — a replica
+        that existed for 10s contributes 10s, not the router's whole
+        elapsed time, and a retired replica's life never vanishes."""
         with self._lock:
             for st in self._states:
                 st.account()
             elapsed = max(0.0, self._clock() - self._t0)
             n = len(self._states)
-            ready_s = sum(st.seconds["serving_ready"] for st in self._states)
+            by_state = dict(self._departed_seconds)
+            for st in self._states:
+                for k, v in st.seconds.items():
+                    by_state[k] += v
+            total_s = sum(by_state.values())
+            ready_s = by_state["serving_ready"]
             out = {
                 "replicas_total": n,
                 "replicas_ready": sum(
@@ -799,6 +998,11 @@ class FleetRouter:
                 "replicas_ejected": sum(
                     1 for st in self._states if st.status == "ejected"
                 ),
+                "replicas_scaling_up": sum(
+                    1 for st in self._states
+                    if st.status == "scaling_up"
+                ),
+                "replicas_departed": self._departed_count,
                 "deploy_generations": {
                     st.replica.name: st.stats.get("deploy_generation")
                     for st in self._states
@@ -812,10 +1016,19 @@ class FleetRouter:
                     }
                     for st in self._states
                 },
+                # the fleet-total partition by state (departed replicas
+                # included): every scale-up/scale-down second is an
+                # explicit line item here, never dropped
+                "seconds_by_state": {
+                    k: round(v, 6) for k, v in by_state.items()
+                },
                 "fleet_goodput_fraction": (
-                    round(ready_s / (elapsed * n), 6)
-                    if elapsed > 0 and n else None
+                    round(ready_s / total_s, 6) if total_s > 0 else None
                 ),
+                "admission_max_priority": self._admission_max_priority,
+                "shed_by_class": {
+                    c: v for c, v in sorted(self._shed_by_class.items())
+                },
                 **self._slo_state_locked(),
             }
         return out
@@ -861,10 +1074,33 @@ class FleetRouter:
         if s["fleet_goodput_fraction"] is not None:
             families.append((
                 "nanodiloco_fleet_goodput_fraction", "gauge",
-                "replica-seconds serving-and-ready / (wall-clock x "
-                "replicas) — the fleet's every-second-accounted "
+                "replica-seconds serving-and-ready over all tracked "
+                "replica-seconds — the fleet's every-second-accounted "
                 "availability number",
                 [(None, s["fleet_goodput_fraction"])],
+            ))
+        families.append((
+            "nanodiloco_fleet_state_seconds", "gauge",
+            "replica-seconds by state (departed replicas included) — "
+            "scale_up/scale_down transition time is an explicit line "
+            "item, never dropped",
+            [({"state": k}, v)
+             for k, v in sorted(s["seconds_by_state"].items())],
+        ))
+        families.append((
+            "nanodiloco_fleet_admission_max_priority", "gauge",
+            "highest priority class the fleet currently admits (9 = "
+            "all; lower = class-aware overload shedding active)",
+            [(None, s["admission_max_priority"])],
+        ))
+        if s["shed_by_class"]:
+            families.append((
+                "nanodiloco_fleet_shed", "counter",
+                "requests shed by class-aware admission control, by "
+                "priority class (terminal 429s, never retried)",
+                [({"priority": str(c)}, v)
+                 for c, v in sorted(s["shed_by_class"].items())]
+                + [(None, sum(s["shed_by_class"].values()))],
             ))
         families.append((
             "nanodiloco_fleet_slo_burning", "gauge",

@@ -26,8 +26,13 @@ from nanodiloco_tpu.obs.collector import (
     parse_exposition,
 )
 from nanodiloco_tpu.obs.flightrec import FlightRecorder
+from nanodiloco_tpu.obs.forecast import CapacityEstimate, CapacityModel
 from nanodiloco_tpu.obs.goodput import CAUSES as GOODPUT_CAUSES
-from nanodiloco_tpu.obs.goodput import GoodputLedger, stitch_goodput_records
+from nanodiloco_tpu.obs.goodput import (
+    FLEET_STATE_CAUSES,
+    GoodputLedger,
+    stitch_goodput_records,
+)
 from nanodiloco_tpu.obs.tracer import (
     SpanTracer,
     current_tracer,
@@ -47,7 +52,10 @@ from nanodiloco_tpu.obs.telemetry import (
 )
 
 __all__ = [
+    "CapacityEstimate",
+    "CapacityModel",
     "Collector",
+    "FLEET_STATE_CAUSES",
     "SeriesStore",
     "flatten_families",
     "parse_exposition",

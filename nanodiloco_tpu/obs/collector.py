@@ -25,8 +25,11 @@ collection half, stdlib only, in the repo's own dialect:
   not grow without bound) with the query surface the SLO engine needs:
   windowed samples, windowed mean/max/min, counter ``increase``/
   ``rate`` (positive deltas only, so a process restart reads as a
-  reset, not a negative rate), and nearest-rank percentiles over a
-  window.
+  reset, not a negative rate), nearest-rank percentiles over a
+  window, and the FORECASTING queries the autoscaler acts on
+  (``fleet/autoscaler.py``): ``slope`` (Theil-Sen robust trend,
+  counter-reset tolerant) and ``forecast_exhaustion`` (seconds until
+  a series crosses a floor/ceiling at the current trend).
 - ``Collector`` — the scrape loop over named targets. Clock, wall
   clock, sleep, and the HTTP fetch are all injectable (tests script a
   fleet with a fake clock and no sockets; the default fetch is the
@@ -407,6 +410,86 @@ class SeriesStore:
         inc = self.increase(key, window_s, now)
         return None if inc is None else inc / elapsed
 
+    # Theil-Sen is O(n^2) in pair count; windows are resampled down to
+    # this many points first (evenly strided, newest kept) so a maxed-out
+    # ring buffer cannot turn one autoscaler tick into ~2M pair slopes
+    _SLOPE_MAX_POINTS = 48
+
+    def slope(self, key: str, window_s: float, now: float,
+              *, counter: bool = False) -> float | None:
+        """Robust per-second trend over the window: the Theil-Sen
+        estimator (median of all pairwise slopes), so one garbage sample
+        — a scrape racing a restart, a transient spike — cannot swing
+        the estimate the way least-squares would, and the autoscaler
+        never acts on a phantom trend.
+
+        With ``counter=True`` the samples are first folded into a
+        monotone cumulative series using the same positive-deltas-only
+        rule as ``increase()``: a process restart (counter drops toward
+        0) reads as a reset, not a cliff of negative slope. None with
+        fewer than two samples or no elapsed time."""
+        samples = self.window(key, now - window_s, now)
+        if len(samples) < 2:
+            return None
+        if samples[-1][0] - samples[0][0] <= 0:
+            return None
+        if counter:
+            folded: list[tuple[float, float]] = [(samples[0][0], 0.0)]
+            cum = 0.0
+            for (_, a), (t, b) in zip(samples, samples[1:]):
+                if b > a:
+                    cum += b - a
+                folded.append((t, cum))
+            samples = folded
+        if len(samples) > self._SLOPE_MAX_POINTS:
+            stride = len(samples) / self._SLOPE_MAX_POINTS
+            samples = [
+                samples[min(len(samples) - 1, int(i * stride))]
+                for i in range(self._SLOPE_MAX_POINTS - 1)
+            ] + [samples[-1]]
+        slopes: list[float] = []
+        for i in range(len(samples)):
+            t0, v0 = samples[i]
+            for t1, v1 in samples[i + 1:]:
+                if t1 > t0:
+                    slopes.append((v1 - v0) / (t1 - t0))
+        if not slopes:
+            return None
+        slopes.sort()
+        mid = len(slopes) // 2
+        if len(slopes) % 2:
+            return slopes[mid]
+        return (slopes[mid - 1] + slopes[mid]) / 2.0
+
+    def forecast_exhaustion(self, key: str, bound: float, window_s: float,
+                            now: float, *,
+                            kind: str = "floor") -> float | None:
+        """Seconds until the series crosses ``bound`` at its current
+        ``slope()`` — the question "when does ``kv_blocks_free`` hit 0"
+        or "when does queue depth hit slot capacity", asked of the
+        trend rather than the point gauge. ``kind="floor"`` forecasts a
+        falling series crossing down through the bound; ``"ceiling"`` a
+        rising series crossing up. Returns 0.0 when the latest sample
+        is already past the bound, None when the series is trending
+        away from it (or has no usable trend)."""
+        if kind not in ("floor", "ceiling"):
+            raise ValueError(f"kind must be 'floor' or 'ceiling'; got "
+                             f"{kind!r}")
+        last = self.latest(key)
+        if last is None:
+            return None
+        _, v = last
+        if kind == "floor" and v <= bound:
+            return 0.0
+        if kind == "ceiling" and v >= bound:
+            return 0.0
+        s = self.slope(key, window_s, now)
+        if s is None:
+            return None
+        if kind == "floor":
+            return (bound - v) / s if s < 0 else None
+        return (bound - v) / s if s > 0 else None
+
     def snapshot(self) -> dict[str, list[tuple[float, float]]]:
         with self._lock:
             return {k: list(dq) for k, dq in self._series.items()}
@@ -471,6 +554,18 @@ class Collector:
 
     def key(self, target: str, sample: str) -> str:
         return f"{target}:{sample}"
+
+    def set_targets(self, targets: list[tuple[str, str]]) -> None:
+        """Replace the target set (the fleet autoscaler follows elastic
+        membership with this: launched replicas start being scraped,
+        retired ones stop). Series already collected for a departed
+        target stay in the store — history must survive the replica."""
+        if not targets:
+            raise ValueError("a collector needs at least one target")
+        names = [n for n, _ in targets]
+        if len(set(names)) != len(names):
+            raise ValueError(f"target names must be unique; got {names}")
+        self.targets = [(str(n), str(u).rstrip("/")) for n, u in targets]
 
     def scrape_once(self) -> dict[str, Any]:
         """One sweep over every target: fetch, parse, store. Returns

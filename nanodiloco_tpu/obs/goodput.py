@@ -60,6 +60,23 @@ CAUSES = (
     "other",              # startup/logging/unattributed residual
 )
 
+#: The closed cause set for FLEET goodput (the serving twin of
+#: ``CAUSES``): every replica-second the fleet router tracks lands in
+#: exactly one of these buckets (``fleet/router.py`` imports this tuple
+#: as its bucket names — one source of truth, so the autoscaler cannot
+#: invent a state the accounting silently drops). ``serving_ready`` is
+#: the only goodput bucket; ``scaling_up``/``scaling_down`` book the
+#: autoscaler's transition seconds explicitly (MegaScale's every-
+#: second-accounted discipline extended to elastic capacity).
+FLEET_STATE_CAUSES = (
+    "serving_ready",      # probed ready: usable serving capacity
+    "serving_unready",    # alive but failing probes (compile, overload)
+    "draining",           # admission stopped for a weight push
+    "ejected",            # ejected after repeated probe failures
+    "scaling_up",         # launched by the autoscaler, not yet ready
+    "scaling_down",       # retiring: drain -> remove in progress
+)
+
 #: tracer depth-0 span name -> cause. ``t_``-prefixed JSONL keys map
 #: through the same table (``observe_phases`` strips the prefix).
 PHASE_CAUSE = {

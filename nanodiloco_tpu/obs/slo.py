@@ -85,6 +85,10 @@ class SLORule:
 
 # series keys as the serve/router /metrics endpoints expose them
 TTFT_P95_KEY = "nanodiloco_serve_ttft_p95_seconds"
+# the protected class's latency under class-aware shedding: the gauge
+# the fleet-wide p95 cannot substitute for (it mixes the protected
+# class with the best-effort classes being sacrificed)
+CLASS0_TTFT_P95_KEY = 'nanodiloco_serve_class_ttft_p95_seconds{priority="0"}'
 DECODE_TPS_KEY = "nanodiloco_serve_decode_tokens_per_sec"
 KV_FREE_KEY = "nanodiloco_kv_blocks_free"
 FLEET_GOODPUT_KEY = "nanodiloco_fleet_goodput_fraction"
@@ -96,6 +100,7 @@ REQUESTS_TOTAL_KEY = "nanodiloco_serve_requests_total"
 def standard_rules(
     *,
     ttft_p95_max_s: float | None = None,
+    class0_ttft_p95_max_s: float | None = None,
     decode_tps_min: float | None = None,
     error_rate_max: float | None = None,
     kv_blocks_free_min: float | None = None,
@@ -117,6 +122,12 @@ def standard_rules(
     if ttft_p95_max_s is not None:
         rules.append(SLORule("short_ttft_p95_s", TTFT_P95_KEY,
                              ttft_p95_max_s, "ceiling", "replica", **win))
+    if class0_ttft_p95_max_s is not None:
+        # the class-aware shedding contract: while lower classes shed,
+        # THIS rule is the one that must stay quiet
+        rules.append(SLORule("class0_ttft_p95_s", CLASS0_TTFT_P95_KEY,
+                             class0_ttft_p95_max_s, "ceiling", "replica",
+                             **win))
     if decode_tps_min is not None:
         rules.append(SLORule("decode_tokens_per_sec", DECODE_TPS_KEY,
                              decode_tps_min, "floor", "replica", **win))

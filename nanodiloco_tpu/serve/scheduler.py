@@ -83,6 +83,25 @@ class QueueFull(RuntimeError):
     distinguishes slot-bound from HBM-bound saturation."""
 
 
+class ClassShed(QueueFull):
+    """Raised by ``submit`` when the request's priority class is above
+    the current admission ceiling (``set_admission_max_priority``) —
+    overload shedding, NOT backpressure. The distinction matters on the
+    wire: a busy 429 means "this replica, right now" and the fleet
+    router retries another replica; a shed 429 means "this CLASS, fleet
+    policy" and retrying elsewhere would pointlessly hammer every
+    replica — the server marks it ``"shed": true`` so the router
+    propagates it terminally."""
+
+    def __init__(self, shed_class: int, max_priority: int) -> None:
+        super().__init__(
+            f"priority class {shed_class} is shed under overload "
+            f"(admitting classes 0..{max_priority})"
+        )
+        self.shed_class = int(shed_class)
+        self.max_priority = int(max_priority)
+
+
 @dataclasses.dataclass(frozen=True)
 class GenRequest:
     """One generation request. ``deadline_s`` is a RELATIVE budget from
@@ -266,6 +285,14 @@ class Scheduler:
         self._expired = 0
         self._cancelled = 0
         self._errors = 0
+        # class-aware overload shedding: requests whose priority is
+        # ABOVE this ceiling are refused at submit (ClassShed -> a
+        # terminal 429) so the highest classes' SLO holds while load
+        # exceeds capacity. 9 admits every class (the priority range is
+        # 0..9); the fleet router / autoscaler lowers it under
+        # forecasted exhaustion via /admin/admission.
+        self._admission_max_priority = 9
+        self._shed_by_priority: dict[int, int] = {}
         # admission-stall accounting: ticks on which the next queued
         # request could not be admitted, split by WHY — every slot
         # occupied ("no_slot") vs the backend's KV block pool unable to
@@ -278,6 +305,11 @@ class Scheduler:
         self._decode_s = 0.0
         self._prefill_chunks = 0   # chunks run (counter)
         self._ttft: collections.deque[float] = collections.deque(maxlen=512)
+        # per-class TTFT windows: the gauge the highest class's SLO rule
+        # alerts on — the fleet-wide TTFT p95 is meaningless under
+        # class-aware shedding (it mixes the protected class with the
+        # best-effort one being sacrificed)
+        self._ttft_by_priority: dict[int, collections.deque] = {}
         # real distributions for the scrape (cumulative-bucket
         # histograms; the deque above remains for last/p50/p95 gauges):
         # TTFT submit->first-token, slot wait submit->admit (overall AND
@@ -293,6 +325,12 @@ class Scheduler:
     def submit(self, request: GenRequest) -> Ticket:
         now = self._clock()
         with self._lock:
+            if request.priority > self._admission_max_priority:
+                self._shed_by_priority[request.priority] = (
+                    self._shed_by_priority.get(request.priority, 0) + 1
+                )
+                raise ClassShed(request.priority,
+                                self._admission_max_priority)
             if len(self._queue) >= self.max_queue:
                 self._rejected += 1
                 raise QueueFull(
@@ -323,6 +361,26 @@ class Scheduler:
     @property
     def draining(self) -> bool:
         return self._draining
+
+    @property
+    def admission_max_priority(self) -> int:
+        return self._admission_max_priority
+
+    def set_admission_max_priority(self, max_priority: int) -> int:
+        """Set the class-shedding ceiling: requests with ``priority >
+        max_priority`` are refused with ``ClassShed`` (a terminal 429)
+        until the ceiling is raised again. 9 admits everything; 0 sheds
+        all but the most urgent class; -1 (the floor) sheds even class
+        0 — a full admission stop that, unlike ``drain``, answers with
+        an honest shed body instead of flipping readiness."""
+        if not isinstance(max_priority, int) or isinstance(
+                max_priority, bool) or not -1 <= max_priority <= 9:
+            raise ValueError(
+                f"max_priority must be an integer in [-1, 9]; got "
+                f"{max_priority!r}"
+            )
+        self._admission_max_priority = max_priority
+        return max_priority
 
     def in_flight(self) -> int:
         """Slots holding a request (prefilling or decoding) — what a
@@ -523,6 +581,11 @@ class Scheduler:
                            chunks=run.chunks_run)
                 with self._lock:  # stats() sorts this deque from HTTP threads
                     self._ttft.append(t_first - run.submitted_at)
+                    dq = self._ttft_by_priority.setdefault(
+                        int(run.request.priority),
+                        collections.deque(maxlen=256),
+                    )
+                    dq.append(t_first - run.submitted_at)
                 self._tokens_out += 1
                 live = _Running(run.ticket, run.request, run.submitted_at,
                                 run.deadline_at, run.admitted_at, t_first,
@@ -743,6 +806,10 @@ class Scheduler:
             depth = len(self._queue)
             ttft_snapshot = list(self._ttft)  # tick appends under the lock
             prio_hists = dict(self.hist_queue_wait_by_priority)
+            ttft_by_prio = {
+                p: list(dq) for p, dq in self._ttft_by_priority.items()
+            }
+            shed_by_prio = dict(self._shed_by_priority)
         ttft = sorted(ttft_snapshot)
 
         def pct(p: float) -> float | None:
@@ -775,6 +842,18 @@ class Scheduler:
                 "expired": self._expired,
                 "cancelled": self._cancelled,
                 "error": self._errors,
+                # class-shed refusals are their OWN outcome, not folded
+                # into "rejected": busy-rejections are capacity noise,
+                # sheds are deliberate policy — an SLO error-rate rule
+                # must be able to tell them apart
+                "shed": sum(shed_by_prio.values()),
+            },
+            # class-aware overload shedding state: the ceiling and the
+            # per-class shed counts (the honest 429 story — which
+            # classes are being sacrificed, how often)
+            "admission_max_priority": self._admission_max_priority,
+            "shed_by_priority": {
+                p: n for p, n in sorted(shed_by_prio.items())
             },
             # admission stalls split by cause: slots exhausted vs the
             # paged backend's KV block pool exhausted — the 429/backlog
@@ -794,6 +873,14 @@ class Scheduler:
             "ttft_last_s": ttft_snapshot[-1] if ttft_snapshot else None,
             "ttft_p50_s": pct(0.50),
             "ttft_p95_s": pct(0.95),
+            # per-class TTFT p95 (last 256 admissions of each class):
+            # what the highest class's SLO rule watches while lower
+            # classes shed
+            "ttft_p95_by_priority": {
+                p: nearest_rank_percentile(sorted(vals), 0.95)
+                for p, vals in sorted(ttft_by_prio.items())
+                if vals
+            },
             # full distributions (cumulative-bucket form) for the
             # histogram families on /metrics
             "hist_ttft": self.hist_ttft.snapshot(),

@@ -65,7 +65,12 @@ from nanodiloco_tpu.obs.telemetry import (
     handle_profile_request,
     render_exposition,
 )
-from nanodiloco_tpu.serve.scheduler import GenRequest, QueueFull, Scheduler
+from nanodiloco_tpu.serve.scheduler import (
+    ClassShed,
+    GenRequest,
+    QueueFull,
+    Scheduler,
+)
 
 
 class ServeServer:
@@ -163,7 +168,8 @@ class ServeServer:
                     )
                     self._reply_json(code, out)
                     return
-                if path in ("/admin/drain", "/admin/resume", "/admin/swap"):
+                if path in ("/admin/drain", "/admin/resume", "/admin/swap",
+                            "/admin/admission"):
                     try:
                         n = int(self.headers.get("Content-Length", 0))
                         doc = json.loads(self.rfile.read(n) or b"{}")
@@ -273,6 +279,18 @@ class ServeServer:
             return 400, {"error": str(e)}
         try:
             ticket = self._scheduler.submit(request)
+        except ClassShed as e:
+            # overload SHED, not backpressure: the body says so
+            # explicitly ("shed": true + the sacrificed class) because
+            # the two 429s demand opposite client behavior — a busy 429
+            # is retried on another replica by the fleet router, a shed
+            # 429 is fleet policy and terminal
+            return 429, {
+                "error": str(e),
+                "shed": True,
+                "shed_class": e.shed_class,
+                "max_priority": e.max_priority,
+            }
         except QueueFull as e:
             return 429, {"error": str(e)}
         deadline = request.deadline_s
@@ -405,6 +423,17 @@ class ServeServer:
         if path == "/admin/resume":
             sched.resume()
             return 200, {"draining": False}
+        if path == "/admin/admission":
+            # class-aware shedding ceiling (fleet router / autoscaler):
+            # {"max_priority": N} — classes above N are refused with the
+            # shed 429 until raised again
+            mp = doc.get("max_priority")
+            try:
+                return 200, {
+                    "max_priority": sched.set_admission_max_priority(mp)
+                }
+            except (ValueError, AttributeError) as e:
+                return 400, {"error": str(e)}
         # /admin/swap
         if self._swap_loader is None:
             return 404, {
@@ -688,5 +717,37 @@ class ServeServer:
                 "slot wait split by SLO priority class (0 = most urgent)",
                 [({"priority": str(p)}, snap)
                  for p, snap in by_prio.items()],
+            ))
+        # class-aware overload shedding: the admission ceiling, the
+        # per-class shed counts, and the per-class TTFT p95 — together
+        # the honest story of WHO is being sacrificed under overload
+        # and whether the protected class's latency actually held
+        if s.get("admission_max_priority") is not None:
+            families.append((
+                "nanodiloco_serve_admission_max_priority", "gauge",
+                "highest priority class currently admitted (9 = all; "
+                "lower = overload shedding active)",
+                [(None, s["admission_max_priority"])],
+            ))
+        shed = s.get("shed_by_priority") or {}
+        if shed:
+            families.append((
+                "nanodiloco_serve_shed", "counter",
+                "requests refused by class-aware overload shedding, by "
+                "priority class",
+                [({"priority": str(p)}, n)
+                 for p, n in sorted(shed.items())]
+                + [(None, sum(shed.values()))],
+            ))
+        ttft_by_prio = s.get("ttft_p95_by_priority") or {}
+        if ttft_by_prio:
+            families.append((
+                "nanodiloco_serve_class_ttft_p95_seconds", "gauge",
+                "p95 TTFT split by SLO priority class (0 = most urgent "
+                "— the class whose SLO must hold while lower classes "
+                "shed)",
+                [({"priority": str(p)}, v)
+                 for p, v in sorted(ttft_by_prio.items())
+                 if v is not None],
             ))
         return render_exposition(families)

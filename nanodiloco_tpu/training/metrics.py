@@ -566,9 +566,20 @@ _COMPARE_METRICS = [
     # only when both summaries carry it.
     ("canary_eval_loss", True),
     # fleet goodput (fleet/router.py): replica-seconds serving-and-
-    # ready over wall-clock x replicas — a share like comm_share
+    # ready over all tracked replica-seconds — a share like comm_share
     # (ABSOLUTE threshold), higher is better (a drop is the regression).
     ("fleet_goodput_fraction", False),
+    # autoscale surge workload (serve_bench --workload surge): the
+    # protected class's TTFT p95 while lower classes shed (latency
+    # class/threshold — it must hold under overload), and the total
+    # sheds the surge provoked. Sheds gate BOTH WAYS on a wide relative
+    # band (_SHED_KEYS): a surge candidate shedding far MORE means
+    # overload handling regressed, shedding far LESS (or zero) means
+    # admission control stopped firing and every class collapsed
+    # together — both are failures of the same contract. Gated only
+    # when both summaries carry them.
+    ("class0_ttft_p95_s", True),
+    ("shed_total", True),
     # goodput fraction (obs/goodput ledger, stitched across restarts):
     # a share of wall-clock like comm_share, so it gates on an ABSOLUTE
     # move past max_comm_share_increase — but HIGHER is better (a drop
@@ -592,7 +603,13 @@ _SHARE_KEYS = {"comm_share_last", "outer_sync_share_sync",
 
 # serve latency keys (seconds, lower better) that use the dedicated
 # latency threshold instead of the loss one
-_LATENCY_KEYS = {"ttft_p50_s", "ttft_p95_s", "short_ttft_p95_s"}
+_LATENCY_KEYS = {"ttft_p50_s", "ttft_p95_s", "short_ttft_p95_s",
+                 "class0_ttft_p95_s"}
+
+# shed counters regress in BOTH directions (see the _COMPARE_METRICS
+# note): |delta| beyond the latency band (relative, floored at 1 so a
+# near-zero baseline doesn't gate on a single extra shed)
+_SHED_KEYS = {"shed_total"}
 
 # SLO burn keys (seconds, absolute threshold, share-class semantics —
 # regress on an absolute move past max_slo_burn_increase_s in the key's
@@ -666,6 +683,8 @@ def compare_runs(
                 delta > max_slo_burn_increase_s if lower_better
                 else -delta > max_slo_burn_increase_s
             )
+        elif key in _SHED_KEYS:
+            regressed = abs(delta) > max_latency_increase * max(abs(b), 1.0)
         elif key in _LATENCY_KEYS:
             regressed = delta > max_latency_increase * max(abs(b), 1e-12)
         elif lower_better:

@@ -2560,6 +2560,403 @@ def phase_slo_watch() -> None:
     })
 
 
+def phase_autoscale_surge() -> None:
+    """Predictive-autoscaling drill on this backend: train a tiny
+    checkpoint, boot a 2-replica `serve` fleet behind the `fleet` CLI
+    with ``--autoscale-template`` armed (embedded collector ->
+    CapacityModel -> Autoscaler) plus `obs-watch` holding a class-0
+    TTFT SLO rule, then drive a mixed-class open-loop traffic ramp past
+    the seed fleet's capacity. The drill asserts the CLOSED loop over
+    real processes: the queue-trend exhaustion forecast triggers a
+    scale-out (2 -> up to 4 serve subprocesses) BEFORE any SLO alert
+    fires, one autoscaled child is SIGTERM'd mid-surge (the spot
+    reclaim signal) and relaunched via a preempt_resume event, the
+    fleet drains back to 2 after the ramp with hysteresis (no flapping:
+    event counts stay flat through a quiet window), and every scale-
+    transition second is booked (the scaling_up bucket of
+    ``nanodiloco_fleet_state_seconds`` is nonzero). On CPU this pins
+    the control loop's ordering and accounting; what the forecast
+    horizon should be under real load belongs to the chip sitting
+    (PERF.md)."""
+    import signal as _signal
+    import socket
+    import tempfile
+    import threading
+
+    from nanodiloco_tpu.obs.telemetry import parse_metrics_text
+    from nanodiloco_tpu.serve.client import http_get, http_post_json
+
+    live = chip_is_live()
+    tmp = tempfile.mkdtemp(prefix="nanodiloco-autoscale-")
+    ckpt = os.path.join(tmp, "ckpt")
+    deploy_jsonl = os.path.join(tmp, "deploy.jsonl")
+    alerts_jsonl = os.path.join(tmp, "alerts.jsonl")
+    model_cfg = os.path.join(tmp, "model.json")
+    with open(model_cfg, "w") as f:
+        json.dump({
+            "vocab_size": 2048, "hidden_size": 128, "intermediate_size": 256,
+            "num_attention_heads": 4, "num_hidden_layers": 2,
+            "max_position_embeddings": 256,
+        }, f)
+    budget = float(
+        os.environ.get("NANODILOCO_AGENDA_TIMEOUT_AUTOSCALE_SURGE", "1800")
+    )
+    train = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu",
+         "--total-steps", "2", "--inner-steps", "2",
+         "--batch-size", "8", "--per-device-batch-size", "4",
+         "--seq-length", "256", "--warmup-steps", "2",
+         "--llama-config-file", model_cfg, "--no-measure-comm",
+         "--no-cost-analysis", "--quiet",
+         "--checkpoint-dir", ckpt, "--log-dir", tmp,
+         "--run-name", "autoscale-probe"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=budget * 0.25,
+    )
+    if train.returncode != 0:
+        record({"phase": "autoscale_surge",
+                "error": (train.stderr or train.stdout)[-400:]})
+        raise SystemExit(1)
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    # slots=1 keeps each replica's capacity small enough that the CPU
+    # ramp below genuinely overloads the 2-replica seed fleet (the
+    # forecast can only act on pressure that exists)
+    serve_flags = ["--checkpoint-dir", ckpt, "--host", "127.0.0.1",
+                   "--slots", "1", "--max-len", "128", "--chunk-size", "16",
+                   "--max-new-tokens-cap", "64"]
+    ports = {n: free_port() for n in ("r0", "r1", "router", "watch")}
+    procs: dict = {}
+    for name in ("r0", "r1"):
+        procs[name] = subprocess.Popen(
+            [sys.executable, "-m", "nanodiloco_tpu", "serve",
+             "--port", str(ports[name])] + serve_flags,
+            cwd=REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+    seed_pids = {procs["r0"].pid, procs["r1"].pid}
+
+    def stop(proc):
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(_signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def events():
+        if not os.path.exists(deploy_jsonl):
+            return []
+        out = []
+        with open(deploy_jsonl) as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+        return out
+
+    def wait_event(kind, deadline, **match):
+        while time.time() < deadline:
+            for e in events():
+                if e.get("deploy_event") == kind and all(
+                    e.get(k) == v for k, v in match.items()
+                ):
+                    return e
+            time.sleep(0.3)
+        return None
+
+    def autoscaled_serve_pids():
+        """Serve children the autoscaler launched: processes running
+        this checkpoint's serve command that are NOT the seed
+        replicas — the preemption-injection surface."""
+        pids = set()
+        for d in os.listdir("/proc"):
+            if not d.isdigit() or int(d) in seed_pids:
+                continue
+            try:
+                with open(f"/proc/{d}/cmdline", "rb") as f:
+                    argv = f.read().decode(errors="replace").split("\0")
+            except OSError:
+                continue
+            if "serve" in argv and ckpt in argv:
+                pids.add(int(d))
+        return pids
+
+    try:
+        deadline = time.time() + budget * 0.25
+        for name in ("r0", "r1"):
+            up = False
+            while time.time() < deadline and procs[name].poll() is None:
+                try:
+                    up = http_get(
+                        f"http://127.0.0.1:{ports[name]}/healthz",
+                        timeout=3,
+                    )[0] == 200
+                except OSError:
+                    up = False
+                if up:
+                    break
+                time.sleep(0.3)
+            if not up:
+                record({"phase": "autoscale_surge",
+                        "error": f"replica {name} never answered /healthz"})
+                raise SystemExit(1)
+        # warm both replicas so compile spikes stay out of the surge
+        # window (and out of the class-0 TTFT gauge the SLO rule reads)
+        warm_doc = {"token_ids": [(i * 7 + 3) % 256 for i in range(12)],
+                    "max_new_tokens": 4, "temperature": 0.0,
+                    "stop": False, "prefix_cache": False, "priority": 0}
+        for name in ("r0", "r1"):
+            code, _ = http_post_json(
+                f"http://127.0.0.1:{ports[name]}/v1/generate", warm_doc,
+                timeout=180,
+            )
+            if code != 200:
+                record({"phase": "autoscale_surge",
+                        "error": f"{name} warmup failed {code}"})
+                raise SystemExit(1)
+        template = " ".join(
+            [sys.executable, "-m", "nanodiloco_tpu", "serve",
+             "--port", "{port}"] + serve_flags
+        )
+        procs["router"] = subprocess.Popen(
+            [sys.executable, "-m", "nanodiloco_tpu", "fleet",
+             "--replica", f"http://127.0.0.1:{ports['r0']}",
+             "--replica", f"http://127.0.0.1:{ports['r1']}",
+             "--port", str(ports["router"]), "--host", "127.0.0.1",
+             "--events-jsonl", deploy_jsonl,
+             "--health-interval-s", "0.3", "--drain-timeout-s", "15",
+             "--autoscale-template", template,
+             "--autoscale-min", "2", "--autoscale-max", "4",
+             "--autoscale-interval-s", "0.5",
+             "--autoscale-cooldown-s", "2",
+             "--autoscale-hysteresis", "2",
+             "--autoscale-horizon-s", "30",
+             "--autoscale-idle-ticks", "4",
+             "--autoscale-window-s", "20",
+             "--shed-horizon-s", "8", "--quiet"],
+            cwd=REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        url = f"http://127.0.0.1:{ports['router']}"
+        deadline = time.time() + budget * 0.2
+        router_up = False
+        while time.time() < deadline and procs["router"].poll() is None:
+            try:
+                http_get(url + "/healthz", timeout=3)
+                router_up = True
+                break
+            except OSError:
+                time.sleep(0.3)
+        if not router_up:
+            record({"phase": "autoscale_surge",
+                    "error": "router never opened its socket"})
+            raise SystemExit(1)
+        # the SLO watcher holds the class-0 TTFT rule the shed ladder
+        # protects; the threshold is generous on purpose — the drill's
+        # ordering claim is "capacity arrives BEFORE the SLO burns"
+        procs["watch"] = subprocess.Popen(
+            [sys.executable, "-m", "nanodiloco_tpu", "obs-watch",
+             "--target", f"r0=http://127.0.0.1:{ports['r0']}",
+             "--target", f"r1=http://127.0.0.1:{ports['r1']}",
+             "--port", str(ports["watch"]), "--host", "127.0.0.1",
+             "--interval-s", "0.5",
+             "--class0-ttft-p95-max", "30",
+             "--fast-window-s", "2", "--slow-window-s", "5",
+             "--alerts-jsonl", alerts_jsonl],
+            cwd=REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        # mixed-class open-loop ramp: arrivals fire on schedule no
+        # matter what's in flight (a closed loop would self-throttle
+        # away from the overload the forecast must see)
+        results: list = []
+        lock = threading.Lock()
+
+        def fire(i, prio):
+            try:
+                code, out = http_post_json(
+                    url + "/v1/generate",
+                    {"token_ids": [(i * 11 + 5) % 256 for _ in range(32)],
+                     "max_new_tokens": 48, "temperature": 0.0,
+                     "seed": i, "stop": False, "prefix_cache": False,
+                     "priority": prio},
+                    timeout=300,
+                )
+            except OSError as e:
+                code, out = -1, {"error": str(e)}
+            with lock:
+                results.append((code, prio,
+                                out.get("shed") if isinstance(out, dict)
+                                else None))
+
+        workers = []
+        surge_t0 = time.time()
+        i = 0
+        preempted_pid = None
+        preempt_event = None
+        scale_up = None
+        surge_deadline = surge_t0 + budget * 0.25
+        # keep firing until a scale-out lands AND a preemption has been
+        # injected + recovered (or the per-stage deadline passes)
+        while time.time() < surge_deadline:
+            prio = 0 if i % 2 == 0 else 3
+            w = threading.Thread(target=fire, args=(i, prio))
+            w.start()
+            workers.append(w)
+            i += 1
+            # ~40 req/s of ~60-80ms requests vs 2 replicas x 1 slot:
+            # a real >1.3x overload, so queue depth crosses slots_total
+            # and the exhaustion forecast has something to see
+            time.sleep(0.025)
+            if scale_up is None:
+                for e in events():
+                    if e.get("deploy_event") == "scale_up":
+                        scale_up = e
+                        break
+                continue
+            if preempted_pid is None:
+                auto = autoscaled_serve_pids()
+                if auto:
+                    preempted_pid = sorted(auto)[0]
+                    os.kill(preempted_pid, _signal.SIGTERM)
+                continue
+            if preempt_event is None:
+                for e in events():
+                    if e.get("deploy_event") == "preempt_resume":
+                        preempt_event = e
+                        break
+                continue
+            break  # scale-out seen, preemption injected and recovered
+        for w in workers:
+            w.join()
+        if scale_up is None:
+            tail = "\n".join(json.dumps(e) for e in events()[-8:])
+            record({"phase": "autoscale_surge",
+                    "error": f"no scale_up event under the ramp; "
+                             f"tail:\n{tail}",
+                    "requests_fired": i})
+            raise SystemExit(1)
+        if preempt_event is None:
+            tail = "\n".join(json.dumps(e) for e in events()[-8:])
+            record({"phase": "autoscale_surge",
+                    "error": f"preempted child was never relaunched "
+                             f"(pid={preempted_pid}); tail:\n{tail}"})
+            raise SystemExit(1)
+        # scale-in: with the ramp over, sustained headroom must drain
+        # the fleet back to the 2-replica floor through the router
+        scale_down = wait_event("scale_down", time.time() + budget * 0.25)
+        if scale_down is None:
+            tail = "\n".join(json.dumps(e) for e in events()[-8:])
+            record({"phase": "autoscale_surge",
+                    "error": f"no scale_down after the ramp; tail:\n{tail}"})
+            raise SystemExit(1)
+        deadline = time.time() + budget * 0.25
+        m = {}
+        while time.time() < deadline:
+            try:
+                m = parse_metrics_text(
+                    http_get(url + "/metrics", timeout=5)[1]
+                )
+            except OSError:
+                time.sleep(0.5)
+                continue
+            if m.get("nanodiloco_fleet_replicas_serving") == 2:
+                break
+            time.sleep(0.5)
+        if m.get("nanodiloco_fleet_replicas_serving") != 2:
+            record({"phase": "autoscale_surge",
+                    "error": "fleet never drained back to the floor",
+                    "metrics": {k: v for k, v in m.items()
+                                if "replicas" in k}})
+            raise SystemExit(1)
+        # no flapping: through a quiet window the event ledger stays
+        # flat (hysteresis + cooldown must hold the floor, not oscillate)
+        def scale_counts():
+            c = {"scale_up": 0, "scale_down": 0, "preempt_resume": 0}
+            for e in events():
+                k = e.get("deploy_event")
+                if k in c:
+                    c[k] += 1
+            return c
+
+        before = scale_counts()
+        time.sleep(6)
+        after = scale_counts()
+        if before != after:
+            record({"phase": "autoscale_surge",
+                    "error": "fleet is flapping after the ramp",
+                    "before": before, "after": after})
+            raise SystemExit(1)
+        # every scale-transition second booked: the scaling_up bucket
+        # (boot time of autoscaled replicas) must be nonzero
+        scaling_up_s = m.get(
+            'nanodiloco_fleet_state_seconds{state="scaling_up"}'
+        )
+        if not scaling_up_s:
+            record({"phase": "autoscale_surge",
+                    "error": "no scaling_up seconds booked",
+                    "metrics": {k: v for k, v in m.items()
+                                if "state_seconds" in k}})
+            raise SystemExit(1)
+        # ordering: capacity arrived BEFORE the class-0 SLO ever burned
+        first_alert_t = None
+        if os.path.exists(alerts_jsonl):
+            with open(alerts_jsonl) as f:
+                for line in f:
+                    try:
+                        a = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if (a.get("slo_alert") and a.get("state") == "firing"
+                            and first_alert_t is None):
+                        first_alert_t = a.get("t_unix")
+        if first_alert_t is not None and first_alert_t <= scale_up["t_unix"]:
+            record({"phase": "autoscale_surge",
+                    "error": "SLO alert fired before the scale-out — "
+                             "the forecast did not act ahead of the burn",
+                    "alert_t": first_alert_t,
+                    "scale_up_t": scale_up["t_unix"]})
+            raise SystemExit(1)
+        ok = sum(1 for c, _, _ in results if c == 200)
+        shed = sum(1 for c, _, s in results if c == 429 and s)
+        class0_shed = sum(1 for c, p, s in results
+                          if c == 429 and s and p == 0)
+        if class0_shed:
+            record({"phase": "autoscale_surge",
+                    "error": f"class 0 was shed {class0_shed} time(s) — "
+                             "the protected class must always admit"})
+            raise SystemExit(1)
+    finally:
+        for name in ("watch", "router", "r1", "r0"):
+            stop(procs.get(name))
+        # the router's provider SIGTERMs its autoscaled children on
+        # shutdown; anything still around is a leak — kill, don't leak
+        for pid in autoscaled_serve_pids():
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except OSError:
+                pass
+    record({
+        "phase": "autoscale_surge",
+        "backend_live": live,
+        "requests_fired": i,
+        "requests_ok": ok,
+        "requests_shed": shed,
+        "scale_up_reason": scale_up.get("reason"),
+        "preempted_pid": preempted_pid,
+        "preempt_resumed_replica": preempt_event.get("replica"),
+        "scale_events": after,
+        "scaling_up_seconds": scaling_up_s,
+        "first_alert_t": first_alert_t,
+        "scale_up_t": scale_up["t_unix"],
+    })
+
+
 PHASES = {
     "bench": phase_bench,
     "sweep": phase_sweep,
@@ -2578,6 +2975,7 @@ PHASES = {
     "tp_decode": phase_tp_decode,
     "fleet": phase_fleet,
     "slo_watch": phase_slo_watch,
+    "autoscale_surge": phase_autoscale_surge,
 }
 
 
@@ -2627,6 +3025,7 @@ PHASE_TIMEOUT_S = {
     "tp_decode": 1200,
     "fleet": 1800,
     "slo_watch": 1500,
+    "autoscale_surge": 1800,
 }
 
 

@@ -39,6 +39,22 @@ Workloads:
   with chunked prefill it must stay bounded — and the prefix-cache
   counters show the shared prefix being computed once, not per request.
 
+- ``surge``: the traffic-surge / predictive-autoscaling scenario the
+  observability plane's ACTION loop exists for. An in-process fleet
+  (``FleetRouter`` over real-socket replicas) starts at
+  ``--surge-initial-replicas`` while an embedded collector +
+  ``CapacityModel`` + ``Autoscaler`` watch it; mixed-class open-loop
+  traffic (priority 0 and ``--surge-low-priority``) ramps past one
+  replica's capacity, the queue-depth trend forecasts slot exhaustion,
+  and the autoscaler must scale out BEFORE the surge peaks, shed the
+  low class (terminal ``{"shed": true}`` 429s) if the fleet hits
+  ``--surge-max-replicas`` while still pressed, then drain back down
+  after the ramp. Gated keys: ``fleet_goodput_fraction`` (every
+  replica-second accounted, scale transitions included),
+  ``shed_total`` (BOTH directions: far more sheds = overload handling
+  regressed, none = admission control broke), and ``class0_ttft_p95_s``
+  (the SLO shedding exists to protect).
+
 - ``repetitive``: the speculative-decoding sweep. Four legs on the same
   build: templated GREEDY prompts (pattern x reps + unique tail — the
   few-shot/templated shape where prompt-lookup speculation shines,
@@ -96,7 +112,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "content-free)")
     p.add_argument("--step", type=int, default=None)
     p.add_argument("--workload",
-                   choices=("uniform", "mixed", "capacity", "repetitive"),
+                   choices=("uniform", "mixed", "capacity", "repetitive",
+                            "surge"),
                    default="uniform",
                    help="uniform: every client cycles --prompt-lens; "
                         "mixed: long-prompt interference + shared-prefix "
@@ -106,7 +123,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "greedy traffic where prompt-lookup shines AND "
                         "an adversarial random-token leg where it "
                         "cannot, each measured spec-on vs spec-off on "
-                        "the same build (see module docstring)")
+                        "the same build (see module docstring); surge: "
+                        "mixed-class open-loop ramp against an "
+                        "autoscaled in-process fleet — forecast-driven "
+                        "scale-out, class-aware shedding, scale-in "
+                        "after the ramp")
     p.add_argument("--slots", type=int, default=4)
     p.add_argument("--max-len", type=int, default=256)
     p.add_argument("--max-queue", type=int, default=256)
@@ -180,6 +201,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--capacity-decode-ticks", type=int, default=12,
                    help="[capacity] timed decode ticks per mode (after "
                         "one warmup tick)")
+    # the surge workload's fleet + traffic shape
+    p.add_argument("--surge-initial-replicas", type=int, default=1,
+                   help="[surge] replicas at start (and the autoscaler "
+                        "floor it drains back to)")
+    p.add_argument("--surge-max-replicas", type=int, default=2,
+                   help="[surge] autoscaler ceiling; shedding only "
+                        "starts once the fleet is pinned here")
+    p.add_argument("--surge-low-priority", type=int, default=3,
+                   help="[surge] the sheddable class interleaved with "
+                        "class-0 traffic (must be > 0)")
+    p.add_argument("--surge-phase-requests", type=str, default="8,80,8",
+                   help="[surge] arrivals per phase: base,peak,cooldown")
+    p.add_argument("--surge-base-interval-s", type=float, default=0.5,
+                   help="[surge] open-loop arrival spacing in the base "
+                        "and cooldown phases")
+    p.add_argument("--surge-peak-interval-s", type=float, default=0.04,
+                   help="[surge] arrival spacing during the surge — "
+                        "must exceed one replica's capacity (the "
+                        "committed CPU baseline runs --slots 2 "
+                        "--max-new-tokens 48 so the tiny model "
+                        "actually saturates)")
     # speculative decoding (any workload; the repetitive workload's
     # spec-on legs use these, its spec-off legs force 0)
     p.add_argument("--spec-k", type=int, default=None,
@@ -560,6 +602,268 @@ def run_repetitive(args, cfg, params, jax) -> None:
     print(json.dumps(rec), flush=True)
 
 
+class _InProcessProvider:
+    """A ReplicaProvider whose replicas are in-process ``ServeServer``s
+    sharing the bench's params — the surge workload's provider (the CLI
+    and the chip drill use real subprocesses via
+    ``ProcessReplicaProvider``; a bench must not pay a fresh Python +
+    jax import per scale-out). ``make_server`` builds, WARMS (compiles
+    outside the traffic window), and starts one server."""
+
+    def __init__(self, make_server) -> None:
+        self._make = make_server
+        self._servers: dict = {}
+        self._seq = 0
+
+    def launch(self):
+        from nanodiloco_tpu.fleet import Replica
+
+        self._seq += 1
+        name = f"auto{self._seq}"
+        srv = self._make()
+        self._servers[name] = srv
+        return Replica(name=name, url=f"http://127.0.0.1:{srv.port}")
+
+    def retire(self, name: str) -> None:
+        srv = self._servers.pop(name, None)
+        if srv is not None:
+            srv.stop()
+
+    def preempted(self) -> list:
+        return []  # in-process replicas cannot be reclaimed
+
+    def stop_all(self) -> None:
+        for name in list(self._servers):
+            self.retire(name)
+
+
+def run_surge(args, cfg, params, jax) -> None:
+    """The closed observe->forecast->act loop under a traffic surge:
+    open-loop mixed-class arrivals ramp past one replica's capacity, the
+    capacity model forecasts queue/slot exhaustion from the collector's
+    series (never point gauges), the autoscaler grows the fleet through
+    the router's scaling_up discipline, sheds the low class once pinned
+    at max, and drains back down after the ramp — one ``BENCH_SERVE``
+    record whose gated keys are ``fleet_goodput_fraction``,
+    ``shed_total``, and ``class0_ttft_p95_s``."""
+    from nanodiloco_tpu.fleet import FleetRouter, Replica
+    from nanodiloco_tpu.fleet.autoscaler import Autoscaler
+    from nanodiloco_tpu.obs.collector import Collector
+    from nanodiloco_tpu.obs.forecast import CapacityModel
+    from nanodiloco_tpu.serve import (
+        InferenceEngine,
+        Scheduler,
+        ServeServer,
+        http_post_json,
+    )
+
+    if args.surge_low_priority < 1:
+        raise SystemExit("--surge-low-priority must be >= 1 (class 0 is "
+                         "the protected class)")
+    lens = [int(x) for x in args.prompt_lens.split(",") if x]
+    phase_counts = [int(x) for x in args.surge_phase_requests.split(",")]
+    if len(phase_counts) != 3:
+        raise SystemExit("--surge-phase-requests must be base,peak,cooldown")
+
+    def make_server() -> ServeServer:
+        engine = InferenceEngine(
+            params, cfg, num_slots=args.slots,
+            max_len=min(args.max_len, cfg.max_position_embeddings),
+            chunk_size=args.chunk_size,
+            prefix_cache_tokens=args.prefix_cache_tokens,
+            kv_block_size=args.kv_block_size, kv_dtype=args.kv_dtype,
+            kv_pool_blocks=args.kv_pool_blocks, tp=args.tp,
+        )
+        srv = ServeServer(
+            Scheduler(engine, max_queue=args.max_queue),
+            port=0, host="127.0.0.1",
+            max_new_tokens_cap=args.max_new_tokens,
+        ).start()
+        # compile every prefill bucket + the decode tick BEFORE the
+        # replica joins the router: a mid-surge scale-out must add
+        # capacity, not a compile stall that poisons class-0 TTFT
+        for n, p_len in enumerate(sorted(set(lens))):
+            code, out = http_post_json(
+                f"http://127.0.0.1:{srv.port}/v1/generate",
+                {"token_ids": [(i * 7 + 3) % cfg.vocab_size
+                               for i in range(p_len)],
+                 "max_new_tokens": 2, "temperature": args.temperature,
+                 "top_k": args.top_k, "seed": 10_000 + n, "stop": False,
+                 "prefix_cache": False},
+            )
+            if code != 200:
+                srv.stop()
+                raise SystemExit(
+                    f"surge warmup (prompt_len={p_len}) failed with "
+                    f"{code}: {out.get('error')}"
+                )
+        return srv
+
+    provider = _InProcessProvider(make_server)
+    seed_servers = [make_server()
+                    for _ in range(args.surge_initial_replicas)]
+    replicas = [Replica(name=f"r{i}", url=f"http://127.0.0.1:{s.port}")
+                for i, s in enumerate(seed_servers)]
+    router = FleetRouter(
+        replicas, port=0, host="127.0.0.1",
+        health_interval_s=0.2, quiet=True,
+    ).start()
+    collector = Collector([(r.name, r.url) for r in replicas],
+                          interval_s=0.25)
+    model = CapacityModel(collector.store, window_s=20.0,
+                          min_horizon_s=1.5)
+    scaler = Autoscaler(
+        router, model, provider,
+        min_replicas=args.surge_initial_replicas,
+        max_replicas=args.surge_max_replicas,
+        interval_s=0.25, cooldown_s=3.0, max_step=1,
+        hysteresis_ticks=2, scale_out_horizon_s=30.0,
+        scale_in_idle_ticks=6, shed_horizon_s=20.0,
+    )
+    stop = threading.Event()
+
+    def control_loop() -> None:
+        while not stop.is_set():
+            targets = []
+            for n in router.replica_names():
+                try:
+                    targets.append((n, router.url_of(n)))
+                except KeyError:
+                    continue  # removed between calls
+            try:
+                if targets:
+                    collector.set_targets(targets)
+                    collector.scrape_once()
+                scaler.tick()
+            except Exception:
+                pass  # one bad pass must not kill the loop
+            stop.wait(scaler.interval_s)
+
+    ctrl = threading.Thread(target=control_loop, daemon=True,
+                            name="surge-autoscale")
+    ctrl.start()
+
+    results: list[dict] = []
+    shed: list[dict] = []
+    errors: list[tuple[int, dict]] = []
+    lock = threading.Lock()
+    rng = __import__("random").Random(args.seed)
+
+    def fire(i: int, prio: int) -> None:
+        p_len = lens[i % len(lens)]
+        code, out = http_post_json(
+            f"http://127.0.0.1:{router.port}/v1/generate",
+            {"token_ids": [rng.randrange(cfg.vocab_size)
+                           for _ in range(p_len)],
+             "max_new_tokens": args.max_new_tokens,
+             "temperature": args.temperature, "top_k": args.top_k,
+             "seed": i, "stop": False, "priority": prio},
+            timeout=120.0,
+        )
+        with lock:
+            if code == 200:
+                out["_priority"] = prio
+                results.append(out)
+            elif code == 429 and isinstance(out, dict) and out.get("shed"):
+                shed.append(out)
+            else:
+                errors.append((code, out))
+
+    # open-loop arrivals (a closed loop would self-throttle away from
+    # the very overload being measured), class 0 and the low class
+    # interleaved so both see every phase
+    workers: list[threading.Thread] = []
+    t0 = time.monotonic()
+    i = 0
+    for count, interval in zip(
+        phase_counts,
+        (args.surge_base_interval_s, args.surge_peak_interval_s,
+         args.surge_base_interval_s),
+    ):
+        phase_start = time.monotonic()
+        for k in range(count):
+            due = phase_start + k * interval
+            delay = due - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            prio = 0 if i % 2 == 0 else args.surge_low_priority
+            w = threading.Thread(target=fire, args=(i, prio))
+            w.start()
+            workers.append(w)
+            i += 1
+    for w in workers:
+        w.join()
+    traffic_wall = time.monotonic() - t0
+
+    # let the loop scale back in (drain discipline + idle-tick
+    # hysteresis) before the books close — bounded, not open-ended
+    settle_deadline = time.monotonic() + 30.0
+    while time.monotonic() < settle_deadline:
+        s = router.fleet_stats()
+        if (s["replicas_serving"] <= args.surge_initial_replicas
+                and s["replicas_scaling_up"] == 0):
+            break
+        time.sleep(0.25)
+    stop.set()
+    ctrl.join(timeout=10)
+    fleet = router.fleet_stats()
+    router.stop()
+    provider.stop_all()
+    for s in seed_servers:
+        s.stop()
+
+    def ttfts(prio=None):
+        return sorted(
+            r["timing"]["ttft_s"] for r in results
+            if prio is None or r["_priority"] == prio
+        )
+
+    class0, low = ttfts(0), ttfts(args.surge_low_priority)
+    events = fleet.get("events", {})
+    shed_by_class = fleet.get("shed_by_class", {})
+    rec = {
+        "metric": "BENCH_SERVE",
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "model": f"random-init llama (hidden {cfg.hidden_size} x "
+                 f"{cfg.num_hidden_layers}L, vocab {cfg.vocab_size})",
+        "workload": "surge",
+        "tp_degree": args.tp,
+        "slots": args.slots,
+        "surge_initial_replicas": args.surge_initial_replicas,
+        "surge_max_replicas": args.surge_max_replicas,
+        "surge_low_priority": args.surge_low_priority,
+        "surge_phase_requests": phase_counts,
+        "max_new_tokens": args.max_new_tokens,
+        "traffic_wall_s": round(traffic_wall, 3),
+        "requests": len(results),
+        "rejected_or_failed": len(errors),
+        # the gated surge contract: capacity availability with every
+        # scale-transition second accounted, the admission-control
+        # evidence (both directions), and the protected class's latency
+        "fleet_goodput_fraction": fleet.get("fleet_goodput_fraction"),
+        "shed_total": sum(shed_by_class.values()) if shed_by_class
+                      else len(shed),
+        "class0_ttft_p95_s": (
+            round(_pct(class0, 0.95), 4) if class0 else None
+        ),
+        "class0_requests": len(class0),
+        "low_class_ttft_p95_s": (
+            round(_pct(low, 0.95), 4) if low else None
+        ),
+        "shed_by_class": shed_by_class,
+        "shed_responses_seen": len(shed),
+        "scale_up_events": events.get("scale_up", 0),
+        "scale_down_events": events.get("scale_down", 0),
+        "preempt_resume_events": events.get("preempt_resume", 0),
+        "seconds_by_state": fleet.get("seconds_by_state"),
+        "replicas_departed": fleet.get("replicas_departed"),
+    }
+    print(f"# surge fleet: {json.dumps(fleet.get('seconds_by_state'))} "
+          f"events={json.dumps(events)}", file=sys.stderr, flush=True)
+    print(json.dumps(rec), flush=True)
+
+
 def main() -> None:
     args = build_parser().parse_args()
     if args.force_cpu_devices:
@@ -594,6 +898,9 @@ def main() -> None:
 
     if args.workload == "capacity":
         run_capacity(args, cfg, params, jax)
+        return
+    if args.workload == "surge":
+        run_surge(args, cfg, params, jax)
         return
     if args.workload == "repetitive":
         if args.spec_k is None:

@@ -1,0 +1,297 @@
+"""Autoscaler control-loop tests (nanodiloco_tpu/fleet/autoscaler).
+
+Every decision path of ``Autoscaler.tick()`` — hysteresis votes,
+cooldown, step/size clamps, drain-first scale-in, reflexive preemption
+recovery, the class-shed escalation ladder, the below-min refill — is
+driven with a scripted router, provider, capacity model, and clock.
+
+Tier-1 budget: host-only; no sockets, no subprocesses, no jax, no new
+compiled programs. The real FleetRouter is never started.
+"""
+
+import pytest
+
+from nanodiloco_tpu.fleet.autoscaler import Autoscaler
+from nanodiloco_tpu.fleet.router import Replica
+from nanodiloco_tpu.obs.forecast import CapacityEstimate
+
+
+def est(*, kv_eta=None, q_eta=None, slope=0.0, confident=True):
+    return CapacityEstimate(
+        at=0.0, replicas=2, queue_depth=1.0, queue_slope=slope,
+        request_rate=1.0, kv_blocks_free=100.0, kv_exhaustion_s=kv_eta,
+        queue_exhaustion_s=q_eta, horizon_s=10.0, confident=confident,
+    )
+
+
+PRESSURE = est(kv_eta=5.0, slope=2.0)     # kv exhausts in 5s
+HEADROOM = est(slope=-0.5)                # nothing exhausting, queue falling
+NEUTRAL = est(slope=1.0)                  # rising queue but no forecast: hold
+
+
+class FakeRouter:
+    def __init__(self, serving=1):
+        self.serving = [f"r{i}" for i in range(serving)]
+        self.events = []
+        self.removed = []
+        self.admission = 9
+        self.burning = False
+
+    def fleet_stats(self):
+        return {"replicas_serving": len(self.serving),
+                "replicas_scaling_up": 0}
+
+    def add_replica(self, replica, source=None):
+        self.serving.append(replica.name)
+
+    def remove_replica(self, name, drain=True, reason=None):
+        if name not in self.serving:
+            raise ValueError(name)
+        self.serving.remove(name)
+        self.removed.append((name, drain, reason))
+
+    def replica_names(self):
+        return list(self.serving)
+
+    def state_of(self, name):
+        return {"status": "serving"}
+
+    def log_event(self, kind, replica=None, reason=None):
+        self.events.append((kind, replica, reason))
+
+    def admission_max_priority(self):
+        return self.admission
+
+    def set_admission(self, n, reason=None):
+        self.admission = n
+        return n
+
+    def slo_burning(self):
+        return self.burning
+
+
+class FakeProvider:
+    def __init__(self):
+        self.seq = 0
+        self.retired = []
+        self.preempt_queue = []
+
+    def launch(self):
+        self.seq += 1
+        return Replica(name=f"auto{self.seq}", url="http://test")
+
+    def retire(self, name):
+        self.retired.append(name)
+
+    def preempted(self):
+        out, self.preempt_queue = self.preempt_queue, []
+        return out
+
+
+class FakeModel:
+    def __init__(self, estimate=NEUTRAL):
+        self.current = estimate
+
+    def estimate(self, now):
+        return self.current
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make(serving=1, estimate=NEUTRAL, **kw):
+    router, provider, model = FakeRouter(serving), FakeProvider(), FakeModel(estimate)
+    clock = Clock()
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("hysteresis_ticks", 2)
+    kw.setdefault("scale_out_horizon_s", 30.0)
+    kw.setdefault("scale_in_idle_ticks", 3)
+    scaler = Autoscaler(router, model, provider, clock=clock, **kw)
+    return scaler, router, provider, model, clock
+
+
+def test_scale_out_waits_for_hysteresis():
+    """One alarming forecast is noise; hysteresis_ticks agreeing ones
+    are a trend. The launch is booked through the router (add_replica +
+    a scale_up event carrying the forecast as its reason)."""
+    scaler, router, provider, model, clock = make(serving=1,
+                                                 estimate=PRESSURE)
+    assert "scaled_up" not in scaler.tick()
+    rec = scaler.tick()
+    assert rec["scaled_up"] == ["auto1"]
+    assert router.serving == ["r0", "auto1"]
+    kind, name, reason = router.events[-1]
+    assert kind == "scale_up" and name == "auto1"
+    assert "kv_blocks_free" in reason and "5.0s" in reason
+
+
+def test_cooldown_blocks_back_to_back_scaling():
+    scaler, router, provider, model, clock = make(serving=1,
+                                                 estimate=PRESSURE,
+                                                 cooldown_s=10.0)
+    scaler.tick()
+    clock.t = 1.0
+    assert "scaled_up" in scaler.tick()
+    # pressure persists but the fleet just moved: wait out the cooldown
+    for clock.t in (3.0, 5.0, 9.0):
+        assert "scaled_up" not in scaler.tick()
+    # the streak kept voting through the cooldown, so the action fires
+    # on the first tick past it
+    clock.t = 12.0
+    assert "scaled_up" in scaler.tick()
+    assert len(router.serving) == 3
+
+
+def test_step_and_ceiling_clamp_the_launch():
+    """max_step bounds one action; max_replicas bounds the fleet — a
+    3-replica fleet with max 4 and step 2 adds exactly one."""
+    scaler, router, provider, model, clock = make(serving=3,
+                                                 estimate=PRESSURE,
+                                                 max_step=2)
+    scaler.tick()
+    clock.t = 1.0
+    assert scaler.tick()["scaled_up"] == ["auto1"]
+    clock.t = 20.0
+    scaler.tick()
+    clock.t = 21.0
+    rec = scaler.tick()  # at max: pressure can no longer grow the fleet
+    assert "scaled_up" not in rec and len(router.serving) == 4
+
+
+def test_unconfident_forecast_never_scales():
+    """The phantom-scale guard: a just-booted replica's two-sample
+    slope (confident=False) must not move the fleet, ever."""
+    scaler, router, provider, model, clock = make(
+        serving=1, estimate=est(kv_eta=1.0, confident=False))
+    for clock.t in (0.0, 1.0, 2.0, 3.0, 4.0):
+        rec = scaler.tick()
+        assert "scaled_up" not in rec and "scaled_down" not in rec
+    assert router.serving == ["r0"]
+
+
+def test_disagreement_resets_the_vote_streak():
+    scaler, router, provider, model, clock = make(serving=1,
+                                                 estimate=PRESSURE)
+    scaler.tick()                 # out-vote 1
+    model.current = NEUTRAL
+    clock.t = 1.0
+    scaler.tick()                 # neither: streak resets
+    model.current = PRESSURE
+    clock.t = 2.0
+    assert "scaled_up" not in scaler.tick()  # out-vote 1 again
+    clock.t = 3.0
+    assert "scaled_up" in scaler.tick()
+
+
+def test_scale_in_drains_newest_first_and_respects_min():
+    """Sustained headroom retires the newest autoscaled replica through
+    the router's drain path (in-flight streams finish first); the floor
+    is min_replicas, after which votes change nothing."""
+    scaler, router, provider, model, clock = make(
+        serving=3, estimate=HEADROOM, min_replicas=2,
+        scale_in_idle_ticks=3, cooldown_s=2.0)
+    for clock.t in (0.0, 1.0):
+        assert "scaled_down" not in scaler.tick()
+    clock.t = 2.0
+    rec = scaler.tick()
+    assert rec["scaled_down"] == ["r2"]
+    assert router.removed == [("r2", True, "scale_down")]
+    assert provider.retired == ["r2"]
+    assert ("scale_down", "r2", "sustained headroom") in router.events
+    # at the floor now: more idle ticks never go below min_replicas
+    for clock.t in (6.0, 7.0, 8.0, 9.0, 10.0, 11.0):
+        assert "scaled_down" not in scaler.tick()
+    assert len(router.serving) == 2
+
+
+def test_preemption_recovery_ignores_cooldown():
+    """A reclaimed machine is lost capacity NOW: the relaunch happens
+    inside the cooldown a regular scale action just started, removes
+    the dead name without drain, and books a preempt_resume event."""
+    scaler, router, provider, model, clock = make(serving=1,
+                                                 estimate=PRESSURE)
+    scaler.tick()
+    clock.t = 1.0
+    scaler.tick()                       # scaled up -> cooldown active
+    provider.preempt_queue = ["auto1"]
+    clock.t = 2.0
+    rec = scaler.tick()
+    assert rec["preempt_resumed"] == ["auto2"]
+    assert ("auto1", False, "preempted") in router.removed
+    assert ("preempt_resume", "auto2", "preempted: auto1") in router.events
+    # a preempted name the router already ejected is not an error
+    provider.preempt_queue = ["never-joined"]
+    clock.t = 3.0
+    assert scaler.tick()["preempt_resumed"] == ["auto3"]
+
+
+def test_below_min_refills_without_a_vote():
+    """A fleet under its floor (crash the provider did NOT classify as
+    preemption) refills immediately on a neutral estimate."""
+    scaler, router, provider, model, clock = make(serving=1,
+                                                 estimate=NEUTRAL,
+                                                 min_replicas=2)
+    rec = scaler.tick()
+    assert rec["scaled_up"] == ["auto1"]
+    assert len(router.serving) == 2
+
+
+def test_shed_ladder_escalates_and_recovers_one_class_per_tick():
+    """SLO burn walks the admission ceiling down one class per tick to
+    max_shed_floor — never past it — then back up one per tick once the
+    pressure clears, capping at 9."""
+    scaler, router, provider, model, clock = make(serving=1,
+                                                 estimate=NEUTRAL,
+                                                 max_shed_floor=7)
+    router.burning = True
+    assert scaler.tick()["shed_to"] == 8
+    clock.t = 1.0
+    assert scaler.tick()["shed_to"] == 7
+    clock.t = 2.0
+    rec = scaler.tick()
+    assert "shed_to" not in rec and rec["admission_max_priority"] == 7
+    router.burning = False
+    clock.t = 3.0
+    assert scaler.tick()["recovered_to"] == 8
+    clock.t = 4.0
+    assert scaler.tick()["recovered_to"] == 9
+    clock.t = 5.0
+    rec = scaler.tick()
+    assert "recovered_to" not in rec and rec["admission_max_priority"] == 9
+
+
+def test_exhaustion_at_max_fleet_also_sheds():
+    """No SLO burn yet, but exhaustion is forecast inside
+    shed_horizon_s and the fleet cannot grow: shed pre-emptively.
+    The same forecast below max_replicas scales out instead."""
+    scaler, router, provider, model, clock = make(
+        serving=4, estimate=est(kv_eta=3.0), max_replicas=4,
+        shed_horizon_s=8.0)
+    assert scaler.tick()["shed_to"] == 8
+    # an eta outside the shed horizon is a scale signal, not a shed one
+    scaler2, router2 = make(serving=4, estimate=est(kv_eta=20.0),
+                            max_replicas=4, shed_horizon_s=8.0)[:2]
+    rec = scaler2.tick()
+    assert "shed_to" not in rec and router2.admission == 9
+
+
+def test_constructor_validation():
+    router, provider, model = FakeRouter(), FakeProvider(), FakeModel()
+    with pytest.raises(ValueError):
+        Autoscaler(router, model, provider, min_replicas=0)
+    with pytest.raises(ValueError):
+        Autoscaler(router, model, provider, min_replicas=3,
+                   max_replicas=2)
+    with pytest.raises(ValueError):
+        Autoscaler(router, model, provider, max_step=0)
+    with pytest.raises(ValueError):
+        Autoscaler(router, model, provider, hysteresis_ticks=0)
+    with pytest.raises(ValueError):
+        Autoscaler(router, model, provider, max_shed_floor=10)

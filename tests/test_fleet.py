@@ -676,6 +676,144 @@ def test_fleet_goodput_partitions_replica_seconds(tmp_path):
     assert s["replica_seconds"]["r1"]["ejected"] == pytest.approx(10.0)
 
 
+# -- class-aware admission + elastic membership (scripted router) -------------
+
+
+def test_router_sheds_class_above_ceiling_without_touching_replicas(
+    tmp_path,
+):
+    """Front-door shedding: a request above the admission ceiling gets
+    the honest terminal 429 — shed:true, its class, the ceiling — and
+    NEVER reaches a replica (it is fleet policy, not backpressure)."""
+    router, fleet, _ = _router(tmp_path)
+    router.health_tick()
+    router.set_admission(2, reason="test pressure")
+    code, out = router.handle_generate({"token_ids": [1], "priority": 5})
+    assert code == 429
+    assert out["shed"] is True and out["shed_class"] == 5
+    assert out["max_priority"] == 2 and out["request_id"]
+    assert fleet.posts == []                       # policy, not forwarding
+    # a class AT the ceiling is admitted normally
+    code, out = router.handle_generate({"token_ids": [1], "priority": 2})
+    assert code == 200
+    s = router.fleet_stats()
+    assert s["admission_max_priority"] == 2
+    assert s["shed_by_class"] == {5: 1}
+    # the change itself is an auditable event
+    ev = [e for e in _events(tmp_path) if e["deploy_event"] == "shed_level"]
+    assert len(ev) == 1 and ev[0]["max_priority"] == 2
+    assert ev[0]["reason"] == "test pressure"
+    # idempotent sets log nothing new
+    router.set_admission(2)
+    assert len([e for e in _events(tmp_path)
+                if e["deploy_event"] == "shed_level"]) == 1
+
+
+def test_replica_shed_429_is_terminal_but_busy_429_retries(tmp_path):
+    """The satellite retry fix: a replica-side 429 CARRYING shed:true
+    is the same fleet policy seen late — propagated verbatim, no retry
+    (every replica enforces the same ceiling); a busy 429 (no shed key)
+    still tries the other replica."""
+    router, fleet, _ = _router(tmp_path)
+    router.health_tick()
+    fleet.generate_reply["r0"] = (429, {
+        "error": "shed", "shed": True, "shed_class": 3, "max_priority": 1,
+    })
+    fleet.docs["r1"]["stats"].update(queue_depth=5)  # r0 is the pick
+    router.health_tick()
+    code, out = router.handle_generate({"token_ids": [1], "priority": 3})
+    assert code == 429 and out["shed"] is True and out["shed_class"] == 3
+    gen_posts = [n for n, p, _ in fleet.posts if p == "/v1/generate"]
+    assert gen_posts == ["r0"]                     # terminal: ONE attempt
+    assert router.fleet_stats()["shed_by_class"] == {3: 1}
+    # contrast: a plain busy 429 from the same pick retries on r1
+    fleet.generate_reply["r0"] = (429, {"error": "queue full"})
+    code, out = router.handle_generate({"token_ids": [1], "priority": 3})
+    assert code == 200 and out["served_by"] == "r1"
+
+
+def test_fleet_admission_endpoint_sets_and_validates(tmp_path):
+    router, fleet, _ = _router(tmp_path)
+    code, out = router.handle_admission({"max_priority": 2})
+    assert code == 200 and out["max_priority"] == 2
+    assert router.admission_max_priority() == 2
+    # -1 admits nothing (full shed); out-of-range / non-int are 400s
+    code, _ = router.handle_admission({"max_priority": -1})
+    assert code == 200
+    for bad in (10, -2, "3", True, None):
+        code, out = router.handle_admission({"max_priority": bad})
+        assert code == 400 and "max_priority" in out["error"]
+    assert router.admission_max_priority() == -1   # bad sets changed nothing
+
+
+def test_elastic_membership_books_every_replica_second(tmp_path):
+    """The autoscaler's accounting contract: a joined replica's boot
+    seconds land in ``scaling_up`` (no failure budget while booting),
+    promotion to serving happens on the first live+ready probe, and a
+    removed replica's whole life survives in the departed ledger — the
+    goodput denominator never loses a second."""
+    router, fleet, clock = _router(tmp_path)
+    router.health_tick()                           # r0/r1 ready at t=0
+    clock.advance(5.0)
+    router.add_replica(Replica("a1", "http://fake/a1"))
+    assert router.state_of("a1")["status"] == "scaling_up"
+    assert router.fleet_stats()["replicas_scaling_up"] == 1
+    # booting: unreachable probes cost nothing, forever
+    fleet.docs["a1"] = {"reachable": False, "live": False, "ready": False,
+                        "stats": {}}
+    for _ in range(10):
+        router.health_tick()
+    st = router.state_of("a1")
+    assert st["status"] == "scaling_up" and st["failures"] == 0
+    clock.advance(3.0)                             # 3s of boot
+    fleet.docs["a1"].update(reachable=True, live=True, ready=True)
+    router.health_tick()                           # first ready probe
+    assert router.state_of("a1")["status"] == "serving"
+    clock.advance(2.0)                             # 2s of service
+    s = router.fleet_stats()
+    assert s["replica_seconds"]["a1"]["scaling_up"] == pytest.approx(3.0)
+    assert s["replica_seconds"]["a1"]["serving_ready"] == pytest.approx(2.0)
+    # retire it: the ledger keeps its life, the fleet forgets the name
+    router.remove_replica("a1", drain=False, reason="scale_down")
+    s = router.fleet_stats()
+    assert "a1" not in s["replica_seconds"]
+    assert s["replicas_departed"] == 1
+    assert s["seconds_by_state"]["scaling_up"] == pytest.approx(3.0)
+    # r0+r1: 10s ready each; a1: 3s boot + 2s ready -> 22/25
+    assert s["fleet_goodput_fraction"] == pytest.approx(22.0 / 25.0)
+    ev = [e["deploy_event"] for e in _events(tmp_path)]
+    assert "replica_added" in ev and "replica_removed" in ev
+    removed = next(e for e in _events(tmp_path)
+                   if e["deploy_event"] == "replica_removed")
+    assert removed["seconds"]["scaling_up"] == pytest.approx(3.0)
+    # membership errors are loud
+    with pytest.raises(ValueError):
+        router.add_replica(Replica("r0", "http://fake/dup"))
+    with pytest.raises(ValueError):
+        router.remove_replica("a1")
+
+
+def test_remove_replica_drains_in_flight_before_dropping(tmp_path):
+    """Scale-in goes through the drain discipline: /admin/drain first,
+    then the drop waits until the replica reports zero in-flight."""
+    router, fleet, clock = _router(tmp_path, drain_timeout_s=10.0)
+    router.health_tick()
+    fleet.docs["r1"]["stats"]["in_flight"] = 2
+    orig_probe = fleet.probe
+
+    def finishing_probe(replica):
+        out = orig_probe(replica)
+        fleet.docs[replica.name]["stats"]["in_flight"] = max(
+            0, fleet.docs[replica.name]["stats"]["in_flight"] - 1
+        )
+        return out
+
+    router._probe = finishing_probe
+    router.remove_replica("r1", drain=True)
+    assert ("r1", "/admin/drain") in [(n, p) for n, p, _ in fleet.posts]
+    assert router.replica_names() == ["r0"]
+
+
 # -- deploy controller (scripted router + bench) ------------------------------
 
 

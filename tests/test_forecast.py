@@ -1,0 +1,136 @@
+"""CapacityModel tests (nanodiloco_tpu/obs/forecast).
+
+The model is the join between per-replica collector series and the
+ONE fleet-level answer the autoscaler acts on: demand sums (queue
+depth, slope, request rate), supply sums (kv headroom), min-over-
+replicas exhaustion ETAs, and — load-bearing — the CONFIDENCE
+HORIZON: estimates backed by less than ``min_horizon_s`` of samples
+are flagged not-confident, and forecasts extrapolating beyond
+``beyond_factor`` x their backing span are dropped, so a freshly
+booted replica's two-sample slope can never trigger a phantom scale
+event.
+
+Tier-1 budget: everything here drives a hand-filled ``SeriesStore``
+with explicit timestamps — host-only, no sockets, no jax, no new
+compiled programs.
+"""
+
+import pytest
+
+from nanodiloco_tpu.obs.collector import SeriesStore
+from nanodiloco_tpu.obs.forecast import (
+    KV_FREE_SAMPLE,
+    QUEUE_DEPTH_SAMPLE,
+    REQUESTS_TOTAL_SAMPLE,
+    SLOTS_TOTAL_SAMPLE,
+    CapacityModel,
+)
+
+
+def _fill(store, target, t0, n, *, depth=None, kv=None, slots=None,
+          req=None, dt=1.0):
+    """n samples at 1 Hz; each kwarg is value-at-t0 + per-step delta."""
+    for i in range(n):
+        t = t0 + i * dt
+        if depth is not None:
+            store.add(f"{target}:{QUEUE_DEPTH_SAMPLE}", t,
+                      depth[0] + depth[1] * i)
+        if kv is not None:
+            store.add(f"{target}:{KV_FREE_SAMPLE}", t, kv[0] + kv[1] * i)
+        if slots is not None:
+            store.add(f"{target}:{SLOTS_TOTAL_SAMPLE}", t, slots)
+        if req is not None:
+            store.add(f"{target}:{REQUESTS_TOTAL_SAMPLE}", t,
+                      req[0] + req[1] * i)
+
+
+def test_discovers_targets_from_store_keys():
+    """Elastic membership without re-plumbing: every target that has
+    ever reported a queue-depth sample is joined over (labeled samples
+    with extra colons are not mistaken for targets)."""
+    store = SeriesStore()
+    _fill(store, "r0", 0.0, 3, depth=(1, 0))
+    _fill(store, "auto1", 0.0, 3, depth=(2, 0))
+    store.add(f"weird:extra:{QUEUE_DEPTH_SAMPLE}", 0.0, 9.0)
+    model = CapacityModel(store)
+    assert model.targets() == ["auto1", "r0"]
+    explicit = CapacityModel(store, targets=["r0"])
+    assert explicit.targets() == ["r0"]
+
+
+def test_fleet_sums_and_min_over_replicas_exhaustion():
+    """Demand/supply are SUMS; exhaustion is the MIN over replicas —
+    the fleet degrades when the first replica saturates, not when the
+    average does."""
+    store = SeriesStore()
+    # r0: queue 2 flat, kv falling 5/s from 100 -> exhausts in ~8s
+    _fill(store, "r0", 0.0, 12, depth=(2, 0), kv=(100, -5), slots=4,
+          req=(0, 2))
+    # r1: queue rising 1/s from 0, kv flat at 80
+    _fill(store, "r1", 0.0, 12, depth=(0, 1), kv=(80, 0), slots=4,
+          req=(0, 3))
+    est = CapacityModel(store, window_s=20.0).estimate(now=11.0)
+    assert est.replicas == 2
+    assert est.queue_depth == pytest.approx(2 + 11)
+    assert est.queue_slope == pytest.approx(1.0)
+    assert est.request_rate == pytest.approx(5.0)
+    assert est.kv_blocks_free == pytest.approx((100 - 55) + 80)
+    # only r0's kv trends to 0: (0 - 45) / -5 = 9s
+    assert est.kv_exhaustion_s == pytest.approx(9.0)
+    # r1's queue (at 11, past 4 slots) is already exhausted -> eta 0
+    assert est.queue_exhaustion_s == pytest.approx(0.0)
+    assert est.exhaustion_s() == pytest.approx(0.0)
+    assert est.confident
+    d = est.to_dict()
+    assert d["replicas"] == 2 and d["confident"] is True
+
+
+def test_short_horizon_is_not_confident():
+    """A replica with two fresh samples (just booted): the estimate
+    exists but ``confident`` stays False until min_horizon_s of data
+    backs it — the autoscaler's do-nothing-yet signal."""
+    store = SeriesStore()
+    _fill(store, "r0", 0.0, 2, depth=(0, 5), slots=4)
+    est = CapacityModel(store, window_s=20.0,
+                        min_horizon_s=5.0).estimate(now=1.0)
+    assert est.replicas == 1
+    assert est.horizon_s == pytest.approx(1.0)
+    assert not est.confident
+
+
+def test_forecast_beyond_evidence_is_dropped():
+    """An ETA farther out than beyond_factor x the backing span is
+    extrapolation, not a forecast: reported as no-exhaustion."""
+    store = SeriesStore()
+    # 4s of data, kv falling 1/s from 1000: eta ~996s >> 10 x 3s span
+    _fill(store, "r0", 0.0, 4, depth=(1, 0), kv=(1000, -1), slots=4)
+    est = CapacityModel(store, window_s=20.0, min_horizon_s=2.0,
+                        beyond_factor=10.0).estimate(now=3.0)
+    assert est.confident
+    assert est.kv_exhaustion_s is None
+    assert est.exhaustion_s() is None
+
+
+def test_stale_replica_is_excluded_from_supply():
+    """A retired/dead replica's series stays in the store; its LAST
+    sample being older than the window removes it from the join — the
+    fleet the model sees is the fleet that answered recently."""
+    store = SeriesStore()
+    _fill(store, "r0", 0.0, 30, depth=(1, 0), kv=(50, 0), slots=4)
+    _fill(store, "gone", 0.0, 3, depth=(9, 0), kv=(10, 0), slots=4)
+    est = CapacityModel(store, window_s=10.0).estimate(now=29.0)
+    assert est.replicas == 1
+    assert est.queue_depth == pytest.approx(1.0)
+    assert est.kv_blocks_free == pytest.approx(50.0)
+    # nobody fresh at all: an empty, unconfident estimate — never a crash
+    est = CapacityModel(store, window_s=10.0).estimate(now=500.0)
+    assert est.replicas == 0 and not est.confident
+    assert est.queue_depth is None and est.exhaustion_s() is None
+
+
+def test_constructor_validation():
+    store = SeriesStore()
+    with pytest.raises(ValueError):
+        CapacityModel(store, window_s=0.0)
+    with pytest.raises(ValueError):
+        CapacityModel(store, beyond_factor=0.0)

@@ -289,6 +289,117 @@ def test_series_store_counter_rate_survives_resets():
     assert store.increase("c", window_s=0.5, now=4.0) is None
 
 
+# -- slope + exhaustion forecasts (the autoscaler's inputs) -------------------
+
+
+def test_slope_recovers_a_linear_trend():
+    store = SeriesStore()
+    for i in range(10):
+        store.add("g", float(i), 100.0 - 2.5 * i)
+    assert store.slope("g", window_s=20.0, now=9.0) == pytest.approx(-2.5)
+    # windowing: only the recent (flat) tail counts
+    for i in range(10, 15):
+        store.add("g", float(i), 75.0)
+    assert store.slope("g", window_s=4.0, now=14.0) == pytest.approx(0.0)
+
+
+def test_slope_is_robust_to_a_garbage_sample():
+    """Theil-Sen vs least-squares: ONE wild sample (a scrape racing a
+    restart) must not swing the trend — the difference between a real
+    forecast and a phantom scale event."""
+    store = SeriesStore()
+    for i in range(20):
+        v = 1000.0 if i == 10 else float(i)  # slope 1, one spike
+        store.add("g", float(i), v)
+    s = store.slope("g", window_s=30.0, now=19.0)
+    assert s == pytest.approx(1.0, abs=0.2)
+
+
+def test_slope_counter_mode_survives_resets():
+    """Satellite contract: a replica restarting MID-SURGE (its counter
+    drops to ~0) must not read as a negative or explosive trend. With
+    ``counter=True`` the reset folds into the monotone cumulative
+    series — the same positive-deltas rule as ``increase()`` — so the
+    slope stays the true arrival rate."""
+    store = SeriesStore()
+    # 10/s counter that resets at t=5 (process restart mid-surge)
+    vals = [0, 10, 20, 30, 40, 3, 13, 23, 33, 43]
+    for t, v in enumerate(vals):
+        store.add("c", float(t), float(v))
+    # raw slope sees the cliff; counter mode folds it away
+    s = store.slope("c", window_s=20.0, now=9.0, counter=True)
+    assert s == pytest.approx(10.0, rel=0.15)
+    assert s > 0
+    raw = store.slope("c", window_s=20.0, now=9.0)
+    assert raw < s  # the unfolded series IS poisoned by the reset
+    # and rate()/increase() agree on the same window (the 40 -> 3 cliff
+    # is dropped, the 3 -> 13 -> ... recovery counts)
+    assert store.increase("c", 20.0, 9.0) == pytest.approx(80.0)
+    assert store.rate("c", 20.0, 9.0) == pytest.approx(80.0 / 9.0)
+
+
+def test_slope_downsamples_long_windows():
+    """A maxed-out ring must not turn one trend query into ~2M pair
+    slopes: long windows are strided down but keep the endpoints (and
+    the answer)."""
+    store = SeriesStore(maxlen=4096)
+    for i in range(3000):
+        store.add("g", float(i), 3.0 * i)
+    assert store.slope("g", window_s=1e6, now=2999.0) == pytest.approx(3.0)
+
+
+def test_slope_edge_cases():
+    store = SeriesStore()
+    assert store.slope("missing", 10.0, 0.0) is None
+    store.add("g", 1.0, 5.0)
+    assert store.slope("g", 10.0, 1.0) is None      # one sample
+    store.add("g", 1.0, 7.0)
+    assert store.slope("g", 10.0, 1.0) is None      # zero elapsed
+
+
+def test_forecast_exhaustion_floor_and_ceiling():
+    store = SeriesStore()
+    for i in range(6):
+        store.add("kv", float(i), 100.0 - 10.0 * i)   # free blocks falling
+        store.add("q", float(i), 1.0 * i)             # queue rising
+    # kv at 50, falling 10/s -> hits 0 in 5s
+    assert store.forecast_exhaustion(
+        "kv", 0.0, 10.0, 5.0, kind="floor"
+    ) == pytest.approx(5.0)
+    # queue at 5, rising 1/s -> crosses 8 slots in 3s
+    assert store.forecast_exhaustion(
+        "q", 8.0, 10.0, 5.0, kind="ceiling"
+    ) == pytest.approx(3.0)
+    # already past the bound: 0.0, not a projection
+    assert store.forecast_exhaustion("q", 3.0, 10.0, 5.0,
+                                     kind="ceiling") == 0.0
+    assert store.forecast_exhaustion("kv", 60.0, 10.0, 5.0,
+                                     kind="floor") == 0.0
+    # trending AWAY from the bound: no forecast (queue rises away from
+    # a floor below it; kv falls away from a ceiling above it)
+    assert store.forecast_exhaustion("q", 2.0, 10.0, 5.0,
+                                     kind="floor") is None
+    assert store.forecast_exhaustion("kv", 120.0, 10.0, 5.0,
+                                     kind="ceiling") is None
+    with pytest.raises(ValueError):
+        store.forecast_exhaustion("q", 8.0, 10.0, 5.0, kind="sideways")
+    assert store.forecast_exhaustion("missing", 0.0, 10.0, 5.0) is None
+
+
+def test_forecast_exhaustion_ignores_counter_reset_cliff():
+    """The phantom-scale-event pin, end to end at the store level: a
+    gauge that RESETS (replica restart re-registers kv_blocks_free at
+    full) must not forecast exhaustion from the artificial cliff —
+    Theil-Sen's median keeps the majority trend."""
+    store = SeriesStore()
+    # healthy flat-ish gauge, one restart dip-and-recover
+    vals = [50, 50, 49, 50, 2, 50, 50, 49, 50, 50]
+    for t, v in enumerate(vals):
+        store.add("kv", float(t), float(v))
+    eta = store.forecast_exhaustion("kv", 0.0, 20.0, 9.0, kind="floor")
+    assert eta is None  # median slope ~0: no exhaustion, no phantom scale
+
+
 # -- the scrape loop (scripted fetch, fake clock) -----------------------------
 
 

@@ -2,11 +2,18 @@
 admission (priority classes, EDF, starvation bound), chunked-prefill
 interleaving, slot refill mid-decode, EOS retirement, queue-full
 backpressure, and deadline expiry — all against a scripted fake backend
-and an injected clock. Deterministic, model-free, tier-1."""
+and an injected clock. Deterministic, model-free, tier-1: no jax, no
+new compiled programs (the admission-wire tests at the bottom use a
+loopback ServeServer over the same fake backend)."""
 
 import pytest
 
-from nanodiloco_tpu.serve.scheduler import GenRequest, QueueFull, Scheduler
+from nanodiloco_tpu.serve.scheduler import (
+    ClassShed,
+    GenRequest,
+    QueueFull,
+    Scheduler,
+)
 
 
 class FakeClock:
@@ -647,6 +654,108 @@ def test_request_spans_and_histograms():
     assert cums == sorted(cums)
     assert buckets[-1] == ("+Inf", 2)
     assert s["hist_ttft"]["sum"] > 0
+
+
+def test_class_shed_refuses_above_ceiling_terminally():
+    """Overload shedding, not backpressure: a request whose class is
+    above the admission ceiling raises ``ClassShed`` (a ``QueueFull``
+    subclass carrying the sacrificed class and the ceiling), counts
+    under its OWN outcome — never folded into busy rejections — and a
+    request at the ceiling still admits."""
+    sched, backend, _ = _sched(num_slots=1, scripts={1: [10]})
+    assert sched.admission_max_priority == 9
+    assert sched.set_admission_max_priority(2) == 2
+    with pytest.raises(ClassShed) as exc:
+        sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=1,
+                                priority=5))
+    assert isinstance(exc.value, QueueFull)       # one except-arm upstream
+    assert exc.value.shed_class == 5 and exc.value.max_priority == 2
+    t = sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=1,
+                                priority=2))
+    _drain(sched, (t,))
+    s = sched.stats()
+    assert s["shed_by_priority"] == {5: 1}
+    assert s["requests_by_outcome"]["shed"] == 1
+    assert s["rejected"] == 0                     # sheds are not "rejected"
+    assert s["admission_max_priority"] == 2
+    # -1 is the full stop: even class 0 sheds (unlike drain, the client
+    # gets the honest body, not a readiness flip)
+    sched.set_admission_max_priority(-1)
+    with pytest.raises(ClassShed):
+        sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=1,
+                                priority=0))
+
+
+def test_set_admission_max_priority_validates():
+    sched, _, _ = _sched(scripts={})
+    for bad in (10, -2, "3", True, None, 2.0):
+        with pytest.raises(ValueError):
+            sched.set_admission_max_priority(bad)
+    assert sched.admission_max_priority == 9      # bad sets changed nothing
+
+
+def test_ttft_p95_split_by_priority_class():
+    """The per-class TTFT percentiles exist so the protected class's
+    latency is visible SEPARATELY while lower classes shed — a blended
+    p95 would hide exactly the number the SLO rule watches."""
+
+    class SteppingClock(FakeClock):
+        def __call__(self) -> float:
+            self.t += 0.25
+            return self.t
+
+    sched, _, _ = _sched(num_slots=2, scripts={1: [10], 2: [20]},
+                         clock=SteppingClock())
+    t0 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=1,
+                                 priority=0))
+    t3 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=1, seed=2,
+                                 priority=3))
+    _drain(sched, (t0, t3))
+    by_prio = sched.stats()["ttft_p95_by_priority"]
+    assert set(by_prio) == {0, 3}
+    assert all(v > 0 for v in by_prio.values())
+
+
+def test_admission_ceiling_and_shed_429_over_the_wire():
+    """The wire half of the shed contract: /admin/admission sets the
+    ceiling, a shed /v1/generate answers 429 with the explicit
+    ``shed: true`` body (the fleet router's terminal-vs-retry pivot),
+    and /metrics exposes ceiling + per-class shed counters."""
+    from nanodiloco_tpu.serve import ServeServer, http_get, http_post_json
+
+    sched, _, _ = _sched(num_slots=1, scripts={1: [10, 11]})
+    server = ServeServer(sched, port=0, host="127.0.0.1").start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        code, out = http_post_json(base + "/admin/admission",
+                                   {"max_priority": 0})
+        assert code == 200 and out["max_priority"] == 0
+        code, out = http_post_json(base + "/v1/generate", {
+            "token_ids": [5], "max_new_tokens": 2, "seed": 1,
+            "priority": 3, "stop": False,
+        })
+        assert code == 429
+        assert out["shed"] is True and out["shed_class"] == 3
+        assert out["max_priority"] == 0
+        # the admitted class still serves
+        code, out = http_post_json(base + "/v1/generate", {
+            "token_ids": [5], "max_new_tokens": 2, "seed": 1,
+            "priority": 0, "stop": False,
+        })
+        assert code == 200 and out["token_ids"] == [10, 11]
+        m = http_get(base + "/metrics")[1]
+        assert "nanodiloco_serve_admission_max_priority 0" in m
+        assert 'nanodiloco_serve_shed_total{priority="3"} 1' in m
+        assert 'nanodiloco_serve_requests_total{outcome="shed"} 1' in m
+        assert 'nanodiloco_serve_class_ttft_p95_seconds{priority="0"}' in m
+        # invalid ceilings are 400s, and the running value is untouched
+        for bad in (10, "3", None):
+            code, out = http_post_json(base + "/admin/admission",
+                                       {"max_priority": bad})
+            assert code == 400
+        assert sched.admission_max_priority == 0
+    finally:
+        server.stop()
 
 
 def test_stats_timing_uses_injected_clock():
