@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import time
 
 from nanodiloco_tpu.models.config import LlamaConfig
 from nanodiloco_tpu.training.train_loop import TrainConfig, train
@@ -749,6 +750,9 @@ def _append_serve_stats(path: str, scheduler) -> None:
     s = scheduler.stats()
     rec = {
         "serve_stats": True,
+        # wall-clock stamp so `report dashboard` can order multi-session
+        # appends; older JSONLs without it fall back to record order
+        "t_unix": round(time.time(), 3),
         **{k: v for k, v in s.items() if not k.startswith("hist_")},
     }
     for nested in ("kv_pool", "spec"):
@@ -1386,6 +1390,12 @@ def report_main(argv: list[str]) -> None:
     scraped series from an ``obs-watch --series-jsonl`` artifact — the
     after-the-fact view of an incident's gauges (obs/collector).
 
+    ``report dashboard ARTIFACT.jsonl -o PAGE.html``: self-contained
+    static HTML dashboard (obs/dashboard) — sparkline tables for SLO
+    burn, fleet goodput, the device-second budget by program, cost per
+    class, and a capacity forecast — from a collector series JSONL or
+    a serve stats JSONL, rendered fully offline.
+
     ``report drift RUN.jsonl``: the run's DiLoCo dynamics timeline —
     per-sync cross-worker drift, per-worker pseudo-gradient norms,
     outer-momentum norm, and pseudo-gradient/update cosine (the
@@ -1414,6 +1424,9 @@ def report_main(argv: list[str]) -> None:
         return
     if argv[:1] == ["timeseries"]:
         report_timeseries_main(argv[1:])
+        return
+    if argv[:1] == ["dashboard"]:
+        report_dashboard_main(argv[1:])
         return
     p = argparse.ArgumentParser(prog="nanodiloco_tpu report")
     p.add_argument("jsonl", help="metrics JSONL written by training")
@@ -1576,6 +1589,42 @@ def report_timeseries_main(argv: list[str]) -> None:
         print(f"{key:>{span}} |{spark}| "
               f"min={st['min']:.4g} max={st['max']:.4g} "
               f"last={st['last']:.4g} n={st['n']}")
+
+
+def report_dashboard_main(argv: list[str]) -> None:
+    """``report dashboard ARTIFACT.jsonl -o PAGE.html``: render the
+    offline incident dashboard (obs/dashboard) — one self-contained
+    HTML file, no scripts, no network, from a collector series JSONL
+    (`obs-watch --series-jsonl`) or a serve stats JSONL."""
+    p = argparse.ArgumentParser(prog="nanodiloco_tpu report dashboard")
+    p.add_argument("jsonl",
+                   help="collector series JSONL (obs-watch "
+                        "--series-jsonl) or serve stats JSONL "
+                        "(serve --stats-jsonl)")
+    p.add_argument("-o", "--out", required=True,
+                   help="output HTML path")
+    p.add_argument("--title", type=str, default="nanodiloco fleet",
+                   help="page title")
+    p.add_argument("--width", type=int, default=60,
+                   help="sparkline width in characters")
+    args = p.parse_args(argv)
+
+    import os
+
+    from nanodiloco_tpu.obs.dashboard import (
+        load_dashboard_series,
+        render_dashboard,
+    )
+
+    series = load_dashboard_series(args.jsonl)
+    page = render_dashboard(series, title=args.title, width=args.width)
+    d = os.path.dirname(os.path.abspath(args.out))
+    os.makedirs(d, exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write(page)
+    n_samples = sum(len(v) for v in series.values())
+    print(f"rendered {len(series)} series ({n_samples} samples) "
+          f"-> {args.out}")
 
 
 def report_cost_main(argv: list[str]) -> None:

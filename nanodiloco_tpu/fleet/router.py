@@ -354,7 +354,7 @@ class FleetRouter:
         except (json.JSONDecodeError, ValueError):
             doc = {}
         for k in ("queue_depth", "slots_busy", "kv_blocks_free",
-                  "deploy_generation", "draining"):
+                  "deploy_generation", "draining", "device_seconds_total"):
             if doc.get(k) is not None:
                 out["stats"][k] = doc[k]
         try:
@@ -1007,6 +1007,14 @@ class FleetRouter:
                     st.replica.name: st.stats.get("deploy_generation")
                     for st in self._states
                 },
+                # per-replica attributed device-seconds (from the last
+                # health probe's body): where the fleet's dispatch
+                # budget is going, replica by replica
+                "device_seconds_by_replica": {
+                    st.replica.name: st.stats["device_seconds_total"]
+                    for st in self._states
+                    if st.stats.get("device_seconds_total") is not None
+                },
                 "events": dict(sorted(self._counters.items())),
                 "elapsed_s": round(elapsed, 6),
                 "replica_ready_s": round(ready_s, 6),
@@ -1078,6 +1086,17 @@ class FleetRouter:
                 "replica-seconds — the fleet's every-second-accounted "
                 "availability number",
                 [(None, s["fleet_goodput_fraction"])],
+            ))
+        dev = s.get("device_seconds_by_replica") or {}
+        if dev:
+            families.append((
+                "nanodiloco_fleet_replica_device_seconds", "counter",
+                "attributed dispatch seconds per replica (from the "
+                "health probe body) — the fleet's device-second budget "
+                "split replica by replica",
+                [({"replica": name}, v)
+                 for name, v in sorted(dev.items())]
+                + [(None, round(sum(dev.values()), 6))],
             ))
         families.append((
             "nanodiloco_fleet_state_seconds", "gauge",

@@ -319,19 +319,80 @@ class SeriesStore:
     and writes are lock-guarded — the scrape loop appends while the SLO
     evaluator and HTTP threads query."""
 
-    def __init__(self, maxlen: int = 2048) -> None:
+    def __init__(self, maxlen: int = 2048, *,
+                 long_bucket_s: float = 60.0,
+                 long_maxlen: int = 1024) -> None:
         if maxlen < 1:
             raise ValueError(f"maxlen must be >= 1; got {maxlen}")
+        if long_bucket_s <= 0:
+            raise ValueError(
+                f"long_bucket_s must be > 0; got {long_bucket_s}"
+            )
+        if long_maxlen < 1:
+            raise ValueError(f"long_maxlen must be >= 1; got {long_maxlen}")
         self.maxlen = int(maxlen)
+        # long-horizon retention tier BEHIND the ring buffers: every
+        # sample also lands in a time-bucketed downsample (one point
+        # per ``long_bucket_s``, the bucket's LAST value — the same
+        # convention a counter scrape keeps), bounded by
+        # ``long_maxlen`` buckets. At the defaults that is ~17 hours
+        # of per-minute trend per series behind a ~2048-sample ring —
+        # the forecaster and the offline dashboard keep multi-hour
+        # history without unbounded memory.
+        self.long_bucket_s = float(long_bucket_s)
+        self.long_maxlen = int(long_maxlen)
         self._series: dict[str, collections.deque] = {}
+        # key -> (closed-bucket deque of (bucket_start_t, last_value),
+        #         open bucket id or None, open bucket's last value)
+        self._long: dict[str, collections.deque] = {}
+        self._long_open: dict[str, tuple[int, float]] = {}
         self._lock = threading.Lock()
 
     def add(self, key: str, t: float, value: float) -> None:
+        t = float(t)
+        value = float(value)
         with self._lock:
             dq = self._series.get(key)
             if dq is None:
                 dq = self._series[key] = collections.deque(maxlen=self.maxlen)
-            dq.append((float(t), float(value)))
+            dq.append((t, value))
+            # feed the long tier: flush the open bucket when this
+            # sample starts a later one (out-of-order samples within a
+            # flushed bucket are rare and simply start a new bucket)
+            bucket = int(t // self.long_bucket_s)
+            open_ = self._long_open.get(key)
+            if open_ is not None and open_[0] != bucket:
+                ldq = self._long.get(key)
+                if ldq is None:
+                    ldq = self._long[key] = collections.deque(
+                        maxlen=self.long_maxlen
+                    )
+                ldq.append((open_[0] * self.long_bucket_s, open_[1]))
+            self._long_open[key] = (bucket, value)
+
+    def long_window(self, key: str, since: float,
+                    until: float | None = None) -> list[tuple[float, float]]:
+        """Downsampled long-horizon samples (one per bucket, the
+        bucket's last value, stamped at the bucket start), oldest
+        first; the still-open bucket is included, stamped at its own
+        bucket start. The dashboard's multi-hour trend source."""
+        with self._lock:
+            samples = list(self._long.get(key, ()))
+            open_ = self._long_open.get(key)
+            if open_ is not None:
+                samples.append(
+                    (open_[0] * self.long_bucket_s, open_[1])
+                )
+        return [
+            (t, v) for t, v in samples
+            if t >= since and (until is None or t <= until)
+        ]
+
+    def long_snapshot(self) -> dict[str, list[tuple[float, float]]]:
+        """Every series' long-tier samples (open bucket included)."""
+        with self._lock:
+            keys = set(self._long) | set(self._long_open)
+        return {k: self.long_window(k, float("-inf")) for k in sorted(keys)}
 
     def keys(self, contains: str | None = None) -> list[str]:
         with self._lock:

@@ -215,6 +215,7 @@ class TelemetryServer:
         self._alarms: dict[str, int] = {}
         self._faults: dict[str, int] = {}    # injected-fault records by kind
         self._retries: dict[str, int] = {}   # IO retry records by op
+        self._devtime: dict | None = None    # last devtime snapshot
         self._resumes = 0                    # checkpoint-resume records
         self._outer_syncs = 0
         self._wire_total = 0.0
@@ -344,6 +345,11 @@ class TelemetryServer:
                             self._badput[cause] = float(s)
                 elif k.startswith("t_") and isinstance(v, (int, float)):
                     self._phases[k[2:]] = float(v)
+                elif k == "devtime" and isinstance(v, dict):
+                    # DispatchAccountant snapshot (obs/devtime): the
+                    # ledgers are cumulative, so keeping the LAST
+                    # snapshot renders correct counters
+                    self._devtime = v
                 elif k == "cost_analysis" and isinstance(v, dict):
                     fpt = v.get("flops_per_token")
                     if isinstance(fpt, (int, float)):
@@ -370,6 +376,7 @@ class TelemetryServer:
             resumes = self._resumes
             syncs = self._outer_syncs
             wire_total = self._wire_total
+            devtime = self._devtime
         helps = {name: h for name, h in _GAUGE_KEYS.values()}
         helps["nanodiloco_flops_per_token"] = (
             "analytic FLOPs per token from the lowered program's "
@@ -455,6 +462,12 @@ class TelemetryServer:
             "cumulative per-worker outer-sync wire bytes",
             [(None, wire_total)],
         ))
+        # per-program device/compile-second ledgers (obs/devtime): the
+        # SAME family definition the serve /metrics uses, so the two
+        # tiers' expositions cannot drift
+        from nanodiloco_tpu.obs.devtime import devtime_families
+
+        families.extend(devtime_families(devtime))
         return render_exposition(families)
 
     def health(self) -> tuple[int, dict]:

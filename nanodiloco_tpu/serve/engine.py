@@ -139,6 +139,7 @@ from nanodiloco_tpu.models.generate import (
     verify_slots_fn,
     verify_slots_paged_fn,
 )
+from nanodiloco_tpu.obs.devtime import DispatchAccountant
 from nanodiloco_tpu.obs.telemetry import Histogram
 from nanodiloco_tpu.serve.block_pool import BlockPool, BlocksExhausted
 from nanodiloco_tpu.serve.prefix_cache import PrefixCache
@@ -400,6 +401,10 @@ class InferenceEngine:
         # (kind -> bucket set) of every program shape dispatched, for
         # the layout-qualified compile-count introspection
         self._buckets: dict[str, set[int]] = {}
+        # device-time ledger: every dispatch below runs inside one of
+        # its fence-timed sections, keyed by the same (kind, bucket,
+        # layout) triples as the compile counts (obs/devtime)
+        self.accountant = DispatchAccountant()
 
     # -- tensor-parallel plumbing -------------------------------------------
 
@@ -449,6 +454,11 @@ class InferenceEngine:
         would splice stale rows into a new-weight stream. Must be
         called from the tick thread (``Scheduler.call_on_tick`` hands a
         swap over from HTTP threads). Returns the new generation."""
+        with self.accountant.section("swap", 0, self.kv_layout,
+                                     first_is_compile=False):
+            return self._swap_weights_inner(params)
+
+    def _swap_weights_inner(self, params) -> int:
         old = jax.tree_util.tree_flatten_with_path(self.params)[0]
         new = jax.tree_util.tree_flatten_with_path(params)[0]
         if [p for p, _ in old] != [p for p, _ in new]:
@@ -471,6 +481,9 @@ class InferenceEngine:
             params = jax.device_put(
                 params, named(self.mesh, param_specs(self.cfg))
             )
+        # fence the transfer: the swap section's seconds must cover the
+        # H2D upload, not just its dispatch
+        jax.block_until_ready(params)
         self.deploy_generation += 1
         self._params_by_gen[self.deploy_generation] = params
         self.params = params
@@ -632,16 +645,24 @@ class InferenceEngine:
             self._jarr(temp, np.float32), self._jarr(top_k, np.int32),
             self._jarr(top_p, np.float32),
         )
-        if self.paged:
-            tok, logits, self.pool = self._chunk_paged(
-                params, self.pool,
-                self._jarr(self._tables[slot]), *args,
-            )
-        else:
-            tok, logits, self.cache = self._chunk(
-                params, self.cache, args[0], args[1],
-                self._jarr(slot, np.int32), *args[2:],
-            )
+        with self.accountant.section("prefill_chunk", len(chunk),
+                                     self.kv_layout):
+            if self.paged:
+                tok, logits, self.pool = self._chunk_paged(
+                    params, self.pool,
+                    self._jarr(self._tables[slot]), *args,
+                )
+            else:
+                tok, logits, self.cache = self._chunk(
+                    params, self.cache, args[0], args[1],
+                    self._jarr(slot, np.int32), *args[2:],
+                )
+            # fence INSIDE the section: interior chunks have no host
+            # consumer (the final chunk's int(tok) is the only natural
+            # sync), and an unfenced async dispatch would be timed as
+            # free. One output suffices — the chunk is one executable,
+            # its buffers materialize together.
+            jax.block_until_ready(tok)
         return tok, logits
 
     def prefill_step(self, slot: int) -> int | None:
@@ -890,20 +911,24 @@ class InferenceEngine:
         keys = self._jarr(keys_now)
         out: list[list[int]] = [[] for _ in range(b)]
         for params, slots, active in self._gen_dispatches(dev):
-            if self.paged:
-                nxt, self.pool = self._decode_paged(
-                    params, self.pool, dev["tables"],
-                    tokens, pos, keys,
-                    dev["temp"], dev["topk"], dev["topp"], active,
-                )
-            else:
-                nxt, self.cache = self._decode(
-                    params, self.cache,
-                    tokens, pos,
-                    dev["key_valid"], keys,
-                    dev["temp"], dev["topk"], dev["topp"], active,
-                )
-            nxt = np.asarray(nxt)
+            with self.accountant.section("decode", 1, self.kv_layout):
+                if self.paged:
+                    nxt, self.pool = self._decode_paged(
+                        params, self.pool, dev["tables"],
+                        tokens, pos, keys,
+                        dev["temp"], dev["topk"], dev["topp"], active,
+                    )
+                else:
+                    nxt, self.cache = self._decode(
+                        params, self.cache,
+                        tokens, pos,
+                        dev["key_valid"], keys,
+                        dev["temp"], dev["topk"], dev["topp"], active,
+                    )
+                # the host fetch below is the tick's natural fence;
+                # inside the section so the measured seconds cover the
+                # program, not just its dispatch
+                nxt = np.asarray(nxt)
             for s in slots:
                 self._pos[s] += 1
                 self._step_idx[s] += 1
@@ -951,20 +976,21 @@ class InferenceEngine:
         jkeys = self._jarr(keys_now)
         out: list[list[int]] = [[] for _ in range(b)]
         for params, slots, active in self._gen_dispatches(dev):
-            if self.paged:
-                sampled, counts, self.pool = self._verify(
-                    params, self.pool, dev["tables"],
-                    jtokens, jpos, jdlen, jkeys,
-                    dev["temp"], dev["topk"], dev["topp"], active,
-                )
-            else:
-                sampled, counts, self.cache = self._verify(
-                    params, self.cache, jtokens, jpos, jdlen,
-                    dev["key_valid"], jkeys,
-                    dev["temp"], dev["topk"], dev["topp"], active,
-                )
-            sampled = np.asarray(sampled)
-            counts = np.asarray(counts)
+            with self.accountant.section("verify", t, self.kv_layout):
+                if self.paged:
+                    sampled, counts, self.pool = self._verify(
+                        params, self.pool, dev["tables"],
+                        jtokens, jpos, jdlen, jkeys,
+                        dev["temp"], dev["topk"], dev["topp"], active,
+                    )
+                else:
+                    sampled, counts, self.cache = self._verify(
+                        params, self.cache, jtokens, jpos, jdlen,
+                        dev["key_valid"], jkeys,
+                        dev["temp"], dev["topk"], dev["topp"], active,
+                    )
+                sampled = np.asarray(sampled)
+                counts = np.asarray(counts)
             for s in slots:
                 c = int(counts[s])
                 emitted = [int(v) for v in sampled[s, :c]]
@@ -1057,8 +1083,11 @@ class InferenceEngine:
             self.speculator = saved
             self.release(0)
             # the ramp's ticks are warmup, not traffic: /metrics must
-            # never report them
+            # never report them. Device seconds follow the same rule;
+            # COMPILE seconds stay — warmup is exactly when the verify
+            # buckets compile, and that budget line is the point
             self.reset_spec_stats()
+            self.accountant.reset_device_seconds()
         return len(widths)
 
     def reset_spec_stats(self) -> None:
@@ -1147,6 +1176,21 @@ class InferenceEngine:
                 str(s): ps["blocks_free"] for s in range(self.tp)
             }
         return out
+
+    def devtime_stats(self) -> dict:
+        """Per-program device/compile-second ledgers for /metrics and
+        the stats JSONL — the accountant is always armed (host-side
+        perf_counter sections; observation-only)."""
+        return self.accountant.snapshot()
+
+    def blocks_held(self, slot: int) -> int:
+        """KV blocks currently mapped into ``slot`` (0 in dense mode —
+        a dense slot's cache rows are a fixed arena share, not a
+        metered allocation). The scheduler's ``kv_block_seconds``
+        attribution reads this at admission."""
+        if not self.paged:
+            return 0
+        return len(self._slot_blocks[slot])
 
     def spec_stats(self) -> dict | None:
         """Speculative-decoding counters for /metrics and the stats

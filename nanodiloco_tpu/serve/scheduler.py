@@ -207,6 +207,12 @@ class _Prefilling:
     chunks_left: int
     chunks_run: int = 0
     bypassed: int = 0
+    # device-time attribution (obs/devtime): measured chunk seconds
+    # billed wholly to this request, and the KV blocks it holds (a
+    # paged allocation is all-or-nothing at admission) for the
+    # block-seconds bill at release
+    prefill_device_s: float = 0.0
+    blocks_held: int = 0
 
 
 @dataclasses.dataclass
@@ -218,6 +224,13 @@ class _Running:
     admitted_at: float
     first_token_at: float
     tokens: list[int]
+    # device-time attribution: prefill seconds carried over from the
+    # _Prefilling phase; decode seconds are this slot's share of each
+    # measured tick (split over the slots it advanced, weighted by
+    # emitted positions — ISSUE 17's apportionment rule)
+    prefill_device_s: float = 0.0
+    decode_device_s: float = 0.0
+    blocks_held: int = 0
 
 
 class Scheduler:
@@ -304,6 +317,22 @@ class Scheduler:
         self._decode_tokens = 0
         self._decode_s = 0.0
         self._prefill_chunks = 0   # chunks run (counter)
+        # device-time and cost attribution (obs/devtime): measured
+        # prefill-dispatch seconds (the decode twin is _decode_s), the
+        # per-class device-second and KV-block-second rollups the
+        # billing counters export, and the two decode-tick windows the
+        # interference ratio derives from — tick p50 with vs without
+        # pending prefill chunks, the DistServe tier-split signal
+        # (arXiv:2401.09670; ROADMAP item 1)
+        self._prefill_s = 0.0
+        self._device_s_by_priority: dict[int, float] = {}
+        self._kv_block_s_by_priority: dict[int, float] = {}
+        self._tick_with_prefill: collections.deque[float] = (
+            collections.deque(maxlen=512)
+        )
+        self._tick_no_prefill: collections.deque[float] = (
+            collections.deque(maxlen=512)
+        )
         self._ttft: collections.deque[float] = collections.deque(maxlen=512)
         # per-class TTFT windows: the gauge the highest class's SLO rule
         # alerts on — the fleet-wide TTFT p95 is meaningless under
@@ -461,8 +490,14 @@ class Scheduler:
             self._span("prefill", run.admitted_at, now,
                        self._req_id(run.ticket, run.request), slot=s,
                        chunks=run.chunks_run, outcome=reason)
+            # chunks already run billed their seconds to this request —
+            # an expiry mid-prefill must not drop them (no second
+            # silently vanishes), and the blocks it held settle here
             self._finish(run.ticket, run.request, [], reason,
-                         run.submitted_at, run.admitted_at, None, now)
+                         run.submitted_at, run.admitted_at, None, now,
+                         prefill_device_s=run.prefill_device_s,
+                         kv_block_seconds=(
+                             run.blocks_held * (now - run.admitted_at)))
 
         # 3. admit into free slots in SLO order (priority class, EDF
         # within it, starvation bound on top) — staging only; the model
@@ -528,9 +563,15 @@ class Scheduler:
             self._priority_hist(q.request.priority).observe(wait)
             self._span("queued", q.submitted_at, t_admit, rid_str, slot=slot,
                        priority=q.request.priority)
+            # KV blocks the admission just allocated (all-or-nothing,
+            # constant until release): the block-seconds bill is
+            # blocks x held-time, settled at release. Backends without
+            # the accessor (dense, fakes) bill zero.
+            held = getattr(self.backend, "blocks_held", None)
             self._slots[slot] = _Prefilling(
                 q.ticket, q.request, q.submitted_at, q.deadline_at,
                 t_admit, chunks,
+                blocks_held=int(held(slot)) if held is not None else 0,
             )
             slot += 1
         if (not self._draining and not blocked_on_blocks
@@ -568,7 +609,15 @@ class Scheduler:
                     self._slots[other].bypassed += 1
             run = self._slots[s]
             run.bypassed = 0
+            # the chunk's measured seconds bill WHOLLY to this request
+            # (one chunk advances exactly one prefill) — the scheduler's
+            # own clock, so scripted backends and injected clocks in
+            # tests attribute the same way the engine path does
+            t_pf0 = self._clock()
             tok0 = self.backend.prefill_step(s)
+            pf_dt = self._clock() - t_pf0
+            self._prefill_s += pf_dt
+            run.prefill_device_s += pf_dt
             self._prefill_chunks += 1
             run.chunks_run += 1
             run.chunks_left = max(0, run.chunks_left - 1)
@@ -589,7 +638,9 @@ class Scheduler:
                 self._tokens_out += 1
                 live = _Running(run.ticket, run.request, run.submitted_at,
                                 run.deadline_at, run.admitted_at, t_first,
-                                [int(tok0)])
+                                [int(tok0)],
+                                prefill_device_s=run.prefill_device_s,
+                                blocks_held=run.blocks_held)
                 reason = self._finish_reason(live, t_first)
                 if reason is None:
                     self._slots[s] = live
@@ -618,13 +669,38 @@ class Scheduler:
             t0 = self._clock()
             toks = self.backend.step()
             t1 = self._clock()
-            self._decode_s += t1 - t0
-            self.hist_decode_tick.observe(t1 - t0)
+            tick_dt = t1 - t0
+            self._decode_s += tick_dt
+            self.hist_decode_tick.observe(tick_dt)
+            # interference window split: was prefill work pending while
+            # this decode tick ran? (staged chunks interleave with
+            # decode — the p50 gap between the two windows is the
+            # DistServe tier-split sizing signal)
+            if any(isinstance(r, _Prefilling) for r in self._slots):
+                self._tick_with_prefill.append(tick_dt)
+            else:
+                self._tick_no_prefill.append(tick_dt)
+            # normalize every slot's emission vector FIRST: the tick's
+            # measured seconds are apportioned over the slots it
+            # advanced, weighted by emitted positions (plain decode
+            # emits 1 per slot — an equal split; a verify tick's wider
+            # emissions carry proportionally more of the window). The
+            # weights sum the shares back to exactly tick_dt — no
+            # second dropped or double-billed, even when a slot
+            # finishes (stop/length/deadline) inside this very tick.
+            vecs: dict[int, list] = {}
             for s in live:
-                run = self._slots[s]
                 vec = toks[s]
                 if not isinstance(vec, (list, tuple, np.ndarray)):
                     vec = [vec]  # scalar-per-slot backends
+                vecs[s] = vec
+            wsum = sum(max(1, len(v)) for v in vecs.values())
+            for s in live:
+                run = self._slots[s]
+                vec = vecs[s]
+                run.decode_device_s += (
+                    tick_dt * max(1, len(vec)) / wsum
+                )
                 req = run.request
                 reason = None
                 emitted = 0
@@ -751,12 +827,21 @@ class Scheduler:
             self._served += 1
         self._finish(run.ticket, run.request, run.tokens, reason,
                      run.submitted_at, run.admitted_at, run.first_token_at,
-                     now)
+                     now,
+                     prefill_device_s=run.prefill_device_s,
+                     decode_device_s=run.decode_device_s,
+                     # blocks are allocated all-or-nothing at admission
+                     # and constant until release — the block-seconds
+                     # bill settles exactly here, at release time
+                     kv_block_seconds=run.blocks_held * (now - run.admitted_at))
 
     def _finish(self, ticket: Ticket, request: GenRequest, tokens: list[int],
                 reason: str, submitted_at: float, admitted_at: float | None,
                 first_token_at: float | None, now: float,
-                error: str | None = None) -> None:
+                error: str | None = None,
+                prefill_device_s: float = 0.0,
+                decode_device_s: float = 0.0,
+                kv_block_seconds: float = 0.0) -> None:
         result = {
             "rid": ticket.rid,
             "request_id": self._req_id(ticket, request),
@@ -776,9 +861,32 @@ class Scheduler:
                 now - first_token_at if first_token_at is not None else 0.0
             ),
             "total_s": now - submitted_at,
+            # attribution: THIS request's measured share of dispatch
+            # seconds (prefill chunks billed whole, decode/verify ticks
+            # apportioned by emitted positions) and its KV residency
+            # bill (blocks x seconds held) — the per-request cost line
+            "prefill_device_s": prefill_device_s,
+            "decode_device_s": decode_device_s,
+            "kv_block_seconds": kv_block_seconds,
         }
         if error is not None:
             result["error"] = error
+        # per-class cost rollup (the billing/capacity counters): one
+        # central accumulation point so every finish path — retire,
+        # expiry mid-prefill, instant-finish — bills identically.
+        # All-zero finishes (never-admitted drops) add nothing.
+        if prefill_device_s or decode_device_s or kv_block_seconds:
+            prio = int(request.priority)
+            with self._lock:
+                self._device_s_by_priority[prio] = (
+                    self._device_s_by_priority.get(prio, 0.0)
+                    + prefill_device_s + decode_device_s
+                )
+                if kv_block_seconds:
+                    self._kv_block_s_by_priority[prio] = (
+                        self._kv_block_s_by_priority.get(prio, 0.0)
+                        + kv_block_seconds
+                    )
         # black-box feed (obs/flightrec): one bounded event per request
         # outcome, so an engine-loop death dump shows the requests in
         # flight around the fatal tick. No-op without a recorder.
@@ -810,6 +918,10 @@ class Scheduler:
                 p: list(dq) for p, dq in self._ttft_by_priority.items()
             }
             shed_by_prio = dict(self._shed_by_priority)
+            device_s_by_prio = dict(self._device_s_by_priority)
+            kv_block_s_by_prio = dict(self._kv_block_s_by_priority)
+            ticks_with_pf = sorted(self._tick_with_prefill)
+            ticks_no_pf = sorted(self._tick_no_prefill)
         ttft = sorted(ttft_snapshot)
 
         def pct(p: float) -> float | None:
@@ -889,7 +1001,32 @@ class Scheduler:
             "hist_queue_wait_by_priority": {
                 p: h.snapshot() for p, h in sorted(prio_hists.items())
             },
+            # measured prefill dispatch seconds (chunk-billed; the
+            # decode counterpart is decode_s above) — with decode_s,
+            # the scheduler-level side of the reconciliation identity
+            "prefill_device_s": self._prefill_s,
+            # per-class cost counters: the billing and capacity-planning
+            # rollup of per-request attribution (device-seconds consumed
+            # and KV block-seconds held, by priority class)
+            "device_seconds_by_priority": {
+                p: round(v, 6) for p, v in sorted(device_s_by_prio.items())
+            },
+            "kv_block_seconds_by_priority": {
+                p: round(v, 6) for p, v in sorted(kv_block_s_by_prio.items())
+            },
         }
+        # decode-tick interference: p50 tick time with vs without
+        # staged prefill chunks pending — the DistServe-style
+        # prefill/decode tier-split sizing signal (ROADMAP item 1). Two
+        # scalars, not a histogram family: the ratio is the signal.
+        p50_w = nearest_rank_percentile(ticks_with_pf, 0.50)
+        p50_n = nearest_rank_percentile(ticks_no_pf, 0.50)
+        if p50_w is not None:
+            out["decode_tick_p50_with_prefill_s"] = p50_w
+        if p50_n is not None:
+            out["decode_tick_p50_no_prefill_s"] = p50_n
+        if p50_w is not None and p50_n is not None and p50_n > 0:
+            out["decode_interference_ratio"] = round(p50_w / p50_n, 4)
         # tensor-parallel degree (engines expose ``tp``; 1 = unsharded):
         # a gauge, so dashboards can tell a TP fleet member from a solo
         # replica without parsing flags. Fake/scripted backends without
@@ -919,4 +1056,12 @@ class Scheduler:
             spec = spec_stats()
             if spec is not None:
                 out["spec"] = spec
+        # per-program dispatch ledgers from the engine's accountant
+        # (device/compile seconds by kind:bucket:layout) — fakes
+        # without the accessor omit the key, same as spec/kv above
+        devtime_stats = getattr(self.backend, "devtime_stats", None)
+        if devtime_stats is not None:
+            dt = devtime_stats()
+            if dt is not None:
+                out["devtime"] = dt
         return out

@@ -60,6 +60,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
+from nanodiloco_tpu.obs.devtime import devtime_families
 from nanodiloco_tpu.obs.telemetry import (
     OPENMETRICS_CONTENT_TYPE,
     handle_profile_request,
@@ -324,6 +325,13 @@ class ServeServer:
                 "ttft_s": result["ttft_s"],
                 "decode_s": result["decode_s"],
                 "total_s": result["total_s"],
+                # attribution: this request's apportioned share of
+                # dispatch seconds and its KV residency bill — the
+                # per-request cost line, summable against the engine's
+                # per-program device-second counters
+                "prefill_device_s": result.get("prefill_device_s", 0.0),
+                "decode_device_s": result.get("decode_device_s", 0.0),
+                "kv_block_seconds": result.get("kv_block_seconds", 0.0),
             },
         }
         if self._tokenizer is not None:
@@ -497,6 +505,11 @@ class ServeServer:
             doc["kv_blocks_free"] = kv["blocks_free"]
         if s.get("deploy_generation") is not None:
             doc["deploy_generation"] = s["deploy_generation"]
+        # total attributed device-seconds (all classes): the router's
+        # per-replica cost gauge, riding the same one-GET probe
+        dev = s.get("device_seconds_by_priority")
+        if dev:
+            doc["device_seconds_total"] = round(sum(dev.values()), 6)
         if self._loop_error:
             doc["error"] = self._loop_error
         return (200 if alive else 503), doc
@@ -750,4 +763,41 @@ class ServeServer:
                  for p, v in sorted(ttft_by_prio.items())
                  if v is not None],
             ))
+        # per-class cost metering: device-seconds consumed and KV
+        # block-seconds held, rolled up from per-request attribution —
+        # the billing counters for the millions-of-users story
+        dev_by_prio = s.get("device_seconds_by_priority") or {}
+        if dev_by_prio:
+            families.append((
+                "nanodiloco_serve_device_seconds", "counter",
+                "attributed dispatch seconds (prefill + decode) by SLO "
+                "priority class, summed over finished requests",
+                [({"priority": str(p)}, v)
+                 for p, v in sorted(dev_by_prio.items())]
+                + [(None, round(sum(dev_by_prio.values()), 6))],
+            ))
+        kvbs_by_prio = s.get("kv_block_seconds_by_priority") or {}
+        if kvbs_by_prio:
+            families.append((
+                "nanodiloco_serve_kv_block_seconds", "counter",
+                "KV block-seconds held (blocks x residency time) by SLO "
+                "priority class, settled at release",
+                [({"priority": str(p)}, v)
+                 for p, v in sorted(kvbs_by_prio.items())]
+                + [(None, round(sum(kvbs_by_prio.values()), 6))],
+            ))
+        # decode-tick interference: the DistServe tier-split signal —
+        # p50 decode tick with vs without staged prefill chunks pending
+        if s.get("decode_interference_ratio") is not None:
+            families.append((
+                "nanodiloco_serve_decode_interference_ratio", "gauge",
+                "p50 decode tick time with pending prefill chunks / p50 "
+                "without (>1 = prefill interleave is stretching decode "
+                "ticks; the prefill/decode tier-split sizing signal)",
+                [(None, s["decode_interference_ratio"])],
+            ))
+        # per-program dispatch ledgers from the engine's accountant —
+        # one family definition (obs/devtime) shared with the trainer's
+        # telemetry endpoint so the exposition cannot drift
+        families.extend(devtime_families(s.get("devtime")))
         return render_exposition(families)

@@ -425,6 +425,32 @@ def summarize_run(path: str) -> dict[str, Any]:
             ):
                 if spec.get(key) is not None:
                     out[out_key] = spec[key]
+        # device-time attribution (PR 17, obs/devtime): the per-program
+        # dispatch ledgers, per-class cost totals, and the decode
+        # interference ratio — absent from older JSONLs, whose
+        # summaries are unchanged
+        dt = last.get("devtime")
+        if isinstance(dt, dict) and dt.get("device_seconds_by_program"):
+            out["device_seconds_by_program"] = (
+                dt["device_seconds_by_program"]
+            )
+        if isinstance(dt, dict) and dt.get("compile_seconds_by_program"):
+            out["compile_seconds_by_program"] = (
+                dt["compile_seconds_by_program"]
+            )
+        dbp = last.get("device_seconds_by_priority")
+        if isinstance(dbp, dict) and dbp:
+            out["device_seconds_by_priority"] = dbp
+            out["serve_device_seconds_total"] = round(
+                sum(dbp.values()), 6
+            )
+        kbp = last.get("kv_block_seconds_by_priority")
+        if isinstance(kbp, dict) and kbp:
+            out["kv_block_seconds_by_priority"] = kbp
+        if last.get("decode_interference_ratio") is not None:
+            out["decode_interference_ratio"] = (
+                last["decode_interference_ratio"]
+            )
     # fleet deployment (nanodiloco_tpu/fleet): the deploy-event timeline
     # a `fleet --events-jsonl` session writes — promote/rollback/eject
     # counts, the last promoted step, and the router's final fleet-
@@ -592,6 +618,14 @@ _COMPARE_METRICS = [
     # own threshold (max_slo_burn_increase_s). Gated only when both
     # summaries carry it, so SLO-less runs compare untouched.
     ("slo_burn_seconds", True),
+    # device-second cost per token (serve_bench capacity and surge
+    # records, obs/devtime attribution): gated BOTH directions on the
+    # latency band (_COST_KEYS) — costlier tokens are a regression, and
+    # a wildly CHEAPER number means the measurement window or the
+    # attribution broke (fence removed, sections skipped), not that the
+    # engine got 10x faster overnight. Gated only when both summaries
+    # carry it.
+    ("device_seconds_per_token", True),
 ]
 
 # share-of-wall-clock keys (already ratios): regress on an ABSOLUTE
@@ -615,6 +649,12 @@ _SHED_KEYS = {"shed_total"}
 # regress on an absolute move past max_slo_burn_increase_s in the key's
 # lower_better direction)
 _SLO_BURN_KEYS = {"slo_burn_seconds"}
+
+# per-token cost keys regress in BOTH directions on the relative
+# latency band: |delta| beyond max_latency_increase x baseline — unlike
+# _SHED_KEYS there is no count floor (the values are tiny fractions of
+# a second, a 1.0 floor would never gate)
+_COST_KEYS = {"device_seconds_per_token"}
 
 
 def load_comparable(path: str) -> dict[str, Any]:
@@ -685,6 +725,8 @@ def compare_runs(
             )
         elif key in _SHED_KEYS:
             regressed = abs(delta) > max_latency_increase * max(abs(b), 1.0)
+        elif key in _COST_KEYS:
+            regressed = abs(delta) > max_latency_increase * max(abs(b), 1e-12)
         elif key in _LATENCY_KEYS:
             regressed = delta > max_latency_increase * max(abs(b), 1e-12)
         elif lower_better:

@@ -38,6 +38,7 @@ from nanodiloco_tpu.data import DilocoBatcher, get_tokenizer, pack_corpus, synth
 from nanodiloco_tpu.models.config import LlamaConfig
 from nanodiloco_tpu.obs import SpanTracer, Watchdog, WatchdogConfig, set_tracer, trace_span
 from nanodiloco_tpu.obs import flightrec
+from nanodiloco_tpu.obs.devtime import DispatchAccountant
 from nanodiloco_tpu.obs.goodput import GoodputLedger
 from nanodiloco_tpu.parallel.diloco import Diloco, DilocoConfig
 from nanodiloco_tpu.parallel.mesh import MeshConfig, build_mesh
@@ -876,6 +877,15 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
         process_index=jax.process_index(),
     )
     prev_tracer = set_tracer(tracer)
+    # --- device-time accounting (obs/devtime) -------------------------------
+    # per-program dispatch ledgers for the training programs: the loop
+    # already fences and times its rounds/steps/syncs, so the
+    # accountant RECORDS those measured durations (no double-timing) —
+    # first dispatch of a key books as compile, the rest as device
+    # seconds. Snapshots ride the sync-step JSONL record ("devtime")
+    # and the telemetry /metrics families.
+    devtime_acct = DispatchAccountant()
+    devtime_layout = f"w{cfg.num_workers}"
     # --- crash flight recorder (obs/flightrec) ------------------------------
     # bounded black box of recent spans/heartbeats/records, dumped to
     # <log_dir>/<run>-blackbox.json on fatal watchdog alarms, unhandled
@@ -1474,6 +1484,13 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         if tracing:
                             _profiler_stop()
                     compute_time += round_s
+                    # the fused round IS one compiled program (scan over
+                    # inner steps + the outer sync): its fenced wall
+                    # time books whole — first round's lands as compile
+                    devtime_acct.record(
+                        "train_round", cfg.inner_steps, devtime_layout,
+                        round_s,
+                    )
                     state = dl._offload(state)
                     if cfg.measure_comm:
                         # Differenced estimate: warm full round minus warm
@@ -1675,7 +1692,8 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                                         {**wire_metrics,
                                          "wire_bytes_total": wire_bytes_total,
                                          **dyn_metrics, **mode_extras,
-                                         **elastic_extras}
+                                         **elastic_extras,
+                                         "devtime": devtime_acct.snapshot()}
                                         if i == cfg.inner_steps - 1 else {}
                                     ),
                                 },
@@ -1774,7 +1792,13 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                     jax.block_until_ready(loss)
                     if synced:
                         straggle_extras = _faults.maybe_straggle()
-                    compute_time += time.perf_counter() - t0
+                    step_s = time.perf_counter() - t0
+                    compute_time += step_s
+                    # streaming fuses fragment comm into the step — one
+                    # program, its fenced time books whole
+                    devtime_acct.record(
+                        "train_inner_step", 1, devtime_layout, step_s
+                    )
                 if synced:
                     state = dl._offload(state)
                     if ckpt and (
@@ -1805,7 +1829,11 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         # placement contract as the fused loop: the sleep
                         # lands inside the round's measured compute time)
                         straggle_extras = _faults.maybe_straggle()
-                    compute_time += time.perf_counter() - t0
+                    step_s = time.perf_counter() - t0
+                    compute_time += step_s
+                    devtime_acct.record(
+                        "train_inner_step", 1, devtime_layout, step_s
+                    )
                 if synced and async_on:
                     if pending_baux is not None:
                         # the PREVIOUS boundary's record: its program
@@ -1813,6 +1841,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         _log_async_boundary(pending_baux)
                         pending_baux = None
                     step_dyn = None
+                    t_b0 = time.perf_counter()
                     with trace_span("sync"), sync_timer:
                         # the explicit fence of the async contract sits
                         # at the APPLY: wait (only) for the merge
@@ -1822,6 +1851,13 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         # fence; jax's async dispatch lets the next inner
                         # step queue behind it immediately.
                         jax.block_until_ready(state.pending)
+                    devtime_acct.record(
+                        "train_boundary", cfg.inner_steps, devtime_layout,
+                        time.perf_counter() - t_b0,
+                        # the boundary program compiled on its LAUNCH, a
+                        # round ago — this fence never traces anything
+                        first_is_compile=False,
+                    )
                     if real_step == cfg.total_steps:
                         # final boundary + drain as ONE program — the
                         # SAME executable the fused loop flushes with:
@@ -1852,6 +1888,7 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                         quarantined_last_round = int(
                             cfg.num_workers - eff.sum()
                         )
+                    t_b0 = time.perf_counter()
                     with trace_span("sync"), sync_timer:
                         if dynamics_on:
                             state, step_dyn = dl.outer_step(state, round_ok)
@@ -1859,6 +1896,10 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                             state, step_dyn = dl.outer_step(state, round_ok), None
                         round_ok = None
                         jax.block_until_ready(state.params)
+                    devtime_acct.record(
+                        "train_boundary", cfg.inner_steps, devtime_layout,
+                        time.perf_counter() - t_b0,
+                    )
                     state = dl._offload(state)
                     if ckpt and (real_step // cfg.inner_steps) % cfg.checkpoint_every == 0:
                         _guarded_save(real_step, state)
@@ -1950,6 +1991,9 @@ def train(cfg: TrainConfig) -> dict[str, Any]:
                 sync_extras = {
                     **wire_metrics, "wire_bytes_total": wire_bytes_total,
                     **mode_extras,
+                    # per-program dispatch ledgers at every sync step —
+                    # the same key the fused path carries
+                    "devtime": devtime_acct.snapshot(),
                 }
                 if not streaming and dynamics_on and step_dyn is not None:
                     # host conversion OUTSIDE the sync timer (readout

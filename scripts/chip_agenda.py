@@ -77,6 +77,14 @@ ceremony:
      any ejection, the merged trace joins router and replica spans on
      the request_id key, and the alert counters scrape over the wire.
 
+  13. a device-time ATTRIBUTION drill (`devtime`): one replica under
+     mixed-priority traffic — the per-program dispatch counters
+     (`nanodiloco_device_seconds_total{program=...}`) and per-class
+     cost counters must be live over the wire, the summed per-request
+     `timing` attribution must reconcile with the scraped per-class
+     counter family, and `report dashboard` must render the offline
+     HTML artifact from the collector's series JSONL.
+
 Usage (each phase also runs alone):
     python scripts/chip_agenda.py               # everything
     python scripts/chip_agenda.py bench sweep   # named phases
@@ -2957,6 +2965,271 @@ def phase_autoscale_surge() -> None:
     })
 
 
+def phase_devtime() -> None:
+    """Device-time attribution drill on this backend: train a tiny
+    checkpoint, boot ONE `serve` replica, drive mixed-priority traffic
+    over a real socket, and hold the accounting plane to its ledger
+    over the wire: the per-program dispatch counters
+    (`nanodiloco_device_seconds_total{program="kind:bucket:layout"}`)
+    and the per-class cost counters
+    (`nanodiloco_serve_device_seconds_total{priority=...}`) must be
+    live on /metrics, the sum of every response's per-request `timing`
+    attribution (prefill_device_s + decode_device_s) must RECONCILE
+    with the scraped per-class counter total, and `report dashboard`
+    must render the offline HTML artifact from the series JSONL a
+    short `obs-watch` scrape wrote. On CPU this pins attribution
+    correctness and sum reconciliation end to end; absolute
+    device-second magnitudes belong to the chip sitting (PERF.md)."""
+    import signal as _signal
+    import socket
+    import tempfile
+
+    from nanodiloco_tpu.obs.telemetry import parse_metrics_text
+    from nanodiloco_tpu.serve.client import http_get, http_post_json
+
+    live = chip_is_live()
+    tmp = tempfile.mkdtemp(prefix="nanodiloco-devtime-")
+    ckpt = os.path.join(tmp, "ckpt")
+    series_jsonl = os.path.join(tmp, "series.jsonl")
+    dash_html = os.path.join(tmp, "dashboard.html")
+    model_cfg = os.path.join(tmp, "model.json")
+    with open(model_cfg, "w") as f:
+        json.dump({
+            "vocab_size": 2048, "hidden_size": 128, "intermediate_size": 256,
+            "num_attention_heads": 4, "num_hidden_layers": 2,
+            "max_position_embeddings": 256,
+        }, f)
+    budget = float(
+        os.environ.get("NANODILOCO_AGENDA_TIMEOUT_DEVTIME", "1200")
+    )
+    train = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu",
+         "--total-steps", "2", "--inner-steps", "2",
+         "--batch-size", "8", "--per-device-batch-size", "4",
+         "--seq-length", "256", "--warmup-steps", "2",
+         "--llama-config-file", model_cfg, "--no-measure-comm",
+         "--no-cost-analysis", "--quiet",
+         "--checkpoint-dir", ckpt, "--log-dir", tmp,
+         "--run-name", "devtime-probe"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=budget * 0.3,
+    )
+    if train.returncode != 0:
+        record({"phase": "devtime",
+                "error": (train.stderr or train.stdout)[-400:]})
+        raise SystemExit(1)
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    ports = {n: free_port() for n in ("r0", "watch")}
+    procs: dict = {}
+
+    def stop(proc):
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(_signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    procs["r0"] = subprocess.Popen(
+        [sys.executable, "-m", "nanodiloco_tpu", "serve",
+         "--checkpoint-dir", ckpt,
+         "--port", str(ports["r0"]), "--host", "127.0.0.1",
+         "--slots", "2", "--max-len", "128", "--chunk-size", "16",
+         "--max-new-tokens-cap", "64",
+         # paged KV: kv_block_seconds only bills when a block pool
+         # exists to hold — the dense path has no blocks to meter
+         "--kv-block-size", "16"],
+        cwd=REPO_ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.time() + budget * 0.3
+        up = False
+        while time.time() < deadline and procs["r0"].poll() is None:
+            try:
+                up = http_get(
+                    f"http://127.0.0.1:{ports['r0']}/healthz", timeout=3,
+                )[0] == 200
+            except OSError:
+                up = False
+            if up:
+                break
+            time.sleep(0.3)
+        if not up:
+            record({"phase": "devtime",
+                    "error": "replica never answered /healthz"})
+            raise SystemExit(1)
+        # mixed-priority traffic: every response's timing block carries
+        # its attributed share; the ledger must equal their sum
+        base_doc = {"token_ids": [(i * 7 + 3) % 256 for i in range(8)],
+                    "max_new_tokens": 6, "temperature": 0.0,
+                    "stop": False, "prefix_cache": False}
+        attributed = 0.0
+        kv_block_attr = 0.0
+        classes_seen = set()
+        for i in range(12):
+            prio = (0, 1, 3)[i % 3]
+            code, out = http_post_json(
+                f"http://127.0.0.1:{ports['r0']}/v1/generate",
+                {**base_doc, "seed": i, "priority": prio}, timeout=120,
+            )
+            if code != 200:
+                record({"phase": "devtime",
+                        "error": f"request {i} failed {code}"})
+                raise SystemExit(1)
+            timing = out.get("timing") or {}
+            attributed += (timing.get("prefill_device_s", 0.0)
+                           + timing.get("decode_device_s", 0.0))
+            kv_block_attr += timing.get("kv_block_seconds", 0.0)
+            classes_seen.add(prio)
+        if attributed <= 0.0:
+            record({"phase": "devtime",
+                    "error": "response timing blocks carried no "
+                             "attributed device seconds"})
+            raise SystemExit(1)
+        # the ledger over the wire: per-program dispatch counters live,
+        # per-class counters reconciling with the per-request sums
+        code, m_text = http_get(
+            f"http://127.0.0.1:{ports['r0']}/metrics", timeout=5
+        )
+        m = parse_metrics_text(m_text)
+        prog_samples = {k: v for k, v in m.items()
+                        if k.startswith("nanodiloco_device_seconds_total{")}
+        if not prog_samples or m.get(
+                "nanodiloco_device_seconds_total", 0.0) <= 0.0:
+            record({"phase": "devtime",
+                    "error": "per-program dispatch counters missing or "
+                             "zero on /metrics",
+                    "scraped": sorted(prog_samples)})
+            raise SystemExit(1)
+        # every serving program kind must have dispatched: decode and
+        # prefill_chunk always; this scrape is the proof the engine call
+        # sites are actually fenced, not just that the family renders
+        kinds = {k.split('program="', 1)[1].split(":", 1)[0]
+                 for k in prog_samples if 'program="' in k}
+        for want in ("prefill_chunk", "decode"):
+            if want not in kinds:
+                record({"phase": "devtime",
+                        "error": f"no {want!r} program in the dispatch "
+                                 "ledger", "kinds": sorted(kinds)})
+                raise SystemExit(1)
+        serve_total = m.get("nanodiloco_serve_device_seconds_total", 0.0)
+        by_class = {k: v for k, v in m.items() if k.startswith(
+            "nanodiloco_serve_device_seconds_total{")}
+        if len(by_class) != len(classes_seen):
+            record({"phase": "devtime",
+                    "error": "per-class cost counters do not cover the "
+                             "priority classes served",
+                    "classes": sorted(classes_seen),
+                    "scraped": sorted(by_class)})
+            raise SystemExit(1)
+        # reconciliation over the wire: the scraped counter is the same
+        # ledger the responses were billed from (stats() rounds each
+        # class to 1e-6), so the tolerance is rounding + slack only
+        tol = max(1e-3, 0.01 * attributed)
+        if abs(serve_total - attributed) > tol:
+            record({"phase": "devtime",
+                    "error": "attribution does not reconcile: "
+                             f"sum(timing)={attributed:.6f} vs "
+                             f"counter={serve_total:.6f} (tol {tol:.6f})"})
+            raise SystemExit(1)
+        if kv_block_attr <= 0.0 or m.get(
+                "nanodiloco_serve_kv_block_seconds_total", 0.0) <= 0.0:
+            record({"phase": "devtime",
+                    "error": "KV block-second billing missing (timing "
+                             f"sum {kv_block_attr:.6f}, counter "
+                             "absent or zero)"})
+            raise SystemExit(1)
+        # healthz carries the same total for the router's cost probe
+        code, body = http_get(
+            f"http://127.0.0.1:{ports['r0']}/healthz", timeout=5
+        )
+        health_total = json.loads(body).get("device_seconds_total")
+        if not health_total:
+            record({"phase": "devtime",
+                    "error": "healthz missing device_seconds_total"})
+            raise SystemExit(1)
+        # a short obs-watch sitting scrapes the ledger into the series
+        # JSONL the offline dashboard renders from
+        procs["watch"] = subprocess.Popen(
+            [sys.executable, "-m", "nanodiloco_tpu", "obs-watch",
+             "--target", f"r0=http://127.0.0.1:{ports['r0']}",
+             "--port", str(ports["watch"]), "--host", "127.0.0.1",
+             "--interval-s", "0.4",
+             # obs-watch refuses to run ruleless; a deliberately loose
+             # ceiling keeps the drill about collection, not alerting
+             "--ttft-p95-max", "60",
+             "--series-jsonl", series_jsonl],
+            cwd=REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        deadline = time.time() + budget * 0.2
+
+        def series_has_devtime():
+            if not os.path.exists(series_jsonl):
+                return False
+            with open(series_jsonl) as f:
+                return "nanodiloco_device_seconds_total" in f.read()
+
+        while time.time() < deadline and not series_has_devtime():
+            time.sleep(0.4)
+        if not series_has_devtime():
+            record({"phase": "devtime",
+                    "error": "obs-watch series JSONL never captured the "
+                             "dispatch counters"})
+            raise SystemExit(1)
+        # a couple more requests so the scraped series has a real trend
+        for i in range(4):
+            http_post_json(
+                f"http://127.0.0.1:{ports['r0']}/v1/generate",
+                {**base_doc, "seed": 100 + i, "priority": 1}, timeout=120,
+            )
+        time.sleep(1.0)
+    finally:
+        for name in ("watch", "r0"):
+            stop(procs.get(name))
+
+    # the offline artifact after shutdown: the dashboard must render
+    # with nothing running, straight from the series JSONL
+    dash = subprocess.run(
+        [sys.executable, "-m", "nanodiloco_tpu", "report", "dashboard",
+         series_jsonl, "-o", dash_html, "--title", "devtime drill"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    if dash.returncode != 0:
+        record({"phase": "devtime",
+                "error": f"report dashboard failed: {dash.stdout[-200:]}"
+                         f"{dash.stderr[-200:]}"})
+        raise SystemExit(1)
+    if not os.path.exists(dash_html):
+        record({"phase": "devtime",
+                "error": "dashboard artifact missing after render"})
+        raise SystemExit(1)
+    with open(dash_html) as f:
+        page = f.read()
+    if ("Device-second budget by program" not in page
+            or "nanodiloco_device_seconds_total" not in page):
+        record({"phase": "devtime",
+                "error": "dashboard page missing the device-second "
+                         "budget section"})
+        raise SystemExit(1)
+    record({
+        "phase": "devtime",
+        "backend_live": live,
+        "attributed_device_s": round(attributed, 6),
+        "counter_device_s": round(serve_total, 6),
+        "kv_block_seconds": round(kv_block_attr, 6),
+        "priority_classes": sorted(classes_seen),
+        "programs": sorted(prog_samples),
+        "healthz_device_seconds_total": health_total,
+        "dashboard_bytes": len(page),
+    })
+
+
 PHASES = {
     "bench": phase_bench,
     "sweep": phase_sweep,
@@ -2976,6 +3249,7 @@ PHASES = {
     "fleet": phase_fleet,
     "slo_watch": phase_slo_watch,
     "autoscale_surge": phase_autoscale_surge,
+    "devtime": phase_devtime,
 }
 
 
@@ -3026,6 +3300,7 @@ PHASE_TIMEOUT_S = {
     "fleet": 1800,
     "slo_watch": 1500,
     "autoscale_surge": 1800,
+    "devtime": 1200,
 }
 
 

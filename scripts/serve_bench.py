@@ -254,6 +254,23 @@ def _pct(sorted_vals: list[float], p: float) -> float | None:
     return nearest_rank_percentile(sorted_vals, p)
 
 
+def _device_seconds_per_token(results: list[dict]) -> float | None:
+    """Attributed device-seconds per completed token, from the
+    responses' ``timing`` blocks — the over-the-wire side of the
+    accountant's ledger. None when the server predates attribution or
+    nothing completed."""
+    dev_s = 0.0
+    tokens = 0
+    for r in results:
+        t = r.get("timing") or {}
+        dev_s += (t.get("prefill_device_s") or 0.0)
+        dev_s += (t.get("decode_device_s") or 0.0)
+        tokens += int(r.get("completion_tokens") or 0)
+    if not tokens or dev_s <= 0:
+        return None
+    return round(dev_s / tokens, 8)
+
+
 def _capacity_mode(args, cfg, params, mode: str, budget_bytes: int) -> dict:
     """Size ONE engine variant to the fixed KV HBM budget, admit
     identical requests until admission refuses (slots exhausted for
@@ -339,10 +356,16 @@ def _capacity_mode(args, cfg, params, mode: str, budget_bytes: int) -> dict:
             "--max-new-tokens for a longer window)",
             file=sys.stderr, flush=True,
         )
+    # device-second cost over the SAME measured window: the engine's
+    # dispatch accountant (obs/devtime) as a snapshot delta, so warmup
+    # and compile seconds stay out of the per-token number
+    dev0 = eng.accountant.total_device_seconds()
     t0 = time.monotonic()
     for _ in range(ticks):
         eng.step()
     dt = time.monotonic() - t0
+    dev_s = eng.accountant.total_device_seconds() - dev0
+    window_tokens = admitted * ticks
     return {
         "mode": mode,
         "max_concurrent_slots": admitted,
@@ -353,6 +376,10 @@ def _capacity_mode(args, cfg, params, mode: str, budget_bytes: int) -> dict:
             round(kv_bytes / (admitted * req_tokens), 1) if admitted else None
         ),
         "decode_tokens_per_sec": round(admitted * ticks / dt, 1) if dt else None,
+        "device_seconds_per_token": (
+            round(dev_s / window_tokens, 8)
+            if window_tokens and dev_s > 0 else None
+        ),
         **({"kv_pool_blocks": eng.block_pool.num_blocks,
             "kv_block_size": eng.kv_block_size} if eng.paged else {}),
     }
@@ -386,6 +413,12 @@ def run_capacity(args, cfg, params, jax) -> None:
         # the gated contract: paged-int8 at the fixed budget
         "max_concurrent_slots": int8["max_concurrent_slots"],
         "kv_hbm_bytes_per_token": int8["kv_hbm_bytes_per_token"],
+        # device-second cost per decoded token at capacity (paged-int8
+        # headline, accountant snapshot delta over the timed window) —
+        # gated BOTH directions in report compare: costlier tokens are
+        # a regression, and a wildly cheaper number means the window
+        # stopped measuring what it claims
+        "device_seconds_per_token": int8.get("device_seconds_per_token"),
         "capacity_ratio_int8_vs_dense": (
             round(int8["max_concurrent_slots"]
                   / dense["max_concurrent_slots"], 2)
@@ -853,6 +886,11 @@ def run_surge(args, cfg, params, jax) -> None:
         ),
         "shed_by_class": shed_by_class,
         "shed_responses_seen": len(shed),
+        # device-second cost per completed token OVER THE WIRE: summed
+        # from each response's attribution timing block — the same
+        # ledger the engine accountant keeps, arriving via the client
+        # path (reconciliation is pinned by test; gated both ways)
+        "device_seconds_per_token": _device_seconds_per_token(results),
         "scale_up_events": events.get("scale_up", 0),
         "scale_down_events": events.get("scale_down", 0),
         "preempt_resume_events": events.get("preempt_resume", 0),

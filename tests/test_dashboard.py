@@ -1,0 +1,255 @@
+"""The offline dashboard plane: the SeriesStore's long-horizon
+retention tier, ``render_dashboard``'s self-contained HTML, the
+serve-stats series synthesis, artifact flavor auto-detection, the
+``report dashboard`` CLI, and the committed sample artifact."""
+
+import json
+import os
+
+import pytest
+
+from nanodiloco_tpu.obs.collector import SeriesStore, read_series_jsonl
+from nanodiloco_tpu.obs.dashboard import (
+    load_dashboard_series,
+    render_dashboard,
+    serve_stats_series,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SAMPLE = os.path.join(REPO, "runs", "sample_series.jsonl")
+
+
+# -- the long-horizon retention tier -----------------------------------------
+
+
+def test_long_tier_downsamples_one_point_per_bucket():
+    st = SeriesStore(maxlen=4, long_bucket_s=10.0)
+    # 35 seconds of 1 Hz samples; the fine ring (maxlen=4) wraps, the
+    # long tier keeps one point per 10 s bucket — the bucket's LAST
+    # value, stamped at the bucket start
+    for i in range(35):
+        st.add("k", float(i), float(i * 2))
+    long = st.long_window("k", float("-inf"))
+    assert long == [(0.0, 18.0), (10.0, 38.0), (20.0, 58.0), (30.0, 68.0)]
+    # the fine ring only remembers the newest maxlen samples
+    assert len(st.window("k", float("-inf"))) == 4
+
+
+def test_long_tier_includes_the_open_bucket():
+    st = SeriesStore(long_bucket_s=60.0)
+    st.add("k", 5.0, 1.0)
+    st.add("k", 6.0, 2.0)
+    # no bucket has closed yet — the open bucket still shows up,
+    # carrying its latest value
+    assert st.long_window("k", float("-inf")) == [(0.0, 2.0)]
+    st.add("k", 65.0, 3.0)
+    assert st.long_window("k", float("-inf")) == [(0.0, 2.0), (60.0, 3.0)]
+
+
+def test_long_tier_is_bounded():
+    st = SeriesStore(long_bucket_s=1.0, long_maxlen=5)
+    for i in range(100):
+        st.add("k", float(i), float(i))
+    long = st.long_window("k", float("-inf"))
+    # 5 closed buckets + the open one
+    assert len(long) == 6
+    assert long[-1] == (99.0, 99.0)
+
+
+def test_long_window_bounds_and_snapshot():
+    st = SeriesStore(long_bucket_s=10.0)
+    for i in range(50):
+        st.add("a", float(i), float(i))
+        st.add("b", float(i), float(-i))
+    assert all(t >= 20.0 for t, _ in st.long_window("a", 20.0))
+    assert all(t <= 30.0 for t, _ in st.long_window("a", 0.0, 30.0))
+    snap = st.long_snapshot()
+    assert set(snap) == {"a", "b"}
+    assert snap["a"] == st.long_window("a", float("-inf"))
+
+
+def test_long_tier_validation():
+    with pytest.raises(ValueError, match="long_bucket_s"):
+        SeriesStore(long_bucket_s=0.0)
+    with pytest.raises(ValueError, match="long_maxlen"):
+        SeriesStore(long_maxlen=0)
+
+
+# -- render_dashboard --------------------------------------------------------
+
+
+def _series():
+    return {
+        'r0:nanodiloco_device_seconds_total{program="decode:1:dense"}':
+            [(0.0, 0.1), (1.0, 0.3), (2.0, 0.7)],
+        'r0:nanodiloco_serve_device_seconds_total{priority="0"}':
+            [(0.0, 0.05), (1.0, 0.15)],
+        "r0:nanodiloco_kv_blocks_free":
+            [(0.0, 90.0), (1.0, 60.0), (2.0, 30.0)],
+        "router:nanodiloco_fleet_goodput_fraction":
+            [(0.0, 1.0), (1.0, 0.97)],
+        'watch:nanodiloco_slo_burning{rule="ttft_p95",target="r0"}':
+            [(0.0, 0.0), (1.0, 1.0)],
+        "r0:nanodiloco_serve_tokens_total":
+            [(0.0, 10.0), (1.0, 48.0)],
+    }
+
+
+def test_dashboard_routes_series_to_sections():
+    page = render_dashboard(_series(), title="t")
+    for section in ("SLO burn", "Fleet goodput",
+                    "Device-second budget by program", "Cost per class",
+                    "Capacity forecast"):
+        assert section in page
+    # the tokens counter matches no section needle — the catchall keeps
+    # it visible instead of dropping it
+    assert "Other series" in page
+    assert "nanodiloco_serve_tokens_total" in page
+    # section membership: the device-second key renders after its
+    # section header and before the next one
+    dev_at = page.index("Device-second budget by program")
+    cost_at = page.index("Cost per class")
+    key_at = page.index("decode:1:dense")
+    assert dev_at < key_at < cost_at
+
+
+def test_dashboard_is_fully_offline_and_self_contained():
+    page = render_dashboard(_series())
+    assert "<script" not in page
+    assert "http://" not in page and "https://" not in page
+    assert 'src="' not in page and "@import" not in page
+    assert "<style>" in page  # inline CSS only
+    assert page.startswith("<!DOCTYPE html>")
+    # unicode sparklines made it in
+    assert any(c in page for c in "▁▂▃▄▅▆▇█")
+
+
+def test_dashboard_forecast_reports_slope_and_eta():
+    # kv_blocks_free drains 30/s from 90 — exhaustion in ~1 s past the
+    # last sample; the forecast table must show a negative slope and a
+    # finite ETA
+    page = render_dashboard(_series())
+    assert "Theil-Sen slope" in page
+    assert "-30/s" in page
+    assert "exhaustion ETA" in page
+    assert "1s" in page
+
+
+def test_dashboard_escapes_html_in_keys_and_title():
+    page = render_dashboard(
+        {"r0:<b>sneaky</b>": [(0.0, 1.0)]}, title='a<script>"x"'
+    )
+    assert "<b>sneaky</b>" not in page
+    assert "&lt;b&gt;sneaky&lt;/b&gt;" in page
+    assert "<script>" not in page
+
+
+def test_dashboard_empty_sections_say_so():
+    page = render_dashboard({"r0:nanodiloco_loss": [(0.0, 2.0)]})
+    assert "no matching series in this artifact" in page
+
+
+# -- serve-stats synthesis + flavor auto-detection ---------------------------
+
+
+def _write_serve_stats(path, with_t_unix=True):
+    recs = []
+    for i in range(3):
+        r = {
+            "serve_stats": True,
+            "queue_depth": i,
+            "slots_busy": 2,
+            "devtime": {
+                "device_seconds_by_program": {"decode:1:dense": 0.1 * (i + 1)},
+                "compile_seconds_by_program": {"decode:1:dense": 1.5},
+            },
+            "device_seconds_by_priority": {"0": 0.02 * (i + 1)},
+            "kv_block_seconds_by_priority": {"0": 1.1 * (i + 1)},
+            "kv_pool": {"blocks_free": 50 - i, "blocks_used": 10 + i},
+        }
+        if with_t_unix:
+            r["t_unix"] = 100.0 + i
+        recs.append(r)
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_serve_stats_series_expands_attribution_ledgers(tmp_path):
+    p = tmp_path / "stats.jsonl"
+    _write_serve_stats(p)
+    series = serve_stats_series(str(p))
+    dev = series['serve:nanodiloco_device_seconds_total'
+                 '{program="decode:1:dense"}']
+    assert dev == [(100.0, 0.1), (101.0, pytest.approx(0.2)),
+                   (102.0, pytest.approx(0.3))]
+    assert ('serve:nanodiloco_serve_device_seconds_total{priority="0"}'
+            in series)
+    assert ('serve:nanodiloco_serve_kv_block_seconds_total{priority="0"}'
+            in series)
+    assert series["serve:nanodiloco_kv_blocks_free"][0] == (100.0, 50.0)
+    assert series["serve:queue_depth"] == [(100.0, 0.0), (101.0, 1.0),
+                                           (102.0, 2.0)]
+
+
+def test_serve_stats_series_older_jsonl_uses_record_order(tmp_path):
+    p = tmp_path / "stats.jsonl"
+    _write_serve_stats(p, with_t_unix=False)
+    series = serve_stats_series(str(p))
+    assert [t for t, _ in series["serve:queue_depth"]] == [0.0, 1.0, 2.0]
+
+
+def test_load_dashboard_series_autodetects_both_flavors(tmp_path):
+    serve_p = tmp_path / "stats.jsonl"
+    _write_serve_stats(serve_p)
+    assert "serve:queue_depth" in load_dashboard_series(str(serve_p))
+    coll_p = tmp_path / "series.jsonl"
+    with open(coll_p, "w") as f:
+        f.write(json.dumps({"series": "r0", "t_unix": 1.0,
+                            "samples": {"nanodiloco_loss": 2.5}}) + "\n")
+    assert load_dashboard_series(str(coll_p)) == {
+        "r0:nanodiloco_loss": [(1.0, 2.5)]
+    }
+
+
+def test_load_dashboard_series_fails_loudly_on_garbage(tmp_path):
+    p = tmp_path / "not_an_artifact.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"loss": 2.0, "step": 1}) + "\n")
+    with pytest.raises(ValueError, match="neither"):
+        load_dashboard_series(str(p))
+
+
+# -- the CLI + the committed sample artifact ---------------------------------
+
+
+def test_report_dashboard_cli_end_to_end(tmp_path, capsys):
+    from nanodiloco_tpu.cli import report_dashboard_main
+
+    out = tmp_path / "sub" / "dash.html"
+    report_dashboard_main([SAMPLE, "-o", str(out), "--title", "drill"])
+    assert out.exists()
+    page = out.read_text()
+    assert "drill" in page and "<script" not in page
+    printed = capsys.readouterr().out
+    assert "rendered" in printed and str(out) in printed
+
+
+def test_committed_sample_renders_every_section():
+    """The committed artifact is the offline-render acceptance fixture:
+    it must carry enough of the fleet's families that NO dashboard
+    section comes up empty."""
+    series = read_series_jsonl(SAMPLE)
+    assert series, "runs/sample_series.jsonl is missing or empty"
+    page = render_dashboard(series, title="sample fleet")
+    assert "no matching series in this artifact" not in page
+    # keys are HTML-escaped in the page, so match the escaped spelling
+    for needle in (
+        "nanodiloco_device_seconds_total{program=&quot;"
+        "decode:1:paged-int8&quot;}",
+        "nanodiloco_serve_device_seconds_total{priority=&quot;0&quot;}",
+        "nanodiloco_slo_burn_seconds_total{rule=&quot;ttft_p95&quot;}",
+        "nanodiloco_fleet_goodput_fraction",
+        "nanodiloco_kv_blocks_free",
+    ):
+        assert needle in page

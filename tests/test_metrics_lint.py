@@ -1,0 +1,228 @@
+"""Metrics-name lint (tier-1): walk the package source for every
+``nanodiloco_*`` metric family and hold the exposition namespace to its
+contract — rendered sample names globally unique (no family may collide
+with another family's ``_total``/``_bucket``/``_count``/``_sum``
+rendering), every label key drawn from a BOUNDED allowlist (a
+``request_id``-like label would mint one series per request and melt
+any scrape store), every consumer-side metric-name reference resolving
+to a family some producer actually renders, and every family documented
+in README's metrics tables. Each assertion fails naming the offender
+and its definition site.
+
+The scan is static (ast + regex over ``nanodiloco_tpu/``), matching the
+three definition idioms in the tree: typed family tuples
+``(name, "counter"|"gauge"|"histogram", help, samples)``, untyped
+gauge-list entries ``(name, "help text", value...)`` (the help is prose
+— it contains a space, which is what separates a definition from a
+section-needle tuple), and gauge-dict assignments
+``gauges["nanodiloco_x"] = v``."""
+
+import ast
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "nanodiloco_tpu")
+
+METRIC_TYPES = {"counter", "gauge", "histogram"}
+
+# every label key any family may use. Additions need a README table row
+# AND an entry here — the point is that adding an unbounded-cardinality
+# label (request_id, prompt hash, ...) is a loud, reviewed decision,
+# never an accident.
+LABEL_ALLOWLIST = {
+    "outcome", "reason", "result", "priority", "shard", "worker",
+    "target", "kind", "op", "cause", "phase", "event", "state",
+    "replica", "rule", "program",
+    "le",  # histogram bucket bound (rendered by the exposition layer)
+}
+
+# names that are legitimately NOT metric families
+NON_METRIC_NAMES = {"nanodiloco_tpu"}  # the package itself
+
+
+def _scan():
+    """(defs, refs): definition sites {name: [(file, line, type)]} with
+    label keys {name: set}, and every other nanodiloco_* string literal
+    as a reference [(name, file)]."""
+    defs: dict[str, list] = {}
+    labels: dict[str, set] = {}
+    refs: list[tuple[str, str]] = []
+    for dirpath, _dirs, files in os.walk(PKG):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, REPO)
+            with open(path) as f:
+                src = f.read()
+            tree = ast.parse(src)
+            claimed: set[str] = set()
+
+            def add_def(name, lineno, mtype):
+                defs.setdefault(name, []).append((rel, lineno, mtype))
+                claimed.add(name)
+                labels.setdefault(name, set())
+
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Tuple) and len(node.elts) >= 2:
+                    e0, e1 = node.elts[0], node.elts[1]
+                    if not (isinstance(e0, ast.Constant)
+                            and isinstance(e0.value, str)
+                            and e0.value.startswith("nanodiloco_")):
+                        continue
+                    name = e0.value
+                    if (isinstance(e1, ast.Constant)
+                            and e1.value in METRIC_TYPES):
+                        add_def(name, node.lineno, e1.value)
+                        for sub in ast.walk(node):
+                            if isinstance(sub, ast.Dict):
+                                for k in sub.keys:
+                                    if (isinstance(k, ast.Constant)
+                                            and isinstance(k.value, str)):
+                                        labels[name].add(k.value)
+                    elif (isinstance(e1, ast.Constant)
+                          and isinstance(e1.value, str)
+                          and " " in e1.value):
+                        # (name, "help text", ...) — untyped gauge-list /
+                        # _GAUGE_KEYS entry; a 4-tuple's third string
+                        # element is the loop's label key
+                        add_def(name, node.lineno, "untyped")
+                        if len(node.elts) >= 4:
+                            e2 = node.elts[2]
+                            if (isinstance(e2, ast.Constant)
+                                    and isinstance(e2.value, str)):
+                                labels[name].add(e2.value)
+                elif isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if (isinstance(tgt, ast.Subscript)
+                                and isinstance(tgt.slice, ast.Constant)
+                                and isinstance(tgt.slice.value, str)
+                                and tgt.slice.value.startswith(
+                                    "nanodiloco_")):
+                            add_def(tgt.slice.value, node.lineno, "untyped")
+            for m in re.finditer(r'"(nanodiloco_[a-z0-9_]+)"', src):
+                if m.group(1) not in claimed:
+                    refs.append((m.group(1), rel))
+    return defs, labels, refs
+
+
+@pytest.fixture(scope="module")
+def scan():
+    return _scan()
+
+
+def test_scan_finds_the_namespace(scan):
+    """Sanity pin: the scan sees the known core families — if a
+    refactor moves definitions to an idiom the scan can't parse, this
+    fails before the other checks silently pass on nothing."""
+    defs, _labels, _refs = scan
+    for expected in ("nanodiloco_serve_requests", "nanodiloco_loss",
+                     "nanodiloco_device_seconds", "nanodiloco_slo_alerts",
+                     "nanodiloco_fleet_replicas_serving"):
+        assert expected in defs, f"scan lost sight of {expected}"
+    assert len(defs) >= 50
+
+
+def test_family_names_globally_unique(scan):
+    """One name, one family: a name defined under two different metric
+    types is two families fighting over one exposition line. Same-type
+    definitions at multiple sites are allowed (the replica gauge and
+    the router's fleet view render the same family about different
+    processes)."""
+    defs, _labels, _refs = scan
+    for name, sites in sorted(defs.items()):
+        types = {t for _f, _l, t in sites if t in METRIC_TYPES}
+        assert len(types) <= 1, (
+            f"{name} is defined as {sorted(types)} at "
+            f"{[(f, l) for f, l, _ in sites]} — one family name, one type"
+        )
+
+
+def test_rendered_sample_names_cannot_collide(scan):
+    """The exposition renders counters as ``X_total`` and histograms as
+    ``X_bucket``/``X_count``/``X_sum``: no family's rendered names may
+    collide with another family's. Untyped (gauge-list) definitions
+    claim both ``X`` and ``X_total`` — conservative, so an idiom the
+    scan cannot type still cannot introduce a collision."""
+    defs, _labels, _refs = scan
+    rendered: dict[str, str] = {}
+    for name, sites in sorted(defs.items()):
+        types = {t for _f, _l, t in sites}
+        if types == {"untyped"}:
+            forms = [name, name + "_total"]
+        elif "counter" in types:
+            forms = [name + "_total"]
+        elif "histogram" in types:
+            forms = [name + "_bucket", name + "_count", name + "_sum"]
+        else:
+            forms = [name]
+        for form in forms:
+            owner = rendered.get(form)
+            assert owner is None or owner == name, (
+                f"rendered sample name {form!r} is claimed by BOTH "
+                f"{owner} and {name} ({[s[:2] for s in defs[name]]})"
+            )
+            rendered[form] = name
+
+
+def test_label_keys_come_from_the_bounded_allowlist(scan):
+    """No unbounded-cardinality labels: every label key in every family
+    must be in LABEL_ALLOWLIST. A request_id/prompt-derived label mints
+    a series per request and melts the collector's ring buffers."""
+    defs, labels, _refs = scan
+    for name in sorted(labels):
+        rogue = labels[name] - LABEL_ALLOWLIST
+        assert not rogue, (
+            f"{name} (defined at {[s[:2] for s in defs[name]]}) uses "
+            f"label key(s) {sorted(rogue)} outside the allowlist "
+            f"{sorted(LABEL_ALLOWLIST)} — bounded label sets only; "
+            "extending the allowlist is a reviewed decision"
+        )
+
+
+def test_metric_name_references_resolve_to_real_families(scan):
+    """Consumer-side references (SLO rules, the autoscaler's forecast
+    keys, dashboard section needles) must name a family some producer
+    renders — a watcher keyed to a metric nobody emits alarms on
+    nothing, forever. Prefix needles (trailing ``_``) and counter
+    ``_total`` spellings resolve against the definition set."""
+    defs, _labels, refs = scan
+    counterish = {
+        n for n, sites in defs.items()
+        if any(t in ("counter", "untyped") for _f, _l, t in sites)
+    }
+    bad = []
+    for name, rel in refs:
+        if name in defs or name in NON_METRIC_NAMES:
+            continue
+        if name.endswith("_total") and name[:-len("_total")] in counterish:
+            continue
+        if name.endswith("_"):  # prefix needle (dashboard sections)
+            if any(d.startswith(name) for d in defs):
+                continue
+        bad.append((name, rel))
+    assert not bad, (
+        f"metric-name references that resolve to NO defined family: "
+        f"{sorted(set(bad))}"
+    )
+
+
+def test_every_family_documented_in_readme(scan):
+    """README's metrics tables are the operator contract: every defined
+    family name must appear there. A new family without a table row
+    fails HERE, naming itself — documentation is part of adding a
+    metric, not a follow-up."""
+    defs, _labels, _refs = scan
+    with open(os.path.join(REPO, "README.md")) as f:
+        readme = f.read()
+    missing = sorted(n for n in defs if n not in readme)
+    assert not missing, (
+        "families missing from README's metrics tables: "
+        + ", ".join(missing)
+        + " — add a row (name, type, labels, meaning) to README.md"
+    )
