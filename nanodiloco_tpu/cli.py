@@ -896,6 +896,50 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                         "({\"shed\": true}); 9 (default) admits every "
                         "class. The autoscaler moves this under "
                         "pressure")
+    p.add_argument("--hedge-after-s", type=float, default=None,
+                   metavar="S",
+                   help="launch a hedge attempt on a second replica "
+                        "when the first is this slow; unset = adaptive "
+                        "(p95 of recent winner latencies once enough "
+                        "samples exist); 0 disables hedging. First "
+                        "answer wins, the loser is cancelled via "
+                        "/v1/cancel")
+    p.add_argument("--retry-budget-ratio", type=float, default=0.2,
+                   help="retry-budget token-bucket refill per success "
+                        "(retries admitted as a fraction of recent "
+                        "successes; an empty bucket returns the "
+                        "replica's honest error instead of amplifying "
+                        "overload)")
+    p.add_argument("--retry-budget-min", type=float, default=3.0,
+                   help="retry-budget floor: failovers that never wait "
+                        "on prior successes")
+    p.add_argument("--breaker-window", type=int, default=20,
+                   help="per-replica circuit-breaker rolling sample "
+                        "window")
+    p.add_argument("--breaker-min-samples", type=int, default=5,
+                   help="samples in window before the breaker may trip")
+    p.add_argument("--breaker-failure-rate", type=float, default=0.5,
+                   help="bad fraction of the window that trips the "
+                        "breaker (route-around, never ejection)")
+    p.add_argument("--breaker-open-s", type=float, default=10.0,
+                   help="seconds a tripped breaker stays open before "
+                        "the half-open single-probe request")
+    p.add_argument("--breaker-slow-s", type=float, default=None,
+                   metavar="S",
+                   help="count 200s slower than this as breaker "
+                        "failures (a replica can be sick without "
+                        "erroring); unset = errors only")
+    p.add_argument("--chaos-plan", type=str, default=None,
+                   metavar="JSON",
+                   help="chaos drill: a fleet/chaos.py fault-plan file; "
+                        "every replica is fronted by an in-process "
+                        "ChaosProxy realizing the plan's wire faults "
+                        "(latency, reset, blackhole, 500s, flapping "
+                        "healthz, kill) keyed by per-replica request/"
+                        "probe ordinals. Injections append {\"chaos\": "
+                        "kind} records to --events-jsonl. kill faults "
+                        "are record-only here (the CLI does not own the "
+                        "replica processes) plus the wire abort")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -915,6 +959,23 @@ def fleet_main(argv: list[str]) -> None:
             name=f"r{i}", url=url.rstrip("/"),
             blackbox=blackbox or None,
         ))
+    chaos_plan = None
+    chaos_proxies = []
+    if args.chaos_plan:
+        from nanodiloco_tpu.fleet.chaos import ChaosPlan, proxy_fleet
+
+        chaos_plan = ChaosPlan.load(args.chaos_plan)
+        # the router is pointed at the proxies, not the replicas: every
+        # fault crosses a real socket, exactly as production would see
+        # it. No on_kill — the CLI fronts replicas it does not own, so
+        # kill faults are record-only plus the wire abort.
+        replicas, chaos_proxies = proxy_fleet(replicas, chaos_plan)
+        print(
+            f"chaos drill: {len(chaos_plan.faults)} fault(s) from "
+            f"{args.chaos_plan} on the wire in front of "
+            f"{len(replicas)} replica(s)",
+            flush=True,
+        )
     tracer = None
     if args.trace_out:
         from nanodiloco_tpu.obs import SpanTracer
@@ -931,6 +992,14 @@ def fleet_main(argv: list[str]) -> None:
         health_interval_s=args.health_interval_s,
         eject_after_failures=args.eject_after,
         drain_timeout_s=args.drain_timeout_s,
+        hedge_after_s=args.hedge_after_s,
+        retry_budget_ratio=args.retry_budget_ratio,
+        retry_budget_min=args.retry_budget_min,
+        breaker_window=args.breaker_window,
+        breaker_min_samples=args.breaker_min_samples,
+        breaker_failure_rate=args.breaker_failure_rate,
+        breaker_open_s=args.breaker_open_s,
+        breaker_slow_s=args.breaker_slow_s,
         tracer=tracer,
         quiet=args.quiet,
     ).start()
@@ -1047,8 +1116,25 @@ def fleet_main(argv: list[str]) -> None:
             signal.signal(sig, lambda *_: stop.set())
         except ValueError:  # not the main thread (embedded use)
             break
+    def _drain_chaos() -> None:
+        # fired-fault records -> the events JSONL ({"chaos": kind, ...}
+        # timeline summarize_run reads); without a JSONL the record
+        # still printed once per injection for the operator
+        if chaos_plan is None:
+            return
+        for rec in chaos_plan.drain_fired():
+            if args.events_jsonl:
+                try:
+                    with open(args.events_jsonl, "a") as f:
+                        f.write(json.dumps(rec) + "\n")
+                except OSError:
+                    pass  # a full disk must not kill the drill
+            if not args.quiet:
+                print(f"chaos injected: {json.dumps(rec)}", flush=True)
+
     try:
         while not stop.is_set():
+            _drain_chaos()
             time.sleep(0.2)
     finally:
         stop.set()
@@ -1059,6 +1145,9 @@ def fleet_main(argv: list[str]) -> None:
         if provider is not None:
             provider.stop_all()
         router.stop()
+        for proxy in chaos_proxies:
+            proxy.stop()
+        _drain_chaos()
         if tracer is not None:
             try:
                 tracer.export_chrome(args.trace_out)

@@ -17,7 +17,12 @@ Three coupled pieces over the serving stack (nanodiloco_tpu/serve):
 - ``deploy.DeployController`` — watches the training checkpoint dir,
   canaries each fresh checkpoint on one replica (closed-loop bench +
   held-out eval loss), and promotes fleet-wide only on a passing
-  ``report compare`` verdict — automatic rollback on regression.
+  ``report compare`` verdict — automatic rollback on regression;
+- ``chaos.ChaosProxy`` + ``chaos.ChaosPlan`` — a deterministic wire-
+  level fault injector (the ``resilience/faults.py`` pattern, keyed by
+  request ordinal) that sits in front of a real replica so the router's
+  resilience stack (deadlines, hedging, retry budget, circuit breakers)
+  is drill-verified, not review-anecdote.
 
 ``python -m nanodiloco_tpu fleet --replica URL[,BLACKBOX] ...`` boots
 the router (+ the controller with ``--watch-checkpoint-dir``).
@@ -27,6 +32,13 @@ from nanodiloco_tpu.fleet.autoscaler import (
     Autoscaler,
     ProcessReplicaProvider,
     ReplicaProvider,
+)
+from nanodiloco_tpu.fleet.chaos import (
+    DRILL_PLAN,
+    ChaosPlan,
+    ChaosProxy,
+    chaos_families,
+    proxy_fleet,
 )
 from nanodiloco_tpu.fleet.deploy import (
     DeployController,
@@ -38,6 +50,9 @@ from nanodiloco_tpu.fleet.router import EVENT_KINDS, FleetRouter, Replica
 
 __all__ = [
     "Autoscaler",
+    "ChaosPlan",
+    "ChaosProxy",
+    "DRILL_PLAN",
     "DeployController",
     "EVENT_KINDS",
     "FleetRouter",
@@ -46,5 +61,7 @@ __all__ = [
     "ReplicaProvider",
     "canary_bench",
     "canary_eval_loss",
+    "chaos_families",
     "latest_checkpoint_step",
+    "proxy_fleet",
 ]

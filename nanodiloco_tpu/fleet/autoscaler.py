@@ -212,6 +212,14 @@ class Autoscaler:
                                       kind="preempt_resume")
             rec.setdefault("preempt_resumed", []).extend(relaunched)
 
+        # breaker-open replicas are not credible supply: they still
+        # scrape (gray failure, not dead), but counting them would let
+        # the model see capacity the router is routing around
+        breaker = getattr(self.router, "breaker_open_replicas", None)
+        excl = getattr(self.model, "set_excluded", None)
+        if callable(breaker) and callable(excl):
+            excl(breaker())
+
         est = self.model.estimate(now)
         rec["estimate"] = est.to_dict()
         size = self._fleet_size()

@@ -38,6 +38,19 @@ discipline, arXiv:2402.15627, applied to serving):
   across the fleet, including the autoscaler's transition seconds.
   Every promote/rollback/eject/drain/swap event lands in the deploy
   JSONL (``events_jsonl``) read by ``summarize_run`` / ``report``.
+- **Request-level resilience.** End-to-end deadline propagation (a
+  client ``timeout_s`` bounds the whole request and rides to the
+  replica as ``deadline_s``, so the scheduler's expiry stops decoding
+  for departed clients), hedged requests (p95-derived hedge delay,
+  first answer wins, the loser cancelled through ``/v1/cancel`` so its
+  slot and KV blocks free), a token-bucket retry budget (retries and
+  hedges capped as a fraction of recent successes — overload degrades
+  into honest errors, never a retry storm), and a per-replica circuit
+  breaker (rolling failure/slow-rate window, half-open single-probe
+  recovery) that catches the slow-but-200 gray failures the binary
+  healthz eject cannot — feeding route-around and the ``breaker_open``
+  goodput bucket, never ejection. Drilled end-to-end by
+  ``fleet/chaos.py``.
 - **Elastic membership + class-aware admission.** ``add_replica`` /
   ``remove_replica`` let the autoscaler (``fleet/autoscaler.py``) grow
   and shrink the fleet through the same drain discipline as a weight
@@ -55,10 +68,13 @@ no sockets, no model (tests/test_fleet.py).
 from __future__ import annotations
 
 import dataclasses
+import http.client
 import json
 import os
+import queue
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
@@ -66,6 +82,7 @@ from nanodiloco_tpu.obs import flightrec
 from nanodiloco_tpu.obs.goodput import FLEET_STATE_CAUSES
 from nanodiloco_tpu.obs.telemetry import (
     OPENMETRICS_CONTENT_TYPE,
+    nearest_rank_percentile,
     render_exposition,
 )
 from nanodiloco_tpu.serve.client import http_get, http_post_json
@@ -82,7 +99,18 @@ EVENT_KINDS = (
     # ceiling moves
     "replica_added", "replica_removed", "scale_up", "scale_down",
     "preempt", "preempt_resume", "shed_level",
+    # per-replica circuit breaker (request-level resilience): trip,
+    # half-open recovery probe window, and recovery — route-around
+    # transitions, never ejections
+    "breaker_open", "breaker_half_open", "breaker_close",
 )
+
+#: breaker transition -> the deploy-event kind it logs as
+_BREAKER_EVENT = {"open": "breaker_open", "half_open": "breaker_half_open",
+                  "close": "breaker_close"}
+# gauge encoding for nanodiloco_router_breaker_state (unknown reads as
+# open: fail toward "this replica is not routable")
+_BREAKER_STATE_GAUGE = {"closed": 0, "half_open": 1, "open": 2}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -97,13 +125,103 @@ class Replica:
     blackbox: str | None = None
 
 
+class _Breaker:
+    """Per-replica circuit breaker over FORWARD outcomes — the gray-
+    failure detector the binary healthz eject cannot be. A rolling
+    window of per-attempt results trips ``open`` once the bad rate
+    (transport errors and 5xx, plus successes slower than ``slow_s``
+    when set) reaches ``failure_rate`` with at least ``min_samples``
+    observations. Open cools for ``open_s`` on the injected clock, then
+    ``half_open`` admits EXACTLY ONE probe request, whose outcome
+    closes the breaker (window cleared) or re-opens it. The breaker
+    feeds ROUTE-AROUND (pick ranking) and the ``breaker_open`` goodput
+    bucket, never ejection: a gray replica is slow, not dead.
+
+    All mutation happens under the router's lock. ``pending`` holds
+    transition names the router drains into deploy events (the drain
+    happens on the request path and every health tick, so a transition
+    is never silently swallowed by whichever code path advanced it)."""
+
+    def __init__(self, clock: Callable[[], float], *, window: int = 20,
+                 min_samples: int = 5, failure_rate: float = 0.5,
+                 open_s: float = 10.0,
+                 slow_s: float | None = None) -> None:
+        self._clock = clock
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self.failure_rate = float(failure_rate)
+        self.open_s = float(open_s)
+        self.slow_s = None if slow_s is None else float(slow_s)
+        self.state = "closed"
+        self.opens = 0
+        self.pending: list[str] = []
+        self._results: deque = deque(maxlen=self.window)
+        self._opened_at = 0.0
+        self._probing = False
+
+    def _trip(self) -> None:
+        self.state = "open"
+        self._opened_at = self._clock()
+        self._probing = False
+        self.opens += 1
+        self._results.clear()
+        self.pending.append("open")
+
+    def note(self, ok: bool, latency_s: float | None = None) -> None:
+        """Record one forwarded-attempt outcome."""
+        bad = (not ok) or (self.slow_s is not None
+                           and latency_s is not None
+                           and latency_s > self.slow_s)
+        state = self.current()
+        if state == "open":
+            return  # a straggler attempt launched before the trip:
+            # its late result must not extend the cooldown
+        if state == "half_open":
+            self._probing = False
+            if bad:
+                self._trip()
+            else:
+                self.state = "closed"
+                self._results.clear()
+                self.pending.append("close")
+            return
+        self._results.append(bad)
+        n = len(self._results)
+        if (n >= self.min_samples
+                and sum(self._results) / n >= self.failure_rate):
+            self._trip()
+
+    def current(self) -> str:
+        """The state, advancing open -> half_open once ``open_s`` has
+        cooled on the injected clock."""
+        if (self.state == "open"
+                and self._clock() - self._opened_at >= self.open_s):
+            self.state = "half_open"
+            self._probing = False
+            self.pending.append("half_open")
+        return self.state
+
+    def rank(self) -> int:
+        """Routing preference: 0 closed, 1 half-open awaiting its one
+        recovery probe, 2 open (or half-open with the probe already in
+        flight). Rank-2 replicas remain PICKABLE when nothing better
+        exists — a degraded answer beats a 503."""
+        s = self.current()
+        if s == "closed":
+            return 0
+        if s == "half_open" and not self._probing:
+            return 1
+        return 2
+
+
 class _ReplicaState:
     """Per-replica tracking: status, readiness, last health stats, and
     per-state wall-clock seconds (the fleet goodput numerator). All
     mutation happens under the router's lock."""
 
     def __init__(self, replica: Replica, clock: Callable[[], float],
-                 status: str = "serving") -> None:
+                 status: str = "serving",
+                 breaker: _Breaker | None = None) -> None:
         self.replica = replica
         # serving | draining | ejected | scaling_up | scaling_down —
         # the latter two are the autoscaler's transition states: a
@@ -115,12 +233,19 @@ class _ReplicaState:
         self.failures = 0              # consecutive unreachable probes
         self.stats: dict = {}          # queue_depth/slots_busy/kv_blocks_free/...
         self.router_inflight = 0       # requests this router has in flight here
+        self.breaker = breaker or _Breaker(clock)
         self._clock = clock
         self._since = clock()
         self.seconds = {cause: 0.0 for cause in FLEET_STATE_CAUSES}
 
     def _bucket(self) -> str:
         if self.status == "serving":
+            # a tripped (or half-open) breaker is a named goodput cause:
+            # the replica is nominally serving but the router is routing
+            # around a gray failure — those seconds must never be booked
+            # as ready capacity nor silently dropped
+            if self.breaker.current() != "closed":
+                return "breaker_open"
             return "serving_ready" if self.ready else "serving_unready"
         return self.status
 
@@ -162,6 +287,17 @@ class FleetRouter:
         eject_after_failures: int = 3,
         drain_timeout_s: float = 30.0,
         request_timeout_s: float = 600.0,
+        hedge_after_s: float | None = None,
+        hedge_min_delay_s: float = 0.05,
+        hedge_min_samples: int = 16,
+        retry_budget_ratio: float = 0.2,
+        retry_budget_min: float = 3.0,
+        retry_budget_cap: float = 10.0,
+        breaker_window: int = 20,
+        breaker_min_samples: int = 5,
+        breaker_failure_rate: float = 0.5,
+        breaker_open_s: float = 10.0,
+        breaker_slow_s: float | None = None,
         events_jsonl: str | None = None,
         tracer=None,
         quiet: bool = False,
@@ -178,13 +314,39 @@ class FleetRouter:
         self._post = post or self._http_post
         self.health_interval_s = float(health_interval_s)
         # per-GET bound for the health probes, deliberately well below
-        # the request timeout: the sweep is SEQUENTIAL, so one dead
-        # host (SYN timeout, no RST) must not stall every other
-        # replica's probe — and so ejection — behind it
+        # the request timeout: the sweep is CONCURRENT (one thread per
+        # replica, joined against this bound), so one dead host (SYN
+        # timeout, no RST) costs one probe_timeout_s, not (N-1) of them
+        # stacked in front of every other replica's ejection
         self.probe_timeout_s = float(probe_timeout_s)
         self.eject_after_failures = int(eject_after_failures)
         self.drain_timeout_s = float(drain_timeout_s)
         self._request_timeout_s = float(request_timeout_s)
+        # request-level resilience. Hedge delay: None = adaptive (p95 of
+        # recent winner latencies once hedge_min_samples exist, floored
+        # at hedge_min_delay_s); > 0 = fixed; <= 0 = hedging disabled.
+        self.hedge_min_delay_s = float(hedge_min_delay_s)
+        self.hedge_min_samples = int(hedge_min_samples)
+        self._hedge_after_s = (None if hedge_after_s is None
+                               else float(hedge_after_s))
+        # token-bucket retry budget: a retry/hedge costs 1 token, every
+        # success deposits retry_budget_ratio (capped) — under fleet-
+        # wide failure the budget drains and excess retries become
+        # honest errors instead of amplifying into a retry storm
+        self.retry_budget_ratio = float(retry_budget_ratio)
+        self.retry_budget_cap = float(retry_budget_cap)
+        self._retry_tokens = float(retry_budget_min)
+        self._breaker_kw = dict(
+            window=breaker_window, min_samples=breaker_min_samples,
+            failure_rate=breaker_failure_rate, open_s=breaker_open_s,
+            slow_s=breaker_slow_s,
+        )
+        self._resilience = {
+            "hedges": 0, "hedge_wins": 0, "retries": 0,
+            "retry_budget_exhausted": 0, "deadline_expired": 0,
+            "breaker_opens": 0,
+        }
+        self._latencies: deque = deque(maxlen=512)  # winner latencies
         self.events_jsonl = events_jsonl
         # per-request span sink (obs/tracer.SpanTracer or None): the
         # router records route/forward spans via record_span with ITS
@@ -208,7 +370,10 @@ class FleetRouter:
         # another target's alert still burns
         self._slo_fleet: set = set()                   # {(rule, target)}
         self._req_seq = 0
-        self._states = [_ReplicaState(r, clock) for r in replicas]
+        self._states = [
+            _ReplicaState(r, clock, breaker=self._make_breaker())
+            for r in replicas
+        ]
         self._by_name = {st.replica.name: st for st in self._states}
         # reentrant: the health tick ejects (and so logs/counts an
         # event) while holding the state lock
@@ -345,7 +510,10 @@ class FleetRouter:
         try:
             code, body = http_get(replica.url + "/healthz",
                                   timeout=self.probe_timeout_s)
-        except OSError:
+        except (OSError, http.client.HTTPException):
+            # HTTPException covers a connection RESET mid-body
+            # (IncompleteRead) — a chaos-grade gray failure that is
+            # neither a refused socket nor a parsed status
             return out
         out["reachable"] = True
         out["live"] = code == 200
@@ -364,7 +532,8 @@ class FleetRouter:
             rdoc = json.loads(rbody)
             if isinstance(rdoc, dict) and rdoc.get("in_flight") is not None:
                 out["stats"]["in_flight"] = rdoc["in_flight"]
-        except (OSError, json.JSONDecodeError, ValueError):
+        except (OSError, json.JSONDecodeError, ValueError,
+                http.client.HTTPException):
             out["ready"] = False
         return out
 
@@ -378,49 +547,105 @@ class FleetRouter:
     # -- health + ejection ---------------------------------------------------
 
     def health_tick(self) -> None:
-        """One probe sweep over the non-ejected replicas: refresh
-        readiness + load stats, count consecutive failures, eject."""
+        """One CONCURRENT probe sweep over the non-ejected replicas:
+        refresh readiness + load stats, count consecutive failures,
+        eject. Probes run in parallel, each under the same per-probe
+        bound — sequentially, one blackholed host (SYN timeout, no RST)
+        put the LAST replica's detection ``(N-1) * probe_timeout_s``
+        behind the dead one; concurrently the whole sweep is bounded by
+        roughly one probe's timeout regardless of N."""
         with self._lock:
-            states = list(self._states)  # membership can change mid-sweep
+            states = [st for st in self._states if st.status != "ejected"]
+        results: dict[str, dict] = {}
+
+        def _probe_one(st: _ReplicaState) -> None:
+            try:
+                results[st.replica.name] = self._probe(st.replica) or {}
+            except Exception:  # a probe bug must never kill the sweep
+                results[st.replica.name] = {}
+
+        threads = []
         for st in states:
-            if st.status == "ejected":
-                continue
-            r = self._probe(st.replica)
+            t = threading.Thread(target=_probe_one, args=(st,),
+                                 name="nanodiloco-fleet-probe",
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        # real-time join bound (probe threads are real even under an
+        # injected clock): 2x covers the probe's two GETs (healthz +
+        # readyz), the headroom covers thread scheduling. A probe still
+        # hung past the bound reads as this tick's unreachable.
+        deadline = time.monotonic() + 2 * self.probe_timeout_s + 1.0
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        for st in states:
+            r = results.get(st.replica.name) or {}
             with self._lock:
                 if st.status == "ejected":  # a push thread raced us
                     continue
-                stats = r.get("stats") or {}
-                if stats:
-                    st.stats.update(stats)
-                if st.status == "scaling_up":
-                    # a booting replica is EXPECTED unreachable (process
-                    # start + compile): no failure budget until it has
-                    # joined. First live+ready probe promotes it to a
-                    # routing candidate and closes its scaling_up
-                    # seconds bucket.
-                    if r.get("live") and r.get("ready"):
-                        st.failures = 0
-                        st.set(status="serving", ready=True)
-                    continue
-                if r.get("live"):
-                    st.failures = 0
-                    # a replica draining ITSELF (a push in progress)
-                    # stays unroutable regardless of its readyz
-                    st.set(ready=bool(r.get("ready"))
-                           and st.status == "serving")
-                    continue
-                if st.status == "scaling_down":
-                    continue  # retiring: unreachable is the expected end
-                if r.get("reachable"):
-                    # an explicit /healthz 503: the engine loop DIED.
-                    # It never comes back — eject now, don't wait out
-                    # the failure budget meant for restart windows.
-                    self._eject_locked(st, "healthz_503")
-                    continue
-                st.failures += 1
+                confirm = self._apply_probe_locked(st, r, confirmed=False)
+            if confirm:
+                # one flapped /healthz 503 must not eject (the chaos
+                # taxonomy's flap_health case): re-probe before calling
+                # it the engine loop's death. The replica is unroutable
+                # while unconfirmed, and a PERSISTENT 503 still ejects
+                # within this same tick.
+                try:
+                    r2 = self._probe(st.replica) or {}
+                except Exception:
+                    r2 = {}
+                with self._lock:
+                    if st.status != "ejected":
+                        self._apply_probe_locked(st, r2, confirmed=True)
+            # advance the breaker's open->half_open cooldown and flush
+            # any transition events it accumulated off the request path
+            with self._lock:
+                if st.status != "ejected":
+                    st.breaker.current()
+            self._drain_breaker(st)
+
+    def _apply_probe_locked(self, st: _ReplicaState, r: dict,
+                            confirmed: bool) -> bool:
+        """Apply one probe observation (caller holds the lock). Returns
+        True when the observation was a reachable-but-503 healthz that
+        needs a confirming re-probe before the eject."""
+        stats = r.get("stats") or {}
+        if stats:
+            st.stats.update(stats)
+        if st.status == "scaling_up":
+            # a booting replica is EXPECTED unreachable (process
+            # start + compile): no failure budget until it has
+            # joined. First live+ready probe promotes it to a
+            # routing candidate and closes its scaling_up
+            # seconds bucket.
+            if r.get("live") and r.get("ready"):
+                st.failures = 0
+                st.set(status="serving", ready=True)
+            return False
+        if r.get("live"):
+            st.failures = 0
+            # a replica draining ITSELF (a push in progress)
+            # stays unroutable regardless of its readyz
+            st.set(ready=bool(r.get("ready"))
+                   and st.status == "serving")
+            return False
+        if st.status == "scaling_down":
+            return False  # retiring: unreachable is the expected end
+        if r.get("reachable"):
+            # an explicit /healthz 503: the engine loop DIED. It never
+            # comes back — eject (after one confirming re-probe, which
+            # separates a flapping health endpoint from a dead loop),
+            # don't wait out the failure budget meant for restarts.
+            if not confirmed:
                 st.set(ready=False)
-                if st.failures >= self.eject_after_failures:
-                    self._eject_locked(st, "unreachable")
+                return True
+            self._eject_locked(st, "healthz_503")
+            return False
+        st.failures += 1
+        st.set(ready=False)
+        if st.failures >= self.eject_after_failures:
+            self._eject_locked(st, "unreachable")
+        return False
 
     def _eject_locked(self, st: _ReplicaState, reason: str) -> None:
         """Eject a replica (caller holds the lock): it stops being a
@@ -464,7 +689,8 @@ class FleetRouter:
                 raise ValueError(
                     f"replica {replica.name!r} is already in the fleet"
                 )
-            st = _ReplicaState(replica, self._clock, status="scaling_up")
+            st = _ReplicaState(replica, self._clock, status="scaling_up",
+                               breaker=self._make_breaker())
             self._states.append(st)
             self._by_name[replica.name] = st
         self.log_event("replica_added", replica=replica.name,
@@ -496,7 +722,7 @@ class FleetRouter:
                     if (r.get("stats") or {}).get("in_flight", 0) == 0:
                         break
                     self._sleep(0.05)
-            except (OSError, ValueError):
+            except (OSError, ValueError, http.client.HTTPException):
                 pass  # an unreachable retiree is already as drained
                 # as it will ever be
         with self._lock:
@@ -549,6 +775,91 @@ class FleetRouter:
         with self._lock:
             return self._admission_max_priority
 
+    # -- request-level resilience (breaker / retry budget / hedging) ---------
+
+    def _make_breaker(self) -> _Breaker:
+        return _Breaker(self._clock, **self._breaker_kw)
+
+    def _drain_breaker(self, st: _ReplicaState) -> None:
+        """Flush a breaker's pending transitions into deploy events +
+        the trip counter (called wherever the breaker may have
+        advanced: after a forward outcome, and every health tick)."""
+        with self._lock:
+            pend, st.breaker.pending = list(st.breaker.pending), []
+            for tr in pend:
+                if tr == "open":
+                    self._resilience["breaker_opens"] += 1
+        for tr in pend:
+            self.log_event(_BREAKER_EVENT[tr], replica=st.replica.name)
+
+    def _breaker_note(self, st: _ReplicaState, ok: bool,
+                      latency_s: float | None = None) -> None:
+        with self._lock:
+            st.breaker.note(ok, latency_s)
+        self._drain_breaker(st)
+
+    def breaker_open_replicas(self) -> list[str]:
+        """Serving replicas whose breaker is open or half-open — routed
+        around, so NOT usable supply (the autoscaler subtracts them
+        from the capacity model's serving set)."""
+        with self._lock:
+            return sorted(
+                st.replica.name for st in self._states
+                if st.status == "serving"
+                and st.breaker.current() != "closed"
+            )
+
+    def _retry_take(self, kind: str) -> bool:
+        """Spend one retry-budget token on a retry or hedge. An empty
+        bucket refuses (counted): under fleet-wide failure the router
+        stops amplifying load and returns the honest error instead."""
+        with self._lock:
+            if self._retry_tokens >= 1.0:
+                self._retry_tokens -= 1.0
+                self._resilience[
+                    "hedges" if kind == "hedge" else "retries"] += 1
+                return True
+            self._resilience["retry_budget_exhausted"] += 1
+            return False
+
+    def _retry_deposit(self) -> None:
+        with self._lock:
+            self._retry_tokens = min(
+                self.retry_budget_cap,
+                self._retry_tokens + self.retry_budget_ratio,
+            )
+
+    def _hedge_delay(self) -> float | None:
+        """Seconds to wait before hedging, or None when hedging should
+        not arm: fixed when ``hedge_after_s`` > 0, disabled when <= 0,
+        else the p95 of recent winner latencies (floored at
+        ``hedge_min_delay_s``) once enough samples exist — hedge only
+        the TAIL, never the typical request."""
+        if self._hedge_after_s is not None:
+            return self._hedge_after_s if self._hedge_after_s > 0 else None
+        with self._lock:
+            lats = sorted(self._latencies)
+        if len(lats) < self.hedge_min_samples:
+            return None
+        return max(self.hedge_min_delay_s,
+                   nearest_rank_percentile(lats, 0.95))
+
+    def _cancel_request(self, replica: Replica, rid: str) -> None:
+        """Fire-and-forget ``/v1/cancel`` to a hedge/deadline loser:
+        frees its slot and KV blocks through the scheduler's existing
+        ticket-cancel path. Never awaited — a blackholed loser must not
+        add its own timeout to the winner's latency."""
+        def _run():
+            try:
+                self._post(replica, "/v1/cancel", {"request_id": rid},
+                           timeout=10.0)
+            except Exception:
+                pass  # best-effort: the replica-side deadline expiry
+                # is the backstop for an unreachable loser
+
+        threading.Thread(target=_run, daemon=True,
+                         name="nanodiloco-fleet-cancel").start()
+
     # -- routing -------------------------------------------------------------
 
     def pick(self) -> _ReplicaState | None:
@@ -566,27 +877,60 @@ class FleetRouter:
             )
 
     def handle_generate(self, doc: dict) -> tuple[int, dict]:
-        """Forward one request to the least-loaded ready replica; one
-        retry on a DIFFERENT replica when the first answers 503/429 or
-        the socket fails (the health loop owns ejection — a forward
-        failure only counts against the failure budget; a 429 means
-        THAT replica's queue is full, and the router's load view can be
-        a health-tick stale, so another replica may have headroom).
+        """Forward one request with the full resilience stack:
+
+        - **Deadline propagation.** A client ``timeout_s`` becomes the
+          router's whole budget (``request_timeout_s`` otherwise): each
+          attempt's wire timeout is the REMAINING budget, and the
+          forwarded body carries ``deadline_s`` (min of remaining and
+          any client-supplied deadline) so the scheduler's expiry stops
+          decoding for a departed client instead of burning attributed
+          device-seconds. An exhausted budget is an honest 504.
+        - **Retry.** One retry on a DIFFERENT replica when an attempt
+          answers 503/429-busy/5xx or the socket fails (the health loop
+          owns ejection — a forward failure only counts against the
+          failure budget). Retries spend the token-bucket retry budget;
+          an empty bucket returns the honest error instead of
+          amplifying fleet-wide failure into a retry storm.
+        - **Hedging.** When the sole attempt outlives the hedge delay
+          (p95 of recent winner latencies, or a fixed override), a
+          second attempt launches on another ready replica; first
+          answer wins, the loser is cancelled through the replica's
+          ticket-cancel path (slot + KV blocks freed). Hedges spend the
+          same retry budget.
+        - A 429 carrying ``"shed": true`` stays TERMINAL fleet policy
+          (never retried, never hedged) — the two-429 contract is
+          unchanged.
 
         The ``request_id`` join key is stamped HERE when the client did
         not supply one, and the SAME body — same id — rides every
-        attempt: stamping per-attempt would hand the retry replica a
-        different id and break the router-span/replica-span trace join
-        for exactly the requests that needed diagnosing. The response
-        echoes ``served_by`` (which replica actually answered — on a
-        retry that is NOT the replica the router first picked)."""
+        attempt: stamping per-attempt would hand the retry/hedge
+        replica a different id and break the router-span/replica-span
+        trace join for exactly the requests that needed diagnosing
+        (merged traces join BOTH attempts of a hedged request). The
+        response echoes ``served_by`` (which replica actually answered
+        — on a retry or a hedge win that is NOT the first pick)."""
         rid = doc.get("request_id")
         if not isinstance(rid, str) or not rid:
             with self._lock:
                 self._req_seq += 1
                 rid = f"rtr-{self._req_seq}"
         doc = {**doc, "request_id": rid}
+        timeout_s = doc.pop("timeout_s", None)
+        if timeout_s is not None:
+            if (isinstance(timeout_s, bool)
+                    or not isinstance(timeout_s, (int, float))
+                    or not timeout_s > 0):
+                return 400, {
+                    "error": f"timeout_s must be a positive number of "
+                             f"seconds; got {timeout_s!r}",
+                    "request_id": rid,
+                }
+            timeout_s = float(timeout_s)
         t_route = self._clock()
+        budget = (timeout_s if timeout_s is not None
+                  else self._request_timeout_s)
+        deadline_at = t_route + budget
         # class-aware shedding at the front door: a request whose class
         # is above the admission ceiling never touches a replica — the
         # 429 says so explicitly ("shed": true + the class), because it
@@ -614,43 +958,146 @@ class FleetRouter:
             }
         tried: set[str] = set()
         last_429: tuple[int, dict] | None = None
-        for attempt in range(2):
-            st = self._pick_excluding(tried)
-            if st is None:
-                self._span("route", t_route, self._clock(), rid,
-                           outcome="no_ready_replica")
-                return 503, {"error": "no ready replica",
-                             "request_id": rid,
-                             **({"tried": sorted(tried)} if tried else {})}
+        last_err: tuple[int, dict] | None = None
+        outstanding: dict[int, _ReplicaState] = {}
+        results: queue.Queue = queue.Queue()
+        launched = 0
+        hedged = False
+
+        def _launch(st: _ReplicaState, is_hedge: bool) -> None:
+            nonlocal launched
+            idx = launched
+            launched += 1
             name = st.replica.name
             tried.add(name)
+            outstanding[idx] = st
             with self._lock:
                 st.router_inflight += 1
-            t0 = self._clock()
-            try:
+            remaining = max(0.05, deadline_at - self._clock())
+            fwd = dict(doc)
+            if timeout_s is not None or doc.get("deadline_s") is not None:
+                # propagate the deadline replica-side: the scheduler's
+                # expiry machinery stops decoding for a client that has
+                # already departed (min with any client deadline_s so
+                # the router only ever TIGHTENS it)
+                d = remaining
+                cd = doc.get("deadline_s")
+                if (isinstance(cd, (int, float))
+                        and not isinstance(cd, bool) and cd > 0):
+                    d = min(d, float(cd))
+                fwd["deadline_s"] = round(d, 6)
+                post_timeout = remaining + 0.25
+            else:
+                post_timeout = None
+
+            def _run():
+                t0 = self._clock()
                 try:
-                    code, out = self._post(st.replica, "/v1/generate", doc)
-                finally:
-                    # finally, not per-path: an exception outside the
-                    # routed-around classes below must never leak the
-                    # in-flight count (it feeds the load key — a leak
-                    # penalizes this replica forever)
+                    try:
+                        code, out = self._post(
+                            st.replica, "/v1/generate", fwd,
+                            timeout=post_timeout,
+                        )
+                    finally:
+                        # finally, not per-path: an exception outside
+                        # the routed-around classes must never leak the
+                        # in-flight count (it feeds the load key — a
+                        # leak penalizes this replica forever)
+                        with self._lock:
+                            st.router_inflight -= 1
+                except (OSError, ValueError, http.client.HTTPException):
+                    # ValueError = a non-JSON body (misconfigured URL,
+                    # an intermediary's error page); HTTPException = a
+                    # connection reset mid-body (IncompleteRead): route
+                    # around either — a bad replica must cost the
+                    # client a retry, not a dropped connection
                     with self._lock:
-                        st.router_inflight -= 1
-            except (OSError, ValueError):
-                # ValueError = a non-JSON body (misconfigured URL, an
-                # intermediary's error page): route around it — a bad
-                # replica must cost the client a retry, not a dropped
-                # connection from a dead handler thread
-                with self._lock:
-                    st.failures += 1
-                    st.set(ready=False)
+                        st.failures += 1
+                        st.set(ready=False)
+                    self._breaker_note(
+                        st, ok=False,
+                        latency_s=max(0.0, self._clock() - t0))
+                    self._span("forward", t0, self._clock(), rid,
+                               replica=name, retry=idx > 0,
+                               outcome="error")
+                    results.put((is_hedge, idx, st, None, None, t0))
+                    return
+                # 503 (dead loop or draining) and 429 (backpressure)
+                # are routing signals, not breaker badness; 5xx and
+                # slow 200s feed the gray-failure window
+                self._breaker_note(
+                    st, ok=code < 500 or code == 503,
+                    latency_s=max(0.0, self._clock() - t0))
                 self._span("forward", t0, self._clock(), rid,
-                           replica=name, retry=attempt > 0,
-                           outcome="error")
+                           replica=name, retry=idx > 0, code=code)
+                results.put((is_hedge, idx, st, code, out, t0))
+
+            threading.Thread(
+                target=_run, daemon=True,
+                name="nanodiloco-fleet-forward",
+            ).start()
+
+        while True:
+            now = self._clock()
+            if deadline_at - now <= 0:
+                # the client's budget is gone: cancel whatever is still
+                # in flight (frees replica slots + KV blocks) and say
+                # so honestly — never pin a departed client behind the
+                # fleet-wide request timeout
+                with self._lock:
+                    self._resilience["deadline_expired"] += 1
+                for lst in outstanding.values():
+                    self._cancel_request(lst.replica, rid)
+                self._span("route", t_route, now, rid,
+                           outcome="deadline_expired", attempts=launched)
+                return 504, {
+                    "error": f"deadline exceeded: timeout_s="
+                             f"{round(budget, 3)} elapsed before any "
+                             f"replica answered",
+                    "request_id": rid,
+                    **({"tried": sorted(tried)} if tried else {}),
+                }
+            if not outstanding:
+                if launched >= 2:
+                    break  # first attempt + one retry/hedge: exhausted
+                st = self._pick_excluding(tried)
+                if st is None:
+                    self._span("route", t_route, self._clock(), rid,
+                               outcome="no_ready_replica")
+                    return 503, {"error": "no ready replica",
+                                 "request_id": rid,
+                                 **({"tried": sorted(tried)}
+                                    if tried else {})}
+                if launched > 0 and not self._retry_take("retry"):
+                    break  # budget empty: the honest error, no storm
+                _launch(st, is_hedge=False)
+            hedge_delay = None
+            if launched == 1 and len(outstanding) == 1 and not hedged:
+                hedge_delay = self._hedge_delay()
+            wait_s = (deadline_at - self._clock() if hedge_delay is None
+                      else min(deadline_at - self._clock(), hedge_delay))
+            try:
+                # REAL-time wait on the result queue (the attempt
+                # threads are real even under an injected clock); the
+                # deadline itself is re-checked on the injected clock
+                # at the top of every iteration
+                is_hedge, idx, st, code, out, t0 = results.get(
+                    timeout=max(0.001, wait_s))
+            except queue.Empty:
+                if hedge_delay is not None:
+                    # the sole attempt has outlived the hedge delay:
+                    # launch the second attempt on another ready
+                    # replica — first answer wins. Armed once per
+                    # request, budget-gated like a retry.
+                    hedged = True
+                    st2 = self._pick_excluding(tried)
+                    if st2 is not None and self._retry_take("hedge"):
+                        _launch(st2, is_hedge=True)
                 continue
-            self._span("forward", t0, self._clock(), rid, replica=name,
-                       retry=attempt > 0, code=code)
+            outstanding.pop(idx, None)
+            name = st.replica.name
+            if code is None:
+                continue  # transport failure (marked in the thread)
             if code == 503:
                 # the replica's loop is dead or it is draining: route
                 # around it now; the health loop decides ejection
@@ -671,6 +1118,8 @@ class FleetRouter:
                         self._shed_by_class[sc] = (
                             self._shed_by_class.get(sc, 0) + 1
                         )
+                    for lst in outstanding.values():
+                        self._cancel_request(lst.replica, rid)
                     self._span("route", t_route, self._clock(), rid,
                                outcome="shed", replica=name)
                     return 429, {**out, "replica": name,
@@ -684,16 +1133,41 @@ class FleetRouter:
                                    "request_id": rid}
                             if isinstance(out, dict) else out)
                 continue
+            if code >= 500:
+                # any other 5xx (chaos-injected or a replica bug):
+                # route around it like a transport failure, but keep
+                # the body — if every attempt fails the client gets the
+                # replica's own error, not a synthesized 503
+                last_err = (code, {**out, "replica": name,
+                                   "request_id": rid}
+                            if isinstance(out, dict) else out)
+                continue
+            # first usable answer wins
+            if code == 200:
+                with self._lock:
+                    self._latencies.append(max(0.0, self._clock() - t0))
+                    if is_hedge:
+                        self._resilience["hedge_wins"] += 1
+                self._retry_deposit()
+            for lst in outstanding.values():
+                # the hedge loser: cancelled through the replica's
+                # ticket-cancel path, freeing its slot and KV blocks
+                self._cancel_request(lst.replica, rid)
             if isinstance(out, dict):
                 out = {**out, "replica": name, "served_by": name}
                 out.setdefault("request_id", rid)
             self._span("route", t_route, self._clock(), rid,
-                       served_by=name, attempts=attempt + 1)
+                       served_by=name, attempts=launched)
             return code, out
         self._span("route", t_route, self._clock(), rid,
                    outcome="exhausted", attempts=len(tried))
         if last_429 is not None:
             return last_429
+        if last_err is not None:
+            # a hedged/retried request that lost on BOTH attempts
+            # returns ONE honest error (the last replica body), never
+            # two answers and never a silent drop
+            return last_err
         return 503, {"error": "no replica could take the request",
                      "request_id": rid, "tried": sorted(tried)}
 
@@ -710,14 +1184,22 @@ class FleetRouter:
                 load = ((s.get("queue_depth") or 0)
                         + (s.get("slots_busy") or 0) + st.router_inflight)
                 free = s.get("kv_blocks_free")
-                # SLO route-around FIRST: a replica burning an SLO is
-                # picked only when no clean candidate exists (degraded
-                # beats 503); load order is unchanged within each class
-                return (st.replica.name in self._slo_not_preferred,
+                # breaker route-around OUTRANKS everything: an open-
+                # breaker replica is picked only when no closed (or
+                # probe-ready half-open) candidate exists — degraded
+                # beats 503. SLO not-preferred orders within each
+                # breaker rank; load order within each SLO class.
+                return (st.breaker.rank(),
+                        st.replica.name in self._slo_not_preferred,
                         load, -(free if free is not None else -1),
                         st.replica.name)
 
-            return min(cands, key=key)
+            best = min(cands, key=key)
+            if best.breaker.rank() == 1:
+                # consume the half-open probe slot: exactly one request
+                # tests a recovering replica at a time
+                best.breaker._probing = True
+            return best
 
     # -- SLO burn state (obs/slo action hook) --------------------------------
 
@@ -896,11 +1378,12 @@ class FleetRouter:
                            **({"step": step} if step is not None else {}))
             return {"replica": name, "ok": False, "code": code,
                     "error": err}
-        except (OSError, ValueError) as e:
+        except (OSError, ValueError, http.client.HTTPException) as e:
             # ValueError covers JSONDecodeError: a replica answering a
             # plain-text body (an old serve without /admin routes, a
             # proxy error page) must be a failed push, not an exception
-            # that silently kills the deploy controller's thread
+            # that silently kills the deploy controller's thread;
+            # HTTPException covers a connection reset mid-body
             try:
                 # the drain may have SUCCEEDED before the failure: a
                 # replica left draining admits nothing forever (queued
@@ -908,7 +1391,7 @@ class FleetRouter:
                 # resume, because a failed push must cost a retry, not
                 # a replica's whole capacity
                 self._post(st.replica, "/admin/resume", {}, timeout=30.0)
-            except (OSError, ValueError):
+            except (OSError, ValueError, http.client.HTTPException):
                 pass
             with self._lock:
                 if st.status == "draining":  # not ejected mid-push
@@ -1033,6 +1516,21 @@ class FleetRouter:
                 "fleet_goodput_fraction": (
                     round(ready_s / total_s, 6) if total_s > 0 else None
                 ),
+                # request-level resilience: hedge/retry/deadline
+                # counters, the retry-budget level, and the per-replica
+                # breaker picture (summarize_run surfaces these from
+                # the final fleet_goodput record)
+                **{k: v for k, v in self._resilience.items()},
+                "retry_budget_tokens": round(self._retry_tokens, 3),
+                "breaker_state": {
+                    st.replica.name: st.breaker.state
+                    for st in self._states if st.status != "ejected"
+                },
+                "replicas_breaker_open": sum(
+                    1 for st in self._states
+                    if st.status == "serving"
+                    and st.breaker.state != "closed"
+                ),
                 "admission_max_priority": self._admission_max_priority,
                 "shed_by_class": {
                     c: v for c, v in sorted(self._shed_by_class.items())
@@ -1120,6 +1618,40 @@ class FleetRouter:
                 [({"priority": str(c)}, v)
                  for c, v in sorted(s["shed_by_class"].items())]
                 + [(None, sum(s["shed_by_class"].values()))],
+            ))
+        families.extend([
+            ("nanodiloco_router_hedges", "counter",
+             "hedged second attempts launched (first answer wins; the "
+             "loser is cancelled replica-side)",
+             [(None, s["hedges"])]),
+            ("nanodiloco_router_hedge_wins", "counter",
+             "hedged requests won by the second attempt",
+             [(None, s["hedge_wins"])]),
+            ("nanodiloco_router_retries", "counter",
+             "retry attempts the token-bucket retry budget admitted",
+             [(None, s["retries"])]),
+            ("nanodiloco_router_retry_budget_exhausted", "counter",
+             "retries/hedges refused because the retry budget was "
+             "empty (the anti-retry-storm backstop)",
+             [(None, s["retry_budget_exhausted"])]),
+            ("nanodiloco_router_deadline_expired", "counter",
+             "requests answered 504 because the client deadline "
+             "elapsed at the router",
+             [(None, s["deadline_expired"])]),
+            ("nanodiloco_router_breaker_opens", "counter",
+             "circuit-breaker trips (closed/half-open -> open)",
+             [(None, s["breaker_opens"])]),
+            ("nanodiloco_router_retry_budget_tokens", "gauge",
+             "retry-budget tokens currently available",
+             [(None, s["retry_budget_tokens"])]),
+        ])
+        if s["breaker_state"]:
+            families.append((
+                "nanodiloco_router_breaker_state", "gauge",
+                "per-replica circuit-breaker state (0 closed, 1 "
+                "half-open, 2 open) — route-around, never ejection",
+                [({"replica": name}, _BREAKER_STATE_GAUGE.get(v, 2))
+                 for name, v in sorted(s["breaker_state"].items())],
             ))
         families.append((
             "nanodiloco_fleet_slo_burning", "gauge",

@@ -105,16 +105,26 @@ class CapacityModel:
         self.window_s = float(window_s)
         self.min_horizon_s = float(min_horizon_s)
         self.beyond_factor = float(beyond_factor)
+        self._excluded: frozenset[str] = frozenset()
+
+    def set_excluded(self, names) -> None:
+        """Replicas to leave out of the supply join (e.g. circuit-breaker
+        open: still scraping, but not credible capacity)."""
+        self._excluded = frozenset(str(n) for n in names)
 
     def targets(self) -> list[str]:
         if self._targets is not None:
-            return list(self._targets)
-        suffix = f":{QUEUE_DEPTH_SAMPLE}"
-        return sorted(
-            k[: -len(suffix)]
-            for k in self.store.keys()
-            if k.endswith(suffix) and ":" not in k[: -len(suffix)]
-        )
+            names = list(self._targets)
+        else:
+            suffix = f":{QUEUE_DEPTH_SAMPLE}"
+            names = sorted(
+                k[: -len(suffix)]
+                for k in self.store.keys()
+                if k.endswith(suffix) and ":" not in k[: -len(suffix)]
+            )
+        if self._excluded:
+            names = [n for n in names if n not in self._excluded]
+        return names
 
     def _span(self, key: str, now: float) -> float:
         samples = self.store.window(key, now - self.window_s, now)

@@ -75,6 +75,7 @@ FLEET_STATE_CAUSES = (
     "ejected",            # ejected after repeated probe failures
     "scaling_up",         # launched by the autoscaler, not yet ready
     "scaling_down",       # retiring: drain -> remove in progress
+    "breaker_open",       # circuit breaker open/half-open: gray failure
 )
 
 #: tracer depth-0 span name -> cause. ``t_``-prefixed JSONL keys map

@@ -30,6 +30,10 @@ Endpoints:
   alive-but-not-ready — the router must route around it, not eject it
   as dead (liveness and readiness are different questions, and
   conflating them turns every deploy into a false crash).
+- ``POST /v1/cancel`` — ``{"request_id": str}``: cancel that in-flight
+  stream through the scheduler's ticket-cancel path (slot and paged KV
+  blocks free at the next tick). The fleet router's hedge-loser and
+  deadline-expiry cleanup; 404 when nothing by that id is in flight.
 - ``POST /admin/drain`` / ``POST /admin/resume`` — stop/resume
   admission (in-flight streams always finish); the fleet router brackets
   a weight push with these.
@@ -123,6 +127,13 @@ class ServeServer:
         self._loop_thread: threading.Thread | None = None
         self._http_thread: threading.Thread | None = None
         self._loop_error: str | None = None
+        # in-flight tickets by request_id, for POST /v1/cancel (the
+        # fleet router's hedge-loser / departed-client path): cancel
+        # rides the scheduler's existing ticket-cancel machinery, so a
+        # cancelled stream frees its slot and paged KV blocks instead
+        # of decoding tokens nobody will read
+        self._inflight: dict[str, object] = {}
+        self._inflight_lock = threading.Lock()
 
         server = self
 
@@ -182,7 +193,7 @@ class ServeServer:
                     code, out = server.handle_admin(path, doc)
                     self._reply_json(code, out)
                     return
-                if path != "/v1/generate":
+                if path not in ("/v1/generate", "/v1/cancel"):
                     self._reply(404, b"not found\n", "text/plain")
                     return
                 try:
@@ -193,7 +204,10 @@ class ServeServer:
                 except ValueError as e:
                     self._reply_json(400, {"error": f"bad JSON: {e}"})
                     return
-                code, out = server.handle_generate(doc)
+                if path == "/v1/cancel":
+                    code, out = server.handle_cancel(doc)
+                else:
+                    code, out = server.handle_generate(doc)
                 self._reply_json(code, out)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
@@ -294,9 +308,20 @@ class ServeServer:
             }
         except QueueFull as e:
             return 429, {"error": str(e)}
-        deadline = request.deadline_s
-        timeout = self._timeout_s if deadline is None else deadline + 5.0
-        result = ticket.wait(timeout)
+        # register for /v1/cancel under the SAME id the scheduler will
+        # echo (client-supplied, or the scheduler's req-<rid> fallback);
+        # a duplicate id overwrites — cancel then targets the newest
+        rid_key = request.request_id or f"req-{ticket.rid}"
+        with self._inflight_lock:
+            self._inflight[rid_key] = ticket
+        try:
+            deadline = request.deadline_s
+            timeout = self._timeout_s if deadline is None else deadline + 5.0
+            result = ticket.wait(timeout)
+        finally:
+            with self._inflight_lock:
+                if self._inflight.get(rid_key) is ticket:
+                    del self._inflight[rid_key]
         if result is None:
             # nobody is left to read the stream: cancel so the scheduler
             # frees the slot instead of decoding to completion
@@ -337,6 +362,23 @@ class ServeServer:
         if self._tokenizer is not None:
             out["text"] = self._tokenizer.decode([int(t) for t in tokens])
         return 200, out
+
+    def handle_cancel(self, doc: dict) -> tuple[int, dict]:
+        """POST /v1/cancel: ``{"request_id": str}`` — cancel an
+        in-flight stream by its join key. The fleet router's hedge
+        loser and deadline-expired paths land here; the scheduler's
+        ticket-cancel machinery frees the slot and paged KV blocks at
+        the next tick. 404 (``cancelled: false``) when nothing by that
+        id is in flight — already finished, or never arrived."""
+        rid = doc.get("request_id")
+        if not isinstance(rid, str) or not rid:
+            return 400, {"error": "request_id must be a non-empty string"}
+        with self._inflight_lock:
+            ticket = self._inflight.get(rid)
+        if ticket is None:
+            return 404, {"cancelled": False, "request_id": rid}
+        ticket.cancel()
+        return 200, {"cancelled": True, "request_id": rid}
 
     def _parse_request(self, doc: dict) -> GenRequest:
         if "token_ids" in doc:

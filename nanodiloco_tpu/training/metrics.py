@@ -338,6 +338,16 @@ def summarize_run(path: str) -> dict[str, Any]:
         for f in faults:
             fkinds[f["fault"]] = fkinds.get(f["fault"], 0) + 1
         out["fault_kinds"] = fkinds
+    # chaos harness (fleet/chaos): wire-level fault injections logged
+    # as {"chaos": kind, "target": ..., "ordinal": ...} records by the
+    # chaos bench/drill — same shape discipline as the fault timeline
+    chaos = [r for r in recs if r.get("chaos")]
+    if chaos:
+        out["chaos_injected_total"] = len(chaos)
+        ckinds: dict[str, int] = {}
+        for c in chaos:
+            ckinds[c["chaos"]] = ckinds.get(c["chaos"], 0) + 1
+        out["chaos_kinds"] = ckinds
     resumes = [r for r in recs if "resume" in r]
     if resumes:
         out["resumes"] = len(resumes)
@@ -485,6 +495,17 @@ def summarize_run(path: str) -> dict[str, Any]:
             out["fleet_replicas_ejected"] = last["replicas_ejected"]
         if last.get("replica_ready_s") is not None:
             out["fleet_replica_ready_s"] = last["replica_ready_s"]
+        # request-level resilience counters (PR 18): absent from older
+        # fleet_goodput records, and zero is not news — surface only
+        # when the fleet actually hedged/retried/tripped
+        for rk in ("hedges", "hedge_wins", "retries",
+                   "retry_budget_exhausted", "deadline_expired",
+                   "breaker_opens"):
+            if last.get(rk):
+                out[f"fleet_{rk}"] = last[rk]
+        by_state = last.get("seconds_by_state")
+        if isinstance(by_state, dict) and by_state.get("breaker_open"):
+            out["fleet_breaker_open_s"] = by_state["breaker_open"]
     # goodput ledger (obs/goodput): stitch the per-lifetime snapshots —
     # a supervised crash-loopy run appends several lifetimes to ONE
     # JSONL, and the honest number is the merged fraction including the
@@ -626,6 +647,16 @@ _COMPARE_METRICS = [
     # engine got 10x faster overnight. Gated only when both summaries
     # carry it.
     ("device_seconds_per_token", True),
+    # chaos drill (serve_bench --workload chaos, fleet/chaos.py): the
+    # highest-class goodput under the committed fault schedule — a
+    # share, ABSOLUTE threshold, higher is better — and dropped
+    # in-flight streams, which gate BOTH WAYS like sheds (more drops =
+    # resilience regressed; the committed plan injects drops'-worth of
+    # faults, so a bench that suddenly reports fewer opportunities to
+    # drop means the schedule stopped firing). Gated only when both
+    # summaries carry them.
+    ("chaos_goodput_fraction", False),
+    ("chaos_dropped_streams", True),
 ]
 
 # share-of-wall-clock keys (already ratios): regress on an ABSOLUTE
@@ -633,7 +664,7 @@ _COMPARE_METRICS = [
 # regression direction follows the key's lower_better flag
 _SHARE_KEYS = {"comm_share_last", "outer_sync_share_sync",
                "outer_sync_share_async", "goodput_fraction",
-               "fleet_goodput_fraction"}
+               "fleet_goodput_fraction", "chaos_goodput_fraction"}
 
 # serve latency keys (seconds, lower better) that use the dedicated
 # latency threshold instead of the loss one
@@ -643,7 +674,7 @@ _LATENCY_KEYS = {"ttft_p50_s", "ttft_p95_s", "short_ttft_p95_s",
 # shed counters regress in BOTH directions (see the _COMPARE_METRICS
 # note): |delta| beyond the latency band (relative, floored at 1 so a
 # near-zero baseline doesn't gate on a single extra shed)
-_SHED_KEYS = {"shed_total"}
+_SHED_KEYS = {"shed_total", "chaos_dropped_streams"}
 
 # SLO burn keys (seconds, absolute threshold, share-class semantics —
 # regress on an absolute move past max_slo_burn_increase_s in the key's

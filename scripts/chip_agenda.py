@@ -85,6 +85,14 @@ ceremony:
      counter family, and `report dashboard` must render the offline
      HTML artifact from the collector's series JSONL.
 
+  14. a CHAOS drill (`chaos`): a 3-replica in-process serve fleet with
+     every byte crossing ``ChaosProxy`` wires on a deterministic fault
+     plan — a blackholed first pick forces a hedge win, a sub-hedge
+     ``timeout_s`` forces an honest deadline 504, blackhole aborts and
+     an error_500 burst trip two breakers, and the fleet still answers
+     200 through the last healthy replica with zero ejections; every
+     surviving greedy stream bit-matches solo ``generate()``.
+
 Usage (each phase also runs alone):
     python scripts/chip_agenda.py               # everything
     python scripts/chip_agenda.py bench sweep   # named phases
@@ -2191,6 +2199,211 @@ def phase_fleet() -> None:
     })
 
 
+def phase_chaos() -> None:
+    """Fleet resilience drill on this backend: a 3-replica in-process
+    serve fleet, every byte crossing a ``ChaosProxy`` wire, driven
+    through the router's OWN HTTP server — the request-level resilience
+    stack proven over real sockets, not scripted posts. The schedule is
+    deterministic (per-target request ordinals, zero wall-clock
+    randomness): a blackholed first pick forces a HEDGE WIN, a client
+    ``timeout_s`` shorter than the hedge delay forces a DEADLINE-EXPIRY
+    504, the blackhole aborts trip r0's breaker and an error_500 burst
+    trips r1's — after which the fleet STILL answers 200 through r2
+    (route-around, zero ejections), every surviving greedy stream
+    bit-identical to solo ``generate()``. On CPU this pins the policy
+    stack; tail-latency wins belong to the chip sitting (PERF.md)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nanodiloco_tpu.fleet import FleetRouter, Replica
+    from nanodiloco_tpu.fleet.chaos import ChaosPlan, proxy_fleet
+    from nanodiloco_tpu.models import LlamaConfig, generate, init_params
+    from nanodiloco_tpu.obs.telemetry import parse_metrics_text
+    from nanodiloco_tpu.serve import InferenceEngine, Scheduler, ServeServer
+    from nanodiloco_tpu.serve.client import http_get, http_post_json
+
+    live = chip_is_live()
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, num_hidden_layers=2,
+        max_position_embeddings=128,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    prompt = [(i * 13 + 3) % 256 for i in range(12)]
+    max_new = 32
+    doc = {"token_ids": prompt, "max_new_tokens": max_new,
+           "temperature": 0.0}
+    solo = np.asarray(generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg, max_new,
+        temperature=0.0,
+    )[0]).tolist()
+
+    servers = []
+    for _ in range(3):
+        eng = InferenceEngine(params, cfg, num_slots=2, max_len=96,
+                              kv_block_size=16)
+        servers.append(ServeServer(Scheduler(eng), port=0,
+                                   host="127.0.0.1",
+                                   max_new_tokens_cap=64).start())
+    router = None
+    proxies = []
+    try:
+        # warm DIRECT to each replica (compile prefill+decode without
+        # consuming a chaos ordinal)
+        for s in servers:
+            code, out = http_post_json(
+                f"http://127.0.0.1:{s.port}/v1/generate", doc,
+                timeout=600)
+            if code != 200 or out["token_ids"] != solo:
+                record({"phase": "chaos",
+                        "error": f"warmup parity failed ({code})"})
+                raise SystemExit(1)
+        # r0 requests 0+1 blackholed (2.5s, then an RST): request 0 is
+        # the hedge-win leg, request 1 the deadline-expiry leg, and the
+        # two aborts are r0's breaker trip. r1's ordinals 0/1 go to
+        # those legs' hedges, so the error_500 burst starts at 2.
+        plan = ChaosPlan.from_dict({"faults": [
+            {"kind": "blackhole", "target": "r0", "requests": [0, 1],
+             "seconds": 2.5},
+            {"kind": "error_500", "target": "r1",
+             "requests": [2, 3, 4, 5]},
+        ]})
+        replicas = [Replica(f"r{i}", f"http://127.0.0.1:{s.port}")
+                    for i, s in enumerate(servers)]
+        proxied, proxies = proxy_fleet(replicas, plan)
+        router = FleetRouter(
+            proxied, port=0, host="127.0.0.1",
+            health_interval_s=0.3, probe_timeout_s=2.0,
+            hedge_after_s=0.75, retry_budget_min=10.0,
+            breaker_window=6, breaker_min_samples=2,
+            breaker_failure_rate=0.5, breaker_open_s=300.0,
+            quiet=True,
+        ).start()
+        url = f"http://127.0.0.1:{router.port}"
+
+        # leg 1 — hedge win: r0 blackholed, the 0.75s hedge lands on r1
+        code, hedge_out = http_post_json(url + "/v1/generate", doc,
+                                         timeout=120)
+        if code != 200 or hedge_out.get("served_by") != "r1":
+            record({"phase": "chaos", "error":
+                    f"hedge leg: {code} via "
+                    f"{hedge_out.get('served_by')}"})
+            raise SystemExit(1)
+        if hedge_out["token_ids"] != solo:
+            record({"phase": "chaos",
+                    "error": "hedge winner is not bit-identical to "
+                             "solo generate()"})
+            raise SystemExit(1)
+
+        # wait out the blackhole window: r0's leg-1 attempt holds a
+        # router_inflight slot until the RST lands 2.5s after launch,
+        # and the pick key orders on load — leg 2 must find the loads
+        # level again so the name tiebreak sends it back into r0
+        time.sleep(3.0)
+
+        # leg 2 — deadline expiry: timeout_s below the hedge delay, the
+        # only candidate answering in time is blackholed -> honest 504
+        code, out = http_post_json(url + "/v1/generate",
+                                   {**doc, "timeout_s": 0.6},
+                                   timeout=120)
+        if code != 504:
+            record({"phase": "chaos",
+                    "error": f"deadline leg answered {code}: {out}"})
+            raise SystemExit(1)
+
+        # r0's two blackhole aborts land ~2.5s after each launch; wait
+        # for the breaker trip they add up to
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            status = json.loads(http_get(url + "/fleet/status",
+                                         timeout=5)[1])
+            if status["breaker_state"].get("r0") == "open":
+                break
+            time.sleep(0.3)
+        else:
+            record({"phase": "chaos",
+                    "error": "r0 breaker never tripped on the "
+                             "blackhole aborts",
+                    "breaker_state": status.get("breaker_state")})
+            raise SystemExit(1)
+
+        # leg 3 — error_500 burst trips r1; both requests still answer
+        # 200 through r2 (retry + route-around, zero ejections)
+        for i in range(2):
+            code, out = http_post_json(url + "/v1/generate", doc,
+                                       timeout=120)
+            if code != 200 or out.get("served_by") != "r2":
+                record({"phase": "chaos", "error":
+                        f"route-around leg {i}: {code} via "
+                        f"{out.get('served_by')}"})
+                raise SystemExit(1)
+            if out["token_ids"] != solo:
+                record({"phase": "chaos",
+                        "error": f"route-around leg {i} lost parity"})
+                raise SystemExit(1)
+
+        status = json.loads(http_get(url + "/fleet/status",
+                                     timeout=5)[1])
+        checks = {
+            "hedge_wins": status["hedge_wins"] >= 1,
+            "deadline_expired": status["deadline_expired"] >= 1,
+            "breaker_opens": status["breaker_opens"] >= 2,
+            "retries": status["retries"] >= 2,
+            "r1_breaker_open": status["breaker_state"].get("r1") == "open",
+            "zero_ejections": status["replicas_ejected"] == 0,
+            "breaker_open_seconds_booked":
+                status["seconds_by_state"].get("breaker_open", 0) > 0,
+        }
+        if not all(checks.values()):
+            record({"phase": "chaos", "error": "counter checks failed",
+                    "checks": checks, "status": {
+                        k: status[k] for k in (
+                            "hedges", "hedge_wins", "retries",
+                            "deadline_expired", "breaker_opens",
+                            "breaker_state", "replicas_ejected")}})
+            raise SystemExit(1)
+        m = parse_metrics_text(http_get(url + "/metrics", timeout=5)[1])
+        scraped = {k: m[k] for k in (
+            "nanodiloco_router_hedges_total",
+            "nanodiloco_router_hedge_wins_total",
+            "nanodiloco_router_retries_total",
+            "nanodiloco_router_deadline_expired_total",
+            "nanodiloco_router_breaker_opens_total",
+            'nanodiloco_router_breaker_state{replica="r0"}',
+        ) if k in m}
+        if (not m.get("nanodiloco_router_hedge_wins_total")
+                or not m.get("nanodiloco_router_breaker_opens_total")
+                or not m.get("nanodiloco_router_deadline_expired_total")):
+            record({"phase": "chaos",
+                    "error": "router resilience gauges missing from "
+                             "/metrics", "scraped": scraped})
+            raise SystemExit(1)
+        injected = plan.counts()
+        fired = plan.drain_fired()
+    finally:
+        if router is not None:
+            router.stop()
+        for p in proxies:
+            p.stop()
+        for s in servers:
+            s.stop()
+    record({
+        "phase": "chaos",
+        "backend_live": live,
+        "chaos_injected": injected,
+        "chaos_fired": len(fired),
+        "hedge_served_by": hedge_out["served_by"],
+        "parity_streams": 3,
+        "counters": {k: status[k] for k in (
+            "hedges", "hedge_wins", "retries", "retry_budget_exhausted",
+            "deadline_expired", "breaker_opens")},
+        "breaker_state": status["breaker_state"],
+        "breaker_open_s": status["seconds_by_state"].get("breaker_open"),
+        "scraped": scraped,
+    })
+
+
 def phase_slo_watch() -> None:
     """Fleet observability drill on this backend: train a tiny
     checkpoint, boot a 2-replica `serve` fleet behind the `fleet`
@@ -3247,6 +3460,7 @@ PHASES = {
     "spec_decode": phase_spec_decode,
     "tp_decode": phase_tp_decode,
     "fleet": phase_fleet,
+    "chaos": phase_chaos,
     "slo_watch": phase_slo_watch,
     "autoscale_surge": phase_autoscale_surge,
     "devtime": phase_devtime,
@@ -3298,6 +3512,7 @@ PHASE_TIMEOUT_S = {
     "spec_decode": 900,
     "tp_decode": 1200,
     "fleet": 1800,
+    "chaos": 900,
     "slo_watch": 1500,
     "autoscale_surge": 1800,
     "devtime": 1200,

@@ -55,6 +55,17 @@ Workloads:
   regressed, none = admission control broke), and ``class0_ttft_p95_s``
   (the SLO shedding exists to protect).
 
+- ``chaos``: the committed fault drill (``fleet/chaos.py`` DRILL_PLAN —
+  latency, slow-drip, mid-response reset, 500 burst, garbage JSON,
+  flapped healthz, blackhole, hard replica kill) against a 3-replica
+  fleet whose router<->replica wire runs through ``ChaosProxy``s.
+  Clients fire greedy bursts with ``timeout_s=T``; the gate — asserted
+  in-bench and via ``report compare`` against
+  ``bench_serve_chaos_baseline.json`` — is ZERO dropped in-flight
+  streams, every surviving stream bit-identical to solo ``generate()``,
+  no client past T + one hedge delay, and ``chaos_goodput_fraction``
+  holding (``chaos_dropped_streams`` gates both ways, shed-style).
+
 - ``repetitive``: the speculative-decoding sweep. Four legs on the same
   build: templated GREEDY prompts (pattern x reps + unique tail — the
   few-shot/templated shape where prompt-lookup speculation shines,
@@ -113,7 +124,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--step", type=int, default=None)
     p.add_argument("--workload",
                    choices=("uniform", "mixed", "capacity", "repetitive",
-                            "surge"),
+                            "surge", "chaos"),
                    default="uniform",
                    help="uniform: every client cycles --prompt-lens; "
                         "mixed: long-prompt interference + shared-prefix "
@@ -127,7 +138,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "mixed-class open-loop ramp against an "
                         "autoscaled in-process fleet — forecast-driven "
                         "scale-out, class-aware shedding, scale-in "
-                        "after the ramp")
+                        "after the ramp; chaos: the committed fault "
+                        "schedule (fleet/chaos.py DRILL_PLAN) against a "
+                        "3-replica fleet behind chaos proxies — gates "
+                        "zero dropped streams, bit-parity of every "
+                        "surviving stream, and goodput under chaos")
     p.add_argument("--slots", type=int, default=4)
     p.add_argument("--max-len", type=int, default=256)
     p.add_argument("--max-queue", type=int, default=256)
@@ -222,6 +237,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "committed CPU baseline runs --slots 2 "
                         "--max-new-tokens 48 so the tiny model "
                         "actually saturates)")
+    p.add_argument("--chaos-plan", type=str, default=None,
+                   help="[chaos] JSON fault-plan path (fleet/chaos.py "
+                        "format); default: the committed DRILL_PLAN — "
+                        "one fault of every kind against r0/r1/r2")
+    p.add_argument("--chaos-requests", type=int, default=48,
+                   help="[chaos] total requests, fired in concurrent "
+                        "bursts of 3 so every replica accrues the "
+                        "ordinals its scheduled faults key on")
+    p.add_argument("--chaos-prompt-len", type=int, default=24,
+                   help="[chaos] one prompt length for every request "
+                        "(one compiled shape, so the bit-parity replay "
+                        "against solo generate() compiles once)")
+    p.add_argument("--chaos-timeout-s", type=float, default=20.0,
+                   help="[chaos] client timeout_s=T on every request; "
+                        "the gate asserts no client ever waits past "
+                        "T + one hedge delay")
+    p.add_argument("--chaos-hedge-after-s", type=float, default=2.0,
+                   help="[chaos] fixed router hedge delay — above the "
+                        "tiny model's normal latency so only genuinely "
+                        "stuck attempts (blackhole) hedge")
     # speculative decoding (any workload; the repetitive workload's
     # spec-on legs use these, its spec-off legs force 0)
     p.add_argument("--spec-k", type=int, default=None,
@@ -902,6 +937,214 @@ def run_surge(args, cfg, params, jax) -> None:
     print(json.dumps(rec), flush=True)
 
 
+def run_chaos(args, cfg, params, jax) -> None:
+    """The committed fault drill against a 3-replica fleet behind chaos
+    proxies: every byte of router<->replica traffic crosses the chaos
+    wire while clients (clean wire, ``timeout_s=T``) fire greedy
+    requests in concurrent bursts. Gates, asserted in-bench AND via the
+    ``BENCH_SERVE`` record in ``report compare``: ZERO dropped
+    in-flight streams (a client transport error is a drop — honest
+    5xx/503 JSON answers are not), every surviving 200 stream
+    bit-identical to solo ``generate()`` on the same backend, no client
+    waiting past T + one hedge delay, and ``chaos_goodput_fraction``
+    (200s over requests sent) holding against the committed baseline."""
+    from nanodiloco_tpu.fleet import FleetRouter, Replica
+    from nanodiloco_tpu.fleet.chaos import DRILL_PLAN, ChaosPlan, proxy_fleet
+    from nanodiloco_tpu.models.generate import generate
+    from nanodiloco_tpu.serve import (
+        InferenceEngine,
+        Scheduler,
+        ServeServer,
+        http_post_json,
+    )
+
+    plan = (ChaosPlan.load(args.chaos_plan) if args.chaos_plan
+            else ChaosPlan.from_dict(DRILL_PLAN))
+    p_len = args.chaos_prompt_len
+    timeout_s = args.chaos_timeout_s
+
+    def make_server() -> ServeServer:
+        engine = InferenceEngine(
+            params, cfg, num_slots=args.slots,
+            max_len=min(args.max_len, cfg.max_position_embeddings),
+            chunk_size=args.chunk_size,
+            prefix_cache_tokens=args.prefix_cache_tokens,
+            kv_block_size=args.kv_block_size, kv_dtype=args.kv_dtype,
+            kv_pool_blocks=args.kv_pool_blocks, tp=args.tp,
+        )
+        srv = ServeServer(
+            Scheduler(engine, max_queue=args.max_queue),
+            port=0, host="127.0.0.1",
+            max_new_tokens_cap=args.max_new_tokens,
+        ).start()
+        # compile the one prompt bucket + decode BEFORE chaos starts:
+        # warmup goes straight to the replica, so it consumes no proxy
+        # ordinal and cannot eat a scheduled fault
+        code, out = http_post_json(
+            f"http://127.0.0.1:{srv.port}/v1/generate",
+            {"token_ids": [(i * 7 + 3) % cfg.vocab_size
+                           for i in range(p_len)],
+             "max_new_tokens": args.max_new_tokens, "temperature": 0.0,
+             "top_k": 0, "seed": 0, "stop": False, "prefix_cache": False},
+        )
+        if code != 200:
+            srv.stop()
+            raise SystemExit(
+                f"chaos warmup failed with {code}: {out.get('error')}"
+            )
+        return srv
+
+    servers = {f"r{i}": make_server() for i in range(3)}
+
+    def on_kill(name: str) -> None:
+        # a hard replica death WITH streams in flight: the server stops
+        # mid-decode, every later forward to it aborts on the wire
+        srv = servers.get(name)
+        if srv is not None:
+            srv.stop()
+
+    replicas = [Replica(name=n, url=f"http://127.0.0.1:{s.port}")
+                for n, s in servers.items()]
+    proxied, proxies = proxy_fleet(replicas, plan, on_kill=on_kill)
+    router = FleetRouter(
+        proxied, port=0, host="127.0.0.1",
+        health_interval_s=0.2, probe_timeout_s=1.0, quiet=True,
+        request_timeout_s=60.0,
+        hedge_after_s=args.chaos_hedge_after_s,
+        retry_budget_min=10.0, retry_budget_cap=20.0,
+        breaker_window=8, breaker_min_samples=3,
+        breaker_failure_rate=0.5, breaker_open_s=1.5,
+    ).start()
+
+    rng = __import__("random").Random(args.seed)
+    prompts = [[rng.randrange(cfg.vocab_size) for _ in range(p_len)]
+               for _ in range(args.chaos_requests)]
+    results: dict[int, tuple[int, dict, float]] = {}
+    dropped: list[tuple[int, str]] = []
+    lock = threading.Lock()
+
+    def fire(i: int) -> None:
+        t0 = time.monotonic()
+        try:
+            code, out = http_post_json(
+                f"http://127.0.0.1:{router.port}/v1/generate",
+                {"token_ids": prompts[i],
+                 "max_new_tokens": args.max_new_tokens,
+                 "temperature": 0.0, "top_k": 0, "seed": i,
+                 "stop": False, "prefix_cache": False, "priority": 0,
+                 "timeout_s": timeout_s},
+                timeout=timeout_s + args.chaos_hedge_after_s + 10.0,
+            )
+            with lock:
+                results[i] = (code, out, time.monotonic() - t0)
+        except Exception as e:  # a transport failure IS a dropped stream
+            with lock:
+                dropped.append((i, f"{type(e).__name__}: {e}"))
+
+    # concurrent bursts of 3 (one per replica-sized slice of the fleet):
+    # least-loaded routing spreads each burst, so every proxy accrues
+    # the per-target request ordinals its scheduled faults key on
+    t0 = time.monotonic()
+    for base in range(0, args.chaos_requests, 3):
+        burst = [threading.Thread(target=fire, args=(i,))
+                 for i in range(base, min(base + 3, args.chaos_requests))]
+        for w in burst:
+            w.start()
+        for w in burst:
+            w.join()
+    traffic_wall = time.monotonic() - t0
+
+    fleet = router.fleet_stats()
+    router.stop()
+    for p in proxies:
+        p.stop()
+    for srv in servers.values():
+        try:
+            srv.stop()  # the killed replica is already down; harmless
+        except Exception:
+            pass
+
+    # bit-parity replay: every surviving 200 stream against solo
+    # generate() on the same backend — one prompt shape, so the whole
+    # replay reuses ONE compiled program. A deadline-shortened stream
+    # (finish_reason expired/cancelled) must still be a PREFIX of the
+    # solo stream: partial, but never wrong.
+    import numpy as np
+
+    survivors = [(i, out) for i, (code, out, _) in sorted(results.items())
+                 if code == 200]
+    parity_failures = []
+    for i, out in survivors:
+        served = [int(t) for t in out.get("token_ids", [])]
+        solo = generate(
+            params, jax.numpy.asarray([prompts[i]], dtype="int32"),
+            cfg, args.max_new_tokens, temperature=0.0,
+        )
+        solo_list = [int(t) for t in np.asarray(solo)[0][: len(served)]]
+        if served != solo_list or not served:
+            parity_failures.append(i)
+    latencies = sorted(lat for _, (_, _, lat) in results.items())
+    max_lat = latencies[-1] if latencies else 0.0
+    ok = sum(1 for code, _, _ in results.values() if code == 200)
+    sent = args.chaos_requests
+    counts = plan.counts()
+
+    rec = {
+        "metric": "BENCH_SERVE",
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "model": f"random-init llama (hidden {cfg.hidden_size} x "
+                 f"{cfg.num_hidden_layers}L, vocab {cfg.vocab_size})",
+        "workload": "chaos",
+        "tp_degree": args.tp,
+        "slots": args.slots,
+        "requests": sent,
+        "max_new_tokens": args.max_new_tokens,
+        "timeout_s": timeout_s,
+        "hedge_after_s": args.chaos_hedge_after_s,
+        "traffic_wall_s": round(traffic_wall, 3),
+        # the gated chaos contract: drops gate BOTH WAYS (shed-style),
+        # goodput is a share with the absolute band
+        "chaos_dropped_streams": len(dropped),
+        "chaos_goodput_fraction": round(ok / sent, 6) if sent else None,
+        "chaos_parity_streams": len(survivors),
+        "chaos_parity_failures": len(parity_failures),
+        "chaos_injected_total": sum(counts.values()),
+        "chaos_injected_by_kind": counts,
+        "max_client_latency_s": round(max_lat, 3),
+        "latency_p95_s": (round(_pct(latencies, 0.95), 4)
+                          if latencies else None),
+        "hedges": fleet.get("hedges"),
+        "hedge_wins": fleet.get("hedge_wins"),
+        "retries": fleet.get("retries"),
+        "retry_budget_exhausted": fleet.get("retry_budget_exhausted"),
+        "deadline_expired": fleet.get("deadline_expired"),
+        "breaker_opens": fleet.get("breaker_opens"),
+        "fleet_events": fleet.get("events", {}),
+        "seconds_by_state": fleet.get("seconds_by_state"),
+    }
+    print(f"# chaos fleet: injected={json.dumps(counts)} "
+          f"events={json.dumps(fleet.get('events', {}))} "
+          f"dropped={len(dropped)} parity_failures={parity_failures}",
+          file=sys.stderr, flush=True)
+    print(json.dumps(rec), flush=True)
+
+    failures = []
+    if dropped:
+        failures.append(f"{len(dropped)} dropped in-flight streams "
+                        f"(client transport errors): {dropped[:5]}")
+    if parity_failures:
+        failures.append(f"{len(parity_failures)} surviving streams "
+                        f"diverged from solo generate(): "
+                        f"{parity_failures[:5]}")
+    bound = timeout_s + args.chaos_hedge_after_s + 2.0
+    if max_lat > bound:
+        failures.append(f"client latency {max_lat:.2f}s exceeds "
+                        f"timeout_s + hedge + slack = {bound:.2f}s")
+    if failures:
+        raise SystemExit("chaos gate FAILED:\n  - " + "\n  - ".join(failures))
+
+
 def main() -> None:
     args = build_parser().parse_args()
     if args.force_cpu_devices:
@@ -939,6 +1182,9 @@ def main() -> None:
         return
     if args.workload == "surge":
         run_surge(args, cfg, params, jax)
+        return
+    if args.workload == "chaos":
+        run_chaos(args, cfg, params, jax)
         return
     if args.workload == "repetitive":
         if args.spec_k is None:

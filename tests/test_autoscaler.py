@@ -295,3 +295,36 @@ def test_constructor_validation():
         Autoscaler(router, model, provider, hysteresis_ticks=0)
     with pytest.raises(ValueError):
         Autoscaler(router, model, provider, max_shed_floor=10)
+
+
+def test_breaker_open_replicas_excluded_from_capacity_supply():
+    """Chaos x autoscaler wiring: a replica whose circuit breaker is
+    open is routed around, so it is NOT credible supply — every tick
+    pushes the router's breaker-open set into the capacity model's
+    exclusion filter BEFORE estimating. Fakes without the two hooks
+    (older providers, the tests above) are untouched."""
+
+    class BreakerRouter(FakeRouter):
+        def __init__(self):
+            super().__init__(serving=2)
+            self.breaker_open = ["r1"]
+
+        def breaker_open_replicas(self):
+            return list(self.breaker_open)
+
+    class ExcludingModel(FakeModel):
+        def __init__(self):
+            super().__init__()
+            self.excluded = None
+
+        def set_excluded(self, names):
+            self.excluded = list(names)
+
+    router, provider = BreakerRouter(), FakeProvider()
+    model = ExcludingModel()
+    scaler = Autoscaler(router, model, provider, clock=Clock())
+    scaler.tick()
+    assert model.excluded == ["r1"]
+    router.breaker_open = []
+    scaler.tick()
+    assert model.excluded == []          # recovery clears the filter

@@ -1,0 +1,503 @@
+"""Request-level resilience units (fleet/router.py, PR 18).
+
+Every behavior pinned here runs against SCRIPTED probes/posts and an
+injected clock — no sockets, no model, no wall-clock sleeps beyond the
+real-time waits the router itself performs on its result queue:
+
+- deadline propagation: client ``timeout_s`` -> router budget ->
+  per-attempt wire timeout -> replica-side ``deadline_s`` (the router
+  only ever TIGHTENS a client-supplied deadline), honest 504 on expiry;
+- hedged requests: p95-derived (or fixed) hedge delay, first answer
+  wins, the loser is cancelled through ``/v1/cancel``, double-loss
+  returns ONE honest error;
+- token-bucket retry budget: an empty bucket turns retries into honest
+  errors instead of a retry storm, successes refill it;
+- per-replica circuit breaker: rolling-window trip, route-around (not
+  ejection), half-open single-probe recovery, breaker-open seconds as a
+  named fleet-goodput cause;
+- the CONCURRENT health sweep (one blackholed replica costs one probe
+  timeout, not ``(N-1)`` of them) and the flap-vs-dead /healthz 503
+  confirm re-probe;
+- ``summarize_run`` surfacing of the new resilience/chaos keys, old
+  JSONLs summarizing unchanged.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from nanodiloco_tpu.fleet import FleetRouter, Replica
+from nanodiloco_tpu.fleet.router import _Breaker
+from nanodiloco_tpu.training.metrics import summarize_run
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class ScriptedFleet:
+    """Scripted probe/post with per-replica reply overrides, optional
+    blocking (a threading.Event the test releases), and a /v1/cancel
+    log — the hedge-loser test's observable."""
+
+    def __init__(self, names, clock=None):
+        self.docs = {
+            n: {"reachable": True, "live": True, "ready": True,
+                "stats": {"queue_depth": 0, "slots_busy": 0,
+                          "kv_blocks_free": 10, "in_flight": 0}}
+            for n in names
+        }
+        self.posts = []
+        self.generate_reply = {}   # name -> (code, doc) | callable(doc)
+        self.block = {}            # name -> threading.Event to wait on
+        self.clock = clock
+
+    def probe(self, replica):
+        d = self.docs[replica.name]
+        return {k: (dict(v) if isinstance(v, dict) else v)
+                for k, v in d.items()}
+
+    def post(self, replica, path, doc, timeout=None):
+        self.posts.append((replica.name, path, dict(doc)))
+        if path == "/v1/generate":
+            ev = self.block.get(replica.name)
+            if ev is not None:
+                ev.wait(timeout=10.0)
+            r = self.generate_reply.get(
+                replica.name, (200, {"token_ids": [1], "ok": True})
+            )
+            if callable(r):
+                r = r(doc)
+            code, out = r
+            return code, dict(out)
+        if path == "/v1/cancel":
+            return 200, {"cancelled": True}
+        if path == "/admin/drain":
+            self.docs[replica.name]["ready"] = False
+            return 200, {"draining": True}
+        if path == "/admin/resume":
+            self.docs[replica.name]["ready"] = True
+            return 200, {"draining": False}
+        raise AssertionError(path)
+
+
+def _router(tmp_path, names=("r0", "r1"), probe=None, **kw):
+    clock = FakeClock()
+    fleet = ScriptedFleet(names, clock=clock)
+    reps = [Replica(n, f"http://fake/{n}") for n in names]
+    router = FleetRouter(
+        reps, probe=probe or fleet.probe, post=fleet.post, clock=clock,
+        sleep=lambda s: clock.advance(s),
+        events_jsonl=str(tmp_path / "deploy.jsonl"), quiet=True, **kw,
+    )
+    router.health_tick()
+    return router, fleet, clock
+
+
+def _events(tmp_path):
+    path = tmp_path / "deploy.jsonl"
+    if not path.exists():
+        return []
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+def _gen_posts(fleet, name=None):
+    return [(n, d) for n, p, d in fleet.posts
+            if p == "/v1/generate" and (name is None or n == name)]
+
+
+def _wait_for(cond, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# -- deadline propagation -----------------------------------------------------
+
+
+def test_timeout_s_becomes_replica_deadline(tmp_path):
+    router, fleet, _ = _router(tmp_path)
+    code, out = router.handle_generate(
+        {"prompt": [1, 2], "timeout_s": 5.0})
+    assert code == 200 and out["served_by"]
+    [(name, fwd)] = _gen_posts(fleet)
+    # the forwarded body carries the REMAINING budget as deadline_s and
+    # never re-carries timeout_s (that is router-level vocabulary)
+    assert "timeout_s" not in fwd
+    assert 4.0 < fwd["deadline_s"] <= 5.0
+    assert fwd["request_id"] == out["request_id"]
+
+
+def test_router_only_tightens_client_deadline(tmp_path):
+    router, fleet, _ = _router(tmp_path)
+    code, _ = router.handle_generate(
+        {"prompt": [1], "timeout_s": 10.0, "deadline_s": 2.0})
+    assert code == 200
+    [(_, fwd)] = _gen_posts(fleet)
+    assert fwd["deadline_s"] <= 2.0   # min(remaining, client deadline)
+
+    fleet.posts.clear()
+    code, _ = router.handle_generate(
+        {"prompt": [1], "timeout_s": 1.0, "deadline_s": 50.0})
+    assert code == 200
+    [(_, fwd)] = _gen_posts(fleet)
+    assert fwd["deadline_s"] <= 1.0   # never LOOSENED to the client's
+
+
+def test_no_timeout_means_no_injected_deadline(tmp_path):
+    router, fleet, _ = _router(tmp_path)
+    code, _ = router.handle_generate({"prompt": [1]})
+    assert code == 200
+    [(_, fwd)] = _gen_posts(fleet)
+    assert "deadline_s" not in fwd
+
+    fleet.posts.clear()
+    # a client deadline WITHOUT timeout_s still rides through
+    code, _ = router.handle_generate({"prompt": [1], "deadline_s": 3.0})
+    assert code == 200
+    [(_, fwd)] = _gen_posts(fleet)
+    assert fwd["deadline_s"] == 3.0
+
+
+@pytest.mark.parametrize("bad", [0, -1.5, "soon", True, []])
+def test_timeout_s_validation(tmp_path, bad):
+    router, fleet, _ = _router(tmp_path)
+    code, out = router.handle_generate({"prompt": [1], "timeout_s": bad})
+    assert code == 400 and "timeout_s" in out["error"]
+    assert not _gen_posts(fleet)   # rejected before touching a replica
+
+
+def test_deadline_expiry_is_an_honest_504(tmp_path):
+    router, fleet, clock = _router(tmp_path)
+
+    def slow_busy(doc):
+        clock.advance(2.0)         # the attempt burned the whole budget
+        return 429, {"error": "queue full"}
+
+    fleet.generate_reply["r0"] = slow_busy
+    fleet.generate_reply["r1"] = slow_busy
+    code, out = router.handle_generate(
+        {"prompt": [1], "timeout_s": 1.0})
+    assert code == 504
+    assert "deadline" in out["error"]
+    assert out["request_id"]
+    s = router.fleet_stats()
+    assert s["deadline_expired"] == 1
+    # the 504 is NOT a retry-budget event and not a breaker event
+    assert s["retry_budget_exhausted"] == 0
+    assert s["breaker_opens"] == 0
+
+
+# -- hedging ------------------------------------------------------------------
+
+
+def test_hedge_first_answer_wins_and_loser_is_cancelled(tmp_path):
+    router, fleet, _ = _router(tmp_path, hedge_after_s=0.05)
+    stuck = threading.Event()
+    fleet.block["r0"] = stuck      # first pick hangs until released
+    code, out = router.handle_generate({"prompt": [1, 2, 3]})
+    assert code == 200
+    assert out["served_by"] == "r1"
+    rid = out["request_id"]
+    s = router.fleet_stats()
+    assert s["hedges"] == 1 and s["hedge_wins"] == 1
+    # the loser is cancelled through /v1/cancel with the SAME join key
+    # (fire-and-forget thread: poll for the post, then release r0)
+    assert _wait_for(lambda: any(
+        n == "r0" and p == "/v1/cancel" and d == {"request_id": rid}
+        for n, p, d in fleet.posts))
+    stuck.set()
+    # both attempts carried the SAME request_id (trace join contract)
+    assert _wait_for(lambda: len(_gen_posts(fleet)) == 2)
+    assert {d["request_id"] for _, d in _gen_posts(fleet)} == {rid}
+
+
+def test_hedge_double_loss_returns_one_honest_error(tmp_path):
+    router, fleet, _ = _router(tmp_path, hedge_after_s=0.05)
+    stuck = threading.Event()
+    fleet.block["r0"] = stuck
+    fleet.generate_reply["r0"] = (500, {"error": "boom-r0"})
+    fleet.generate_reply["r1"] = (500, {"error": "boom-r1"})
+    threading.Timer(0.3, stuck.set).start()
+    code, out = router.handle_generate({"prompt": [1]})
+    # ONE response: the last replica's own error body, never a
+    # synthesized 503 and never a silent drop
+    assert code == 500
+    assert out["error"].startswith("boom-")
+    assert out["request_id"]
+    assert len(_gen_posts(fleet)) == 2
+    s = router.fleet_stats()
+    assert s["hedges"] == 1 and s["hedge_wins"] == 0
+
+
+def test_hedge_delay_modes(tmp_path):
+    # fixed
+    router, _, _ = _router(tmp_path, hedge_after_s=1.5)
+    assert router._hedge_delay() == 1.5
+    # disabled
+    router, _, _ = _router(tmp_path, hedge_after_s=0)
+    assert router._hedge_delay() is None
+    # adaptive: no delay until enough winner latencies exist, then the
+    # p95 of the recorded window (floored at hedge_min_delay_s)
+    router, _, _ = _router(tmp_path, hedge_min_samples=10)
+    assert router._hedge_delay() is None
+    for i in range(10):
+        router._latencies.append(0.1 * (i + 1))
+    assert router._hedge_delay() == pytest.approx(1.0)
+    router._latencies.clear()
+    router._latencies.extend([0.001] * 10)
+    assert router._hedge_delay() == router.hedge_min_delay_s
+
+
+# -- retry budget -------------------------------------------------------------
+
+
+def test_retry_budget_exhausts_then_refills(tmp_path):
+    router, fleet, _ = _router(
+        tmp_path, hedge_after_s=0, retry_budget_min=1.0,
+        retry_budget_ratio=0.25, breaker_failure_rate=0.9,
+    )
+    fleet.generate_reply["r0"] = (500, {"error": "sick"})
+    # 1 token: the first failover is admitted...
+    code, out = router.handle_generate({"prompt": [1]})
+    assert code == 200 and out["served_by"] == "r1"
+    s = router.fleet_stats()
+    assert s["retries"] == 1 and s["retry_budget_exhausted"] == 0
+    # ...the second is refused — the honest error, no retry storm
+    code, out = router.handle_generate({"prompt": [1]})
+    assert code == 500 and out["error"] == "sick"
+    assert router.fleet_stats()["retry_budget_exhausted"] == 1
+    # successes deposit ratio tokens each; the budget refills
+    fleet.generate_reply["r0"] = (200, {"ok": True})
+    for _ in range(3):
+        code, _ = router.handle_generate({"prompt": [1]})
+        assert code == 200
+    assert router.fleet_stats()["retry_budget_tokens"] >= 1.0
+    fleet.generate_reply["r0"] = (500, {"error": "sick"})
+    code, out = router.handle_generate({"prompt": [1]})
+    assert code == 200 and out["served_by"] == "r1"
+    assert router.fleet_stats()["retries"] == 2
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+
+def _breaker_router(tmp_path):
+    return _router(
+        tmp_path, hedge_after_s=0, retry_budget_min=10.0,
+        breaker_window=4, breaker_min_samples=2,
+        breaker_failure_rate=0.5, breaker_open_s=5.0,
+    )
+
+
+def test_breaker_trips_routes_around_and_books_goodput(tmp_path):
+    router, fleet, clock = _breaker_router(tmp_path)
+    fleet.generate_reply["r0"] = (500, {"error": "gray"})
+    for _ in range(2):
+        code, out = router.handle_generate({"prompt": [1]})
+        assert code == 200 and out["served_by"] == "r1"
+    s = router.fleet_stats()
+    assert s["breaker_opens"] == 1
+    assert s["breaker_state"]["r0"] == "open"
+    assert s["replicas_breaker_open"] == 1
+    assert router.breaker_open_replicas() == ["r0"]
+    assert any(e.get("deploy_event") == "breaker_open" and e["replica"] == "r0"
+               for e in _events(tmp_path))
+    # route-around, not ejection: r0 is skipped while open, still serving
+    fleet.posts.clear()
+    code, out = router.handle_generate({"prompt": [1]})
+    assert code == 200 and out["served_by"] == "r1"
+    assert _gen_posts(fleet) == [("r1", _gen_posts(fleet)[0][1])]
+    assert router.fleet_stats()["replicas_ejected"] == 0
+    # open seconds land in the breaker_open goodput bucket by name
+    clock.advance(4.0)
+    s = router.fleet_stats()
+    assert s["seconds_by_state"]["breaker_open"] == pytest.approx(4.0)
+    assert s["fleet_goodput_fraction"] < 1.0
+
+
+def test_breaker_half_open_single_probe_recovers(tmp_path):
+    router, fleet, clock = _breaker_router(tmp_path)
+    fleet.generate_reply["r0"] = (500, {"error": "gray"})
+    for _ in range(2):
+        router.handle_generate({"prompt": [1]})
+    clock.advance(5.0)             # cooldown elapses on the injected clock
+    router.health_tick()           # advances open -> half_open + drains
+    assert any(e.get("deploy_event") == "breaker_half_open"
+               for e in _events(tmp_path))
+    # the half-open replica is picked only when nothing closed remains
+    fleet.docs["r1"]["ready"] = False
+    router.health_tick()
+    fleet.generate_reply["r0"] = (200, {"ok": True})
+    fleet.posts.clear()
+    code, out = router.handle_generate({"prompt": [1]})
+    assert code == 200 and out["served_by"] == "r0"
+    s = router.fleet_stats()
+    assert s["breaker_state"]["r0"] == "closed"
+    assert router.breaker_open_replicas() == []
+    assert any(e.get("deploy_event") == "breaker_close" and e["replica"] == "r0"
+               for e in _events(tmp_path))
+
+
+def test_breaker_unit_semantics():
+    clock = FakeClock()
+    b = _Breaker(clock, window=4, min_samples=2, failure_rate=0.5,
+                 open_s=3.0)
+    assert b.current() == "closed" and b.rank() == 0
+    b.note(False)
+    assert b.current() == "closed"   # below min_samples
+    b.note(False)
+    assert b.current() == "open" and b.opens == 1 and b.rank() == 2
+    # a straggler attempt's late result never extends the cooldown
+    b.note(True)
+    assert b.current() == "open"
+    clock.advance(3.0)
+    assert b.current() == "half_open" and b.rank() == 1
+    # the probe slot is exclusive: while in flight, rank drops back
+    b._probing = True
+    assert b.rank() == 2
+    # a bad probe re-trips; a later good one closes
+    b.note(False)
+    assert b.current() == "open" and b.opens == 2
+    clock.advance(3.0)
+    assert b.current() == "half_open"
+    b.note(True)
+    assert b.current() == "closed" and b.rank() == 0
+    assert [t for t in b.pending] == [
+        "open", "half_open", "open", "half_open", "close"]
+
+
+def test_slow_success_counts_against_breaker():
+    clock = FakeClock()
+    b = _Breaker(clock, window=4, min_samples=2, failure_rate=0.5,
+                 open_s=3.0, slow_s=1.0)
+    b.note(True, latency_s=5.0)
+    b.note(True, latency_s=5.0)    # gray failure: 200s, but too slow
+    assert b.current() == "open"
+
+
+# -- health sweep -------------------------------------------------------------
+
+
+def test_health_sweep_probes_concurrently(tmp_path):
+    names = ("r0", "r1", "r2")
+    fleet = ScriptedFleet(names)
+    barrier = threading.Barrier(3, timeout=5.0)
+
+    def probe(replica):
+        barrier.wait()             # sequential probing would deadlock
+        return fleet.probe(replica)
+
+    reps = [Replica(n, f"http://fake/{n}") for n in names]
+    router = FleetRouter(
+        reps, probe=probe, post=fleet.post, clock=FakeClock(),
+        sleep=lambda s: None, probe_timeout_s=2.0, quiet=True,
+    )
+    t0 = time.monotonic()
+    router.health_tick()
+    assert time.monotonic() - t0 < 4.0
+    assert router.fleet_stats()["replicas_ready"] == 3
+
+
+def test_single_healthz_flap_survives_persistent_503_ejects(tmp_path):
+    router, fleet, _ = _router(tmp_path, names=("r0", "r1"))
+    flapped = []
+
+    def probe(replica):
+        if replica.name == "r0" and not flapped:
+            flapped.append(True)   # ONE 503: reachable but not live
+            return {"reachable": True, "live": False, "ready": False}
+        return fleet.probe(replica)
+
+    router._probe = probe
+    router.health_tick()
+    # the confirm re-probe saw a live loop: no eject, readiness restored
+    s = router.fleet_stats()
+    assert s["replicas_ejected"] == 0 and s["replicas_ready"] == 2
+    assert not any(e.get("deploy_event") == "eject" for e in _events(tmp_path))
+    # a PERSISTENT 503 (the loop really died) still ejects in one tick
+    fleet.docs["r0"].update(live=False, ready=False)
+    router.health_tick()
+    assert router.fleet_stats()["replicas_ejected"] == 1
+    ejects = [e for e in _events(tmp_path) if e.get("deploy_event") == "eject"]
+    assert ejects and ejects[0]["reason"] == "healthz_503"
+
+
+# -- metrics + summaries ------------------------------------------------------
+
+
+def test_resilience_metric_families_render(tmp_path):
+    router, fleet, _ = _router(tmp_path, hedge_after_s=0.05)
+    stuck = threading.Event()
+    fleet.block["r0"] = stuck
+    router.handle_generate({"prompt": [1]})
+    stuck.set()
+    text = router.render_metrics()
+    for fam in (
+        "nanodiloco_router_hedges_total",
+        "nanodiloco_router_hedge_wins_total",
+        "nanodiloco_router_retries_total",
+        "nanodiloco_router_retry_budget_exhausted_total",
+        "nanodiloco_router_deadline_expired_total",
+        "nanodiloco_router_breaker_opens_total",
+        "nanodiloco_router_retry_budget_tokens",
+        'nanodiloco_router_breaker_state{replica="r0"}',
+    ):
+        assert fam in text, fam
+
+
+def test_summarize_run_surfaces_resilience_and_chaos(tmp_path):
+    path = tmp_path / "m.jsonl"
+    recs = [
+        {"step": 1, "loss": 2.0},
+        {"chaos": "latency", "target": "r0", "ordinal": 1},
+        {"chaos": "kill", "target": "r2", "ordinal": 5},
+        {"chaos": "latency", "target": "r1", "ordinal": 2},
+        {"fleet_goodput": {
+            "fleet_goodput_fraction": 0.8, "replicas_total": 3,
+            "replica_ready_s": 10.0, "hedges": 2, "hedge_wins": 1,
+            "retries": 3, "retry_budget_exhausted": 0,
+            "deadline_expired": 1, "breaker_opens": 1,
+            "seconds_by_state": {"serving_ready": 10.0,
+                                 "breaker_open": 4.5},
+        }},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    out = summarize_run(str(path))
+    assert out["fleet_hedges"] == 2 and out["fleet_hedge_wins"] == 1
+    assert out["fleet_retries"] == 3
+    assert out["fleet_deadline_expired"] == 1
+    assert out["fleet_breaker_opens"] == 1
+    assert out["fleet_breaker_open_s"] == 4.5
+    # zero is not news: exhausted never fired, so no key
+    assert "fleet_retry_budget_exhausted" not in out
+    assert out["chaos_injected_total"] == 3
+    assert out["chaos_kinds"] == {"latency": 2, "kill": 1}
+
+
+def test_summarize_run_tolerates_pre_resilience_jsonl(tmp_path):
+    path = tmp_path / "old.jsonl"
+    recs = [
+        {"step": 1, "loss": 2.0},
+        {"fleet_goodput": {"fleet_goodput_fraction": 0.9,
+                           "replicas_total": 2,
+                           "replica_ready_s": 5.0}},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    out = summarize_run(str(path))
+    assert out["fleet_goodput_fraction"] == 0.9
+    assert not any(k.startswith("fleet_hedge") for k in out)
+    assert "fleet_breaker_open_s" not in out
+    assert "chaos_injected_total" not in out

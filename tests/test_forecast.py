@@ -134,3 +134,30 @@ def test_constructor_validation():
         CapacityModel(store, window_s=0.0)
     with pytest.raises(ValueError):
         CapacityModel(store, beyond_factor=0.0)
+
+
+def test_set_excluded_drops_breaker_open_replicas_from_supply():
+    """A breaker-open replica keeps reporting samples (it is serving,
+    just routed around), so exclusion must happen at the JOIN: its
+    series stay in the store, but targets() — and so every demand and
+    supply sum — leaves it out until the breaker closes."""
+    store = SeriesStore()
+    _fill(store, "r0", 0.0, 6, depth=(4, 0), kv=(10, 0))
+    _fill(store, "r1", 0.0, 6, depth=(2, 0), kv=(50, 0))
+    model = CapacityModel(store, window_s=10.0)
+    assert model.targets() == ["r0", "r1"]
+    base = model.estimate(now=5.0)
+    model.set_excluded(["r1"])
+    assert model.targets() == ["r0"]
+    est = model.estimate(now=5.0)
+    assert est.replicas == 1
+    assert est.kv_blocks_free == pytest.approx(10.0)
+    assert est.queue_depth == pytest.approx(4.0)
+    # explicit-targets models filter the same way
+    explicit = CapacityModel(store, targets=["r0", "r1"], window_s=10.0)
+    explicit.set_excluded(["r0"])
+    assert explicit.targets() == ["r1"]
+    # the breaker closing restores the full join
+    model.set_excluded([])
+    assert model.targets() == ["r0", "r1"]
+    assert model.estimate(now=5.0).replicas == base.replicas == 2
