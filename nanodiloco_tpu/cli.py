@@ -612,6 +612,19 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "without touching correctness — makes this "
                         "replica a straggler for the SLO burn-rate drill "
                         "(chip_agenda slo_watch); 0 (default) disables")
+    p.add_argument("--role", type=str, default="both",
+                   choices=("prefill", "decode", "both"),
+                   help="disaggregation tier this replica declares in "
+                        "its health body: 'prefill' serves admissions "
+                        "and parks KV for export, 'decode' accepts "
+                        "/admin/kv/import handoffs, 'both' (default) is "
+                        "monolithic. Routing only — every replica can "
+                        "physically do either")
+    p.add_argument("--park-ttl-s", type=float, default=30.0,
+                   help="seconds a prefilled-and-parked stream's KV "
+                        "blocks wait for /admin/kv/export before the "
+                        "slot is reclaimed (a crashed router must not "
+                        "leak blocks)")
     return p
 
 
@@ -661,6 +674,7 @@ def serve_main(argv: list[str]) -> None:
     scheduler = Scheduler(
         engine, max_queue=args.max_queue, tracer=tracer,
         starvation_s=args.starvation_s if args.starvation_s > 0 else None,
+        park_ttl_s=args.park_ttl_s,
     )
 
     def swap_loader(ckpt_dir: str, step: int | None):
@@ -688,6 +702,7 @@ def serve_main(argv: list[str]) -> None:
         profile_dir=args.profile_dir,
         swap_loader=swap_loader,
         tick_delay_s=args.inject_tick_delay_s,
+        role=args.role,
     ).start()
     print(
         f"serving {args.checkpoint_dir} on {args.host}:{server.port} "
@@ -755,7 +770,7 @@ def _append_serve_stats(path: str, scheduler) -> None:
         "t_unix": round(time.time(), 3),
         **{k: v for k, v in s.items() if not k.startswith("hist_")},
     }
-    for nested in ("kv_pool", "spec"):
+    for nested in ("kv_pool", "spec", "kvship"):
         if isinstance(rec.get(nested), dict):
             # same scalars-only rule for nested snapshots (block pool,
             # speculation): histograms stay on /metrics
@@ -940,6 +955,31 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                         "kind} records to --events-jsonl. kill faults "
                         "are record-only here (the CLI does not own the "
                         "replica processes) plus the wire abort")
+    # disaggregated prefill/decode serving (fleet/disagg.py): replicas
+    # declare a tier with `serve --role`, the router prefills on one
+    # tier, ships the parked KV (serve/kvship.py), and resumes the
+    # stream on the decode tier — streams stay bit-identical to solo
+    # generate, and any handoff failure degrades to one honest
+    # re-prefill on the decode tier
+    p.add_argument("--disagg", action="store_true",
+                   help="route each request through the prefill tier "
+                        "then hand the KV off to the decode tier "
+                        "(replicas declare tiers via `serve --role`); "
+                        "with no prefill-tier replica ready the fleet "
+                        "behaves exactly like a monolithic router")
+    p.add_argument("--handoff-timeout-s", type=float, default=60.0,
+                   help="bound on the prefill and KV-export legs of a "
+                        "disaggregated handoff (the decode leg runs "
+                        "under the normal request timeout)")
+    p.add_argument("--autoscale-template-decode", type=str, default=None,
+                   metavar="CMD",
+                   help="with --disagg and --autoscale-template: the "
+                        "launch command for DECODE-tier replicas "
+                        "(--autoscale-template then launches the "
+                        "prefill tier; both should pass `serve "
+                        "--role ...`). Enables the two-loop tier "
+                        "autoscaler — each tier sized off its own "
+                        "pinned capacity model")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -985,9 +1025,17 @@ def fleet_main(argv: list[str]) -> None:
         # merged with the replicas' serve shards
         tracer = SpanTracer(clock=time.monotonic,
                             process_name="nanodiloco router")
-    router = FleetRouter(
+    router_cls = FleetRouter
+    router_kw = {}
+    if args.disagg:
+        from nanodiloco_tpu.fleet import DisaggRouter
+
+        router_cls = DisaggRouter
+        router_kw["handoff_timeout_s"] = args.handoff_timeout_s
+    router = router_cls(
         replicas,
         port=args.port, host=args.host,
+        **router_kw,
         events_jsonl=args.events_jsonl,
         health_interval_s=args.health_interval_s,
         eject_after_failures=args.eject_after,
@@ -1004,7 +1052,8 @@ def fleet_main(argv: list[str]) -> None:
         quiet=args.quiet,
     ).start()
     print(
-        f"fleet router on {args.host}:{router.port} over "
+        f"fleet router{' (disaggregated)' if args.disagg else ''} on "
+        f"{args.host}:{router.port} over "
         f"{len(replicas)} replica(s): "
         + ", ".join(f"{r.name}={r.url}" for r in replicas),
         flush=True,
@@ -1043,6 +1092,7 @@ def fleet_main(argv: list[str]) -> None:
                              reason="cli --admission-max-priority")
     scaler_thread = None
     provider = None
+    decode_provider = None
     if args.autoscale_template:
         from nanodiloco_tpu.fleet.autoscaler import (
             Autoscaler,
@@ -1065,8 +1115,7 @@ def fleet_main(argv: list[str]) -> None:
         provider = ProcessReplicaProvider(
             args.autoscale_template, host=args.host,
         )
-        scaler = Autoscaler(
-            router, model, provider,
+        scaler_kw = dict(
             min_replicas=args.autoscale_min,
             max_replicas=args.autoscale_max,
             interval_s=args.autoscale_interval_s,
@@ -1077,6 +1126,28 @@ def fleet_main(argv: list[str]) -> None:
             scale_in_idle_ticks=args.autoscale_idle_ticks,
             shed_horizon_s=args.shed_horizon_s,
         )
+        if args.disagg and args.autoscale_template_decode:
+            # two tier-scoped loops over one fleet: each tier gets its
+            # own provider (role-carrying launch template) and its own
+            # capacity model pinned to that tier's usable replicas; the
+            # decode loop owns the admission ceiling
+            from nanodiloco_tpu.fleet import DisaggAutoscaler, TierAutoscaler
+
+            decode_provider = ProcessReplicaProvider(
+                args.autoscale_template_decode, host=args.host,
+            )
+            decode_model = CapacityModel(
+                collector.store, window_s=args.autoscale_window_s,
+            )
+            scaler = DisaggAutoscaler(
+                TierAutoscaler(router, model, provider,
+                               tier="prefill", **scaler_kw),
+                TierAutoscaler(router, decode_model, decode_provider,
+                               tier="decode", manage_admission=True,
+                               **scaler_kw),
+            )
+        else:
+            scaler = Autoscaler(router, model, provider, **scaler_kw)
 
         def _autoscale_loop() -> None:
             while not stop.is_set():
@@ -1144,6 +1215,8 @@ def fleet_main(argv: list[str]) -> None:
             scaler_thread.join(timeout=10)
         if provider is not None:
             provider.stop_all()
+        if decode_provider is not None:
+            decode_provider.stop_all()
         router.stop()
         for proxy in chaos_proxies:
             proxy.stop()

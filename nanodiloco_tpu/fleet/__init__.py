@@ -22,7 +22,13 @@ Three coupled pieces over the serving stack (nanodiloco_tpu/serve):
   level fault injector (the ``resilience/faults.py`` pattern, keyed by
   request ordinal) that sits in front of a real replica so the router's
   resilience stack (deadlines, hedging, retry budget, circuit breakers)
-  is drill-verified, not review-anecdote.
+  is drill-verified, not review-anecdote;
+- ``disagg.DisaggRouter`` + ``disagg.TierAutoscaler`` /
+  ``disagg.DisaggAutoscaler`` — disaggregated prefill/decode serving:
+  admissions prefill on one tier, the parked KV ships between replicas
+  (``serve/kvship.py``), the stream resumes mid-request on the decode
+  tier, and each tier scales independently off its own pinned capacity
+  model.
 
 ``python -m nanodiloco_tpu fleet --replica URL[,BLACKBOX] ...`` boots
 the router (+ the controller with ``--watch-checkpoint-dir``).
@@ -46,6 +52,11 @@ from nanodiloco_tpu.fleet.deploy import (
     canary_eval_loss,
     latest_checkpoint_step,
 )
+from nanodiloco_tpu.fleet.disagg import (
+    DisaggAutoscaler,
+    DisaggRouter,
+    TierAutoscaler,
+)
 from nanodiloco_tpu.fleet.router import EVENT_KINDS, FleetRouter, Replica
 
 __all__ = [
@@ -54,11 +65,14 @@ __all__ = [
     "ChaosProxy",
     "DRILL_PLAN",
     "DeployController",
+    "DisaggAutoscaler",
+    "DisaggRouter",
     "EVENT_KINDS",
     "FleetRouter",
     "ProcessReplicaProvider",
     "Replica",
     "ReplicaProvider",
+    "TierAutoscaler",
     "canary_bench",
     "canary_eval_loss",
     "chaos_families",

@@ -263,10 +263,17 @@ class Autoscaler:
             )
             self._last_scale_t = now
 
-        # 3) class-aware shedding: pressure = fleet-scope SLO burn, or
-        # exhaustion forecast inside the shed horizon while the fleet
-        # cannot grow any further. One class per tick each way —
-        # shedding is an escalation ladder, not a cliff.
+        # 3) class-aware shedding (an overridable step: the tier-scoped
+        # autoscalers in fleet/disagg.py run TWO loops over one fleet,
+        # and exactly one of them may own the admission ceiling)
+        self._shed_tick(est, rec)
+        return rec
+
+    def _shed_tick(self, est: CapacityEstimate, rec: dict) -> None:
+        """Class-aware shedding: pressure = fleet-scope SLO burn, or
+        exhaustion forecast inside the shed horizon while the fleet
+        cannot grow any further. One class per tick each way —
+        shedding is an escalation ladder, not a cliff."""
         ceiling = self.router.admission_max_priority()
         pressed = self.router.slo_burning()
         eta = est.exhaustion_s() if est.confident else None
@@ -285,7 +292,6 @@ class Autoscaler:
             )
             rec["recovered_to"] = ceiling
         rec["admission_max_priority"] = ceiling
-        return rec
 
     def run(self, stop=None, max_ticks: int | None = None) -> None:
         """Tick until ``stop`` is set (or ``max_ticks`` exhausted)."""

@@ -522,7 +522,8 @@ class FleetRouter:
         except (json.JSONDecodeError, ValueError):
             doc = {}
         for k in ("queue_depth", "slots_busy", "kv_blocks_free",
-                  "deploy_generation", "draining", "device_seconds_total"):
+                  "deploy_generation", "draining", "device_seconds_total",
+                  "role"):
             if doc.get(k) is not None:
                 out["stats"][k] = doc[k]
         try:
@@ -862,12 +863,50 @@ class FleetRouter:
 
     # -- routing -------------------------------------------------------------
 
-    def pick(self) -> _ReplicaState | None:
+    def pick(self, tier: str | None = None) -> _ReplicaState | None:
         """Least-loaded READY replica: lowest queue depth + busy slots
         (+ this router's own in-flight count, which keeps the spread
         honest between health ticks), then MOST free KV blocks, then
-        name for determinism."""
-        return self._pick_excluding(set())
+        name for determinism. ``tier`` restricts candidates to the
+        replicas whose declared role serves that tier (disaggregated
+        serving — see ``_tier_match``)."""
+        return self._pick_excluding(set(), tier=tier)
+
+    @staticmethod
+    def _tier_match(stats: dict, tier: str | None) -> bool:
+        """Does a replica's declared role serve ``tier``? A replica
+        that never declared one (an older serve build) reads as
+        ``both`` — monolithic, eligible for either tier."""
+        if tier is None:
+            return True
+        role = stats.get("role") or "both"
+        return role == tier or role == "both"
+
+    def tier_counts(self) -> dict:
+        """Serving-and-ready replicas by declared role — the
+        ``nanodiloco_fleet_tier_replicas`` gauge and the disagg
+        autoscaler's tier census."""
+        out = {"prefill": 0, "decode": 0, "both": 0}
+        with self._lock:
+            for st in self._states:
+                if st.status == "serving" and st.ready:
+                    role = st.stats.get("role") or "both"
+                    out[role if role in out else "both"] += 1
+        return out
+
+    def tier_capacity_names(self, tier: str | None) -> list[str]:
+        """Replica names that count as USABLE capacity for ``tier``:
+        serving, ready, breaker closed, role matching. This is what the
+        tier-scoped ``CapacityModel`` targets — an open-breaker or
+        draining prefill replica must never count toward decode
+        capacity (nor vice versa)."""
+        with self._lock:
+            return sorted(
+                st.replica.name for st in self._states
+                if st.status == "serving" and st.ready
+                and st.breaker.current() == "closed"
+                and self._tier_match(st.stats, tier)
+            )
 
     def _span(self, name: str, t0: float, t1: float, request_id: str,
               **args) -> None:
@@ -1171,11 +1210,13 @@ class FleetRouter:
         return 503, {"error": "no replica could take the request",
                      "request_id": rid, "tried": sorted(tried)}
 
-    def _pick_excluding(self, names: set[str]) -> _ReplicaState | None:
+    def _pick_excluding(self, names: set[str],
+                        tier: str | None = None) -> _ReplicaState | None:
         with self._lock:
             cands = [st for st in self._states
                      if st.status == "serving" and st.ready
-                     and st.replica.name not in names]
+                     and st.replica.name not in names
+                     and self._tier_match(st.stats, tier)]
             if not cands:
                 return None
 
@@ -1486,6 +1527,17 @@ class FleetRouter:
                     if st.status == "scaling_up"
                 ),
                 "replicas_departed": self._departed_count,
+                # serving-and-ready replicas by declared role — the
+                # disaggregated tier census (all "both" for a
+                # monolithic fleet)
+                "replicas_by_tier": {
+                    role: sum(
+                        1 for st in self._states
+                        if st.status == "serving" and st.ready
+                        and (st.stats.get("role") or "both") == role
+                    )
+                    for role in ("prefill", "decode", "both")
+                },
                 "deploy_generations": {
                     st.replica.name: st.stats.get("deploy_generation")
                     for st in self._states
@@ -1666,4 +1718,20 @@ class FleetRouter:
                 [({"replica": name}, 1)
                  for name in sorted(s["slo_not_preferred"])],
             ))
+        tiers = s.get("replicas_by_tier") or {}
+        if tiers:
+            families.append((
+                "nanodiloco_fleet_tier_replicas", "gauge",
+                "serving-and-ready replicas by declared disaggregation "
+                "role (prefill/decode/both; a monolithic fleet is all "
+                "'both')",
+                [({"tier": t}, n) for t, n in sorted(tiers.items())],
+            ))
+        families.extend(self._extra_metric_families(s))
         return render_exposition(families)
+
+    def _extra_metric_families(self, stats: dict) -> list:
+        """Subclass hook (fleet/disagg.py): extra metric families
+        appended to the router exposition — the DisaggRouter's handoff
+        counters and latency histogram land through here."""
+        return []

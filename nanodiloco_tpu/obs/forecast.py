@@ -112,6 +112,15 @@ class CapacityModel:
         open: still scraping, but not credible capacity)."""
         self._excluded = frozenset(str(n) for n in names)
 
+    def set_targets(self, names) -> None:
+        """Pin the replica set the model joins over, replacing
+        discovery. The disaggregated autoscaler (fleet/disagg.py) calls
+        this every tick with ONE TIER's usable replicas
+        (``FleetRouter.tier_capacity_names``), so a prefill replica's
+        queue and KV headroom never count toward decode capacity — each
+        tier's model sees only its own supply."""
+        self._targets = [str(n) for n in names]
+
     def targets(self) -> list[str]:
         if self._targets is not None:
             names = list(self._targets)

@@ -19,11 +19,20 @@ Layers (each usable alone):
   start_prefill/prefill_step/step/release surface works).
 - ``server.ServeServer`` — stdlib HTTP daemon: ``POST /v1/generate``,
   ``GET /healthz``, ``GET /metrics`` (OpenMetrics serve gauges).
+- ``kvship`` — KV block shipping wire format for disaggregated
+  prefill/decode serving (fleet/disagg.py): a parked prefilled
+  stream's cache rows + resume cursor travel layout-invariantly
+  between replicas via ``/admin/kv/export`` / ``/admin/kv/import``.
 """
 
 from nanodiloco_tpu.serve.block_pool import BlockPool, BlocksExhausted
 from nanodiloco_tpu.serve.client import http_get, http_post_json
 from nanodiloco_tpu.serve.engine import InferenceEngine
+from nanodiloco_tpu.serve.kvship import (
+    ShipFormatError,
+    ShipMismatchError,
+    ShippedKV,
+)
 from nanodiloco_tpu.serve.prefix_cache import PrefixCache
 from nanodiloco_tpu.serve.scheduler import (
     ControlHandle,
@@ -47,6 +56,9 @@ __all__ = [
     "PrefixCache",
     "QueueFull",
     "Scheduler",
+    "ShipFormatError",
+    "ShipMismatchError",
+    "ShippedKV",
     "Ticket",
     "ServeServer",
 ]

@@ -120,6 +120,7 @@ identical; dead slots are masked out of routing entirely (``active``).
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import jax
 import jax.numpy as jnp
@@ -141,6 +142,7 @@ from nanodiloco_tpu.models.generate import (
 )
 from nanodiloco_tpu.obs.devtime import DispatchAccountant
 from nanodiloco_tpu.obs.telemetry import Histogram
+from nanodiloco_tpu.serve import kvship
 from nanodiloco_tpu.serve.block_pool import BlockPool, BlocksExhausted
 from nanodiloco_tpu.serve.prefix_cache import PrefixCache
 from nanodiloco_tpu.serve.speculation import PromptLookupProposer
@@ -405,6 +407,15 @@ class InferenceEngine:
         # its fence-timed sections, keyed by the same (kind, bucket,
         # layout) triples as the compile counts (obs/devtime)
         self.accountant = DispatchAccountant()
+        # KV block shipping meters (serve/kvship.py): payload bytes,
+        # blocks, and wall seconds per direction — the disaggregated
+        # fleet's handoff cost counters, surfaced via kvship_stats()
+        self.kvship_counts = {
+            "export_requests": 0, "import_requests": 0,
+            "export_bytes": 0, "import_bytes": 0,
+            "export_blocks": 0, "import_blocks": 0,
+            "export_seconds": 0.0, "import_seconds": 0.0,
+        }
 
     # -- tensor-parallel plumbing -------------------------------------------
 
@@ -1137,6 +1148,272 @@ class InferenceEngine:
         that slot releases them."""
         self.block_pool.deref(blocks)
         self.kv_block_evictions += len(blocks)
+
+    # -- KV block shipping (serve/kvship.py; fleet/disagg.py) ----------------
+
+    def export_kv(self, slot: int) -> dict:
+        """Export ``slot``'s written KV rows for shipping to another
+        replica (the disaggregated prefill->decode handoff). Returns the
+        layout-invariant raw pieces — ``k``/``v`` as ``[L, pos, Hkv,
+        hd]`` host arrays in the ARENA's storage dtype (plus
+        ``ks``/``vs`` per-row f32 scales from an int8 arena), the
+        fingerprint fields, and the cache cursor ``pos`` — which the
+        server packs into the wire doc together with the cursor the
+        scheduler owns (emitted tokens, request spec). Only blocks
+        actually written travel: a paged export gathers the used blocks
+        device-side and transfers those, never the slot's whole
+        allocation. Read-only: the slot stays live (release is the
+        scheduler's call, after the export is in hand)."""
+        if not self._active[slot]:
+            raise ValueError(f"slot {slot} has no live stream to export")
+        t0 = time.perf_counter()
+        pos = int(self._pos[slot])
+        blocks_moved = 0
+        if self.paged:
+            bs = self.kv_block_size
+            nb = -(-pos // bs)
+            blocks = self._slot_blocks[slot][:nb]
+            idx = jnp.asarray(np.asarray(blocks, np.int32))
+            k = np.asarray(self.pool["k"][:, idx])
+            v = np.asarray(self.pool["v"][:, idx])
+            layers = k.shape[0]
+            k = k.reshape(layers, nb * bs, *k.shape[3:])[:, :pos]
+            v = v.reshape(layers, nb * bs, *v.shape[3:])[:, :pos]
+            ks = vs = None
+            if self.kv_dtype == "int8":
+                ks = np.asarray(self.pool["ks"][:, idx]).reshape(
+                    layers, nb * bs)[:, :pos].astype(np.float32)
+                vs = np.asarray(self.pool["vs"][:, idx]).reshape(
+                    layers, nb * bs)[:, :pos].astype(np.float32)
+            blocks_moved = nb
+        else:
+            k = np.asarray(self.cache["k"][:, slot, :pos])
+            v = np.asarray(self.cache["v"][:, slot, :pos])
+            ks = vs = None
+        out = {
+            "config": kvship.config_fingerprint(self.cfg),
+            "generation": int(self._slot_gen[slot]),
+            "wire_dtype": "int8" if ks is not None else str(k.dtype),
+            "pos": pos,
+            "k": k, "v": v, "ks": ks, "vs": vs,
+        }
+        nbytes = k.nbytes + v.nbytes
+        if ks is not None:
+            nbytes += ks.nbytes + vs.nbytes
+        c = self.kvship_counts
+        c["export_requests"] += 1
+        c["export_bytes"] += int(nbytes)
+        c["export_blocks"] += blocks_moved
+        c["export_seconds"] += time.perf_counter() - t0
+        return out
+
+    def _convert_wire(self, shipped):
+        """Wire rows -> this arena's storage form per the kvship dtype
+        rules: verbatim when bit-parity is preservable, requantize
+        (amax/127) into an int8 arena, dequantize out of an int8 wire;
+        an fp wire into a DIFFERENT fp arena dtype is a loud
+        ``ShipMismatchError`` — never a silent cast."""
+        arena_int8 = self.paged and self.kv_dtype == "int8"
+        wire_int8 = shipped.wire_dtype == "int8"
+        if arena_int8:
+            if wire_int8:
+                return shipped.k, shipped.v, shipped.ks, shipped.vs
+            qk, sk = kvship.quantize_rows(shipped.k)
+            qv, sv = kvship.quantize_rows(shipped.v)
+            return qk, qv, sk, sv
+        cdt = np.asarray(jnp.zeros((), self.cfg.dtype)).dtype
+        if wire_int8:
+            return (kvship.dequantize_rows(shipped.k, shipped.ks, cdt),
+                    kvship.dequantize_rows(shipped.v, shipped.vs, cdt),
+                    None, None)
+        if np.dtype(shipped.k.dtype) != cdt:
+            raise kvship.ShipMismatchError(
+                f"fp wire dtype {shipped.wire_dtype} does not match "
+                f"this arena's {cdt} — casting fp bits across dtypes "
+                "would silently break the bit-parity contract"
+            )
+        return shipped.k, shipped.v, None, None
+
+    def _import_paged(self, slot: int, ids, request, pos: int,
+                      k, v, ks, vs) -> int:
+        """Re-block shipped rows into this engine's pool geometry: the
+        request's FULL block budget is allocated all-or-nothing at
+        refcount 1 (``BlocksExhausted`` stays the retryable admission
+        signal, and ``release`` derefs exactly like a local admission —
+        refcount conservation needs no new path), the written rows land
+        in the leading blocks, and the trailing blocks hold the
+        decode-to-come. Returns the block count the payload filled."""
+        bs = self.kv_block_size
+        need = self.blocks_for(len(ids), request.max_new_tokens)
+        own = self.block_pool.alloc(need)
+        try:
+            nb = -(-pos // bs)
+            layers, heads, hd = k.shape[0], k.shape[2], k.shape[3]
+
+            def blockify(rows):
+                pad = np.zeros((layers, nb * bs, heads, hd), rows.dtype)
+                pad[:, :pos] = rows
+                return pad.reshape(layers, nb, bs, heads, hd)
+
+            idx = jnp.asarray(np.asarray(own[:nb], np.int32))
+            self.pool["k"] = self.pool["k"].at[:, idx].set(
+                jnp.asarray(blockify(k), self.pool["k"].dtype))
+            self.pool["v"] = self.pool["v"].at[:, idx].set(
+                jnp.asarray(blockify(v), self.pool["v"].dtype))
+            if ks is not None:
+
+                def blockify_s(sc):
+                    pad = np.zeros((layers, nb * bs), np.float32)
+                    pad[:, :pos] = sc
+                    return pad.reshape(layers, nb, bs)
+
+                self.pool["ks"] = self.pool["ks"].at[:, idx].set(
+                    jnp.asarray(blockify_s(ks)))
+                self.pool["vs"] = self.pool["vs"].at[:, idx].set(
+                    jnp.asarray(blockify_s(vs)))
+            if self.mesh is not None:
+                self.pool = self._shard_kv(self.pool)
+            row = np.full(self.table_blocks, self.block_pool.num_blocks,
+                          np.int32)
+            row[:need] = own
+            self._tables[slot] = row
+            self._slot_blocks[slot] = own
+            return nb
+        except BaseException:
+            # a failed scatter must not leak the allocation (zero-leak
+            # under mid-ship failure is part of the ship contract)
+            self.block_pool.deref(own)
+            raise
+
+    def import_kv(self, slot: int, request, shipped) -> None:
+        """Import a shipped stream into free slot ``slot`` and resume it
+        mid-request. Validates the fingerprint first (``ShipMismatch
+        Error`` — the server's 409 — on an architecture or weight-
+        generation mismatch: shipped rows from other weights would be
+        silent garbage), re-blocks the rows into this engine's own pool
+        geometry, converts dtypes per the kvship rules, then replicates
+        ``prefill_step``'s activation tail exactly: the PRNG schedule is
+        rebuilt from the request seed (no key material travels), the
+        step cursor from the emitted-token count — so the next decode
+        tick is bit-identical to the tick the exporting replica would
+        have run. The prefix cache is NOT populated from shipped rows
+        (a requantized payload would hand non-parity rows to unrelated
+        local requests)."""
+        if self._active[slot] or self._prefills[slot] is not None:
+            raise ValueError(f"slot {slot} is busy")
+        t0 = time.perf_counter()
+        fp = kvship.config_fingerprint(self.cfg)
+        if shipped.config != fp:
+            raise kvship.ShipMismatchError(
+                f"config fingerprint {shipped.config} does not match "
+                f"this engine ({fp}) — different architecture/config"
+            )
+        if int(shipped.generation) != self.deploy_generation:
+            raise kvship.ShipMismatchError(
+                f"weight generation {shipped.generation} does not match "
+                f"this replica's deploy generation "
+                f"{self.deploy_generation} — resuming across weight "
+                "generations would mix caches from different params"
+            )
+        ids = [int(t) for t in request.prompt]
+        self.validate(ids, request.max_new_tokens)
+        emitted = [int(t) for t in shipped.emitted]
+        if len(ids) != shipped.prompt_len:
+            raise kvship.ShipFormatError(
+                f"request prompt has {len(ids)} tokens but the payload "
+                f"was exported for prompt_len={shipped.prompt_len}"
+            )
+        if len(emitted) > int(request.max_new_tokens):
+            raise kvship.ShipFormatError(
+                f"{len(emitted)} emitted tokens exceed the request's "
+                f"max_new_tokens={request.max_new_tokens}"
+            )
+        bad = [t for t in emitted if not 0 <= t < self.vocab_size]
+        if bad:
+            raise kvship.ShipFormatError(
+                f"emitted tokens {bad[:4]} outside the model vocabulary "
+                f"({self.vocab_size})"
+            )
+        pos = int(shipped.pos)
+        arena = self.pool["k"] if self.paged else self.cache["k"]
+        layers, heads, hd = arena.shape[0], arena.shape[-2], arena.shape[-1]
+        if tuple(shipped.k.shape) != (layers, pos, heads, hd):
+            raise kvship.ShipMismatchError(
+                f"payload rows are {tuple(shipped.k.shape)} but this "
+                f"engine expects [{layers}, {pos}, {heads}, {hd}]"
+            )
+        k, v, ks, vs = self._convert_wire(shipped)
+        if self.paged:
+            blocks_moved = self._import_paged(
+                slot, ids, request, pos, k, v, ks, vs
+            )
+        else:
+            blocks_moved = 0
+            self.cache["k"] = self.cache["k"].at[:, slot, :pos].set(
+                jnp.asarray(k))
+            self.cache["v"] = self.cache["v"].at[:, slot, :pos].set(
+                jnp.asarray(v))
+            if self.mesh is not None:
+                self.cache = self._shard_kv(self.cache)
+        # prefill_step's activation tail, replicated: the one-shot
+        # generate()'s key schedule from the request seed, the cursors
+        # from the shipped emission count
+        req = request
+        temp = float(req.temperature)
+        top_k = min(int(req.top_k), self.vocab_size)
+        top_p = float(req.top_p)
+        key = jax.random.key(int(req.seed))
+        karr = jax.random.split(key)
+        n = int(req.max_new_tokens)
+        self._keys[slot] = (
+            np.asarray(jax.random.key_data(jax.random.split(karr[0], n - 1)),
+                       np.uint32)
+            if n > 1 else np.zeros((0, 2), np.uint32)
+        )
+        self._step_idx[slot] = len(emitted) - 1
+        self._pos[slot] = pos
+        self._key_valid[slot] = 1
+        self._tokens[slot] = emitted[-1]
+        self._temp[slot] = temp
+        self._topk[slot] = top_k
+        self._topp[slot] = top_p
+        self._active[slot] = 1
+        self._slot_gen[slot] = self.deploy_generation
+        self._spec_ok[slot] = bool(self.spec_k) and bool(
+            getattr(req, "speculate", True)
+        )
+        if self._spec_ok[slot]:
+            # the proposer's context is (prompt, emitted...) — replayed
+            # here it reaches the exporter's exact state, and exact
+            # acceptance keeps the stream bit-identical regardless of
+            # what it proposes
+            self.speculator.begin(slot, ids, emitted[0])
+            if len(emitted) > 1:
+                self.speculator.observe(slot, emitted[1:])
+        self._dev = None
+        self._prefills[slot] = None
+        nbytes = shipped.k.nbytes + shipped.v.nbytes
+        if shipped.ks is not None:
+            nbytes += shipped.ks.nbytes + shipped.vs.nbytes
+        c = self.kvship_counts
+        c["import_requests"] += 1
+        c["import_bytes"] += int(nbytes)
+        c["import_blocks"] += blocks_moved
+        c["import_seconds"] += time.perf_counter() - t0
+
+    def kvship_stats(self) -> dict | None:
+        """KV shipping meters for /metrics and the stats JSONL (None
+        until the first ship touches this engine, so non-disaggregated
+        replicas' outputs are unchanged). Flat scalars by design: the
+        stats JSONL's nested-dict filter and ``summarize_run`` consume
+        them directly."""
+        c = self.kvship_counts
+        if not (c["export_requests"] or c["import_requests"]):
+            return None
+        out = dict(c)
+        out["export_seconds"] = round(out["export_seconds"], 6)
+        out["import_seconds"] = round(out["import_seconds"], 6)
+        return out
 
     # -- observability -------------------------------------------------------
 

@@ -118,7 +118,12 @@ class GenRequest:
     optional client-supplied correlation id echoed in the result (and
     stamped on the request's trace spans); absent, the scheduler
     derives one from its rid so client logs, serve spans, and
-    histograms always have a join key."""
+    histograms always have a join key. ``prefill_only`` is the
+    disaggregated-serving admission mode (fleet/disagg.py): the request
+    finishes at its FIRST token with ``finish_reason="prefilled"`` and
+    its slot is PARKED — cache rows intact, not decoding — until
+    ``/admin/kv/export`` ships them to a decode replica (or the park
+    TTL/deadline reclaims the slot)."""
 
     prompt: tuple[int, ...]
     max_new_tokens: int
@@ -132,6 +137,7 @@ class GenRequest:
     priority: int = 1
     prefix_cache: bool = True
     speculate: bool = True
+    prefill_only: bool = False
 
 
 class Ticket:
@@ -216,6 +222,26 @@ class _Prefilling:
 
 
 @dataclasses.dataclass
+class _Parked:
+    """A prefilled stream whose slot is held for KV export (the
+    disaggregated handoff window). The ticket already finished — with
+    ``finish_reason="prefilled"`` and the first token — so nothing
+    waits on this; the slot's cache rows survive until
+    ``export_parked`` ships them, or the deadline/park-TTL sweep
+    reclaims an abandoned handoff."""
+
+    request: GenRequest
+    request_id: str
+    tokens: list[int]
+    submitted_at: float
+    deadline_at: float | None
+    admitted_at: float
+    parked_at: float
+    prefill_device_s: float = 0.0
+    blocks_held: int = 0
+
+
+@dataclasses.dataclass
 class _Running:
     ticket: Ticket
     request: GenRequest
@@ -248,6 +274,7 @@ class Scheduler:
         tracer=None,
         starvation_s: float | None = 30.0,
         prefill_aging_ticks: int = 8,
+        park_ttl_s: float = 30.0,
     ) -> None:
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1; got {max_queue}")
@@ -259,7 +286,13 @@ class Scheduler:
             raise ValueError(
                 f"prefill_aging_ticks must be >= 1; got {prefill_aging_ticks}"
             )
+        if park_ttl_s <= 0:
+            raise ValueError(f"park_ttl_s must be > 0; got {park_ttl_s}")
         self.backend = backend
+        # how long a prefilled slot may sit parked awaiting KV export
+        # before the sweep reclaims it (a crashed/partitioned router
+        # must not leak slots and blocks through abandoned handoffs)
+        self.park_ttl_s = float(park_ttl_s)
         self._clock = clock
         # in-slot aging bound for the per-tick chunk pick (step 4): a
         # mid-prefill slot bypassed this many consecutive ticks gets
@@ -275,7 +308,7 @@ class Scheduler:
         self.tracer = tracer
         self.max_queue = int(max_queue)
         self.starvation_s = starvation_s
-        self._slots: list[_Prefilling | _Running | None] = (
+        self._slots: list[_Prefilling | _Running | _Parked | None] = (
             [None] * backend.num_slots
         )
         self._queue: collections.deque[_Queued] = collections.deque()
@@ -298,6 +331,9 @@ class Scheduler:
         self._expired = 0
         self._cancelled = 0
         self._errors = 0
+        # parked slots reclaimed without export (disagg handoffs the
+        # router abandoned — TTL or deadline fired before /admin/kv/export)
+        self._park_expired = 0
         # class-aware overload shedding: requests whose priority is
         # ABOVE this ceiling are refused at submit (ClassShed -> a
         # terminal 429) so the highest classes' SLO holds while load
@@ -426,6 +462,68 @@ class Scheduler:
             self._control.append(handle)
         return handle
 
+    # -- KV shipping (disaggregated serving; run via call_on_tick) -----------
+
+    def export_parked(self, request_id: str):
+        """Ship a PARKED request's raw KV out and free its slot. Tick
+        thread only (hand it over with ``call_on_tick``). Returns
+        ``(raw_export, parked)`` — the backend's ``export_kv`` dict plus
+        the parked record (cursor, emitted tokens, original request) —
+        or ``None`` when no parked slot matches (expired, already
+        exported, or never here: the server's 404)."""
+        for s, run in enumerate(self._slots):
+            if isinstance(run, _Parked) and run.request_id == request_id:
+                raw = self.backend.export_kv(s)
+                self._backend_release(s)
+                self._slots[s] = None
+                return raw, run
+        return None
+
+    def admit_import(self, request: GenRequest, shipped) -> Ticket:
+        """Admit a SHIPPED stream straight into a free slot, bypassing
+        the queue: the prompt is already prefilled — its KV rows arrive
+        in ``shipped`` — so the slot goes directly to ``_Running`` and
+        the next decode tick resumes the stream mid-request. Tick
+        thread only (``call_on_tick``); the HTTP handler maps the
+        raises: ``ShipMismatchError`` -> 409, ``BlocksExhausted`` /
+        ``QueueFull`` -> 429, anything else -> 400."""
+        slot = next(
+            (s for s in range(len(self._slots)) if self._slots[s] is None),
+            None,
+        )
+        if slot is None:
+            raise QueueFull(
+                "no free KV import slot"
+                f"{self._saturation_detail()}"
+            )
+        with self._lock:
+            ticket = Ticket(self._next_rid)
+            self._next_rid += 1
+        now = self._clock()
+        # raises ShipMismatchError / ShipFormatError / BlocksExhausted /
+        # ValueError having allocated nothing (all-or-nothing import)
+        self.backend.import_kv(slot, request, shipped)
+        held = getattr(self.backend, "blocks_held", None)
+        deadline = (
+            now + request.deadline_s
+            if request.deadline_s is not None else None
+        )
+        run = _Running(
+            ticket, request, now, deadline, now, now,
+            [int(t) for t in shipped.emitted],
+            blocks_held=int(held(slot)) if held is not None else 0,
+        )
+        # a ship can arrive already satisfied (stop token in the emitted
+        # tail, or emitted == max_new_tokens): retire instantly rather
+        # than decode a finished stream
+        reason = self._finish_reason(run, now)
+        if reason is not None:
+            self._backend_release(slot)
+            self._retire(run, reason, now)
+        else:
+            self._slots[slot] = run
+        return ticket
+
     # -- the tick loop (one thread) ------------------------------------------
 
     def tick(self) -> int:
@@ -498,6 +596,20 @@ class Scheduler:
                          prefill_device_s=run.prefill_device_s,
                          kv_block_seconds=(
                              run.blocks_held * (now - run.admitted_at)))
+
+        # 2b. reclaim PARKED slots whose handoff was abandoned: the
+        # ticket already finished ("prefilled"), so this is pure
+        # resource recovery — a router that crashed or partitioned
+        # between prefill and export must not leak the slot and its KV
+        # blocks forever
+        for s, run in enumerate(self._slots):
+            if not isinstance(run, _Parked):
+                continue
+            if ((run.deadline_at is not None and now >= run.deadline_at)
+                    or now - run.parked_at >= self.park_ttl_s):
+                self._backend_release(s)
+                self._slots[s] = None
+                self._park_expired += 1
 
         # 3. admit into free slots in SLO order (priority class, EDF
         # within it, starvation bound on top) — staging only; the model
@@ -642,15 +754,38 @@ class Scheduler:
                                 prefill_device_s=run.prefill_device_s,
                                 blocks_held=run.blocks_held)
                 reason = self._finish_reason(live, t_first)
-                if reason is None:
-                    self._slots[s] = live
-                else:
+                if reason is not None:
                     # prefill already activated the slot in the backend;
                     # an unreleased instant-finish would decode as a
                     # zombie
                     self._backend_release(s)
                     self._slots[s] = None
                     self._retire(live, reason, t_first)
+                elif run.request.prefill_only:
+                    # disaggregated admission: the stream finishes HERE
+                    # with its first token; the slot parks — cache rows
+                    # intact, not decoding — until /admin/kv/export
+                    # ships them (or the TTL/deadline sweep reclaims an
+                    # abandoned handoff). Billing settles now: block
+                    # residency DURING the park is the handoff's cost,
+                    # billed at export/expiry, not to the request.
+                    self._slots[s] = _Parked(
+                        run.request, rid_str, [int(tok0)],
+                        run.submitted_at, run.deadline_at,
+                        run.admitted_at, t_first,
+                        prefill_device_s=run.prefill_device_s,
+                        blocks_held=run.blocks_held,
+                    )
+                    self._served += 1
+                    self._finish(
+                        run.ticket, run.request, [int(tok0)], "prefilled",
+                        run.submitted_at, run.admitted_at, t_first, t_first,
+                        prefill_device_s=run.prefill_device_s,
+                        kv_block_seconds=(
+                            run.blocks_held * (t_first - run.admitted_at)),
+                    )
+                else:
+                    self._slots[s] = live
 
         # 5. one decode step for everyone live. The backend emits a
         # token VECTOR per slot (1..k+1 under speculative decoding;
@@ -934,6 +1069,12 @@ class Scheduler:
             "queue_depth": depth,
             "slots_busy": sum(1 for s in self._slots if s is not None),
             "slots_prefilling": len(prefilling),
+            # slots holding a prefilled stream awaiting KV export (the
+            # disagg handoff window) + handoffs abandoned past the TTL
+            "slots_parked": sum(
+                1 for s in self._slots if isinstance(s, _Parked)
+            ),
+            "park_expired": self._park_expired,
             "slots_total": len(self._slots),
             # chunk backlog: how much staged prefill work is waiting for
             # tick interleave slots — the gauge that shows a long prompt
@@ -1056,6 +1197,14 @@ class Scheduler:
             spec = spec_stats()
             if spec is not None:
                 out["spec"] = spec
+        # KV ship traffic (export/import requests, bytes, blocks,
+        # seconds) — present only once a replica has actually shipped,
+        # so non-disagg stats JSONLs are unchanged
+        kvship_stats = getattr(self.backend, "kvship_stats", None)
+        if kvship_stats is not None:
+            ship = kvship_stats()
+            if ship is not None:
+                out["kvship"] = ship
         # per-program dispatch ledgers from the engine's accountant
         # (device/compile seconds by kind:bucket:layout) — fakes
         # without the accessor omit the key, same as spec/kv above

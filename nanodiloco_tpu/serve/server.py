@@ -70,6 +70,7 @@ from nanodiloco_tpu.obs.telemetry import (
     handle_profile_request,
     render_exposition,
 )
+from nanodiloco_tpu.serve import kvship
 from nanodiloco_tpu.serve.scheduler import (
     ClassShed,
     GenRequest,
@@ -100,9 +101,19 @@ class ServeServer:
         swap_loader=None,
         swap_timeout_s: float = 120.0,
         tick_delay_s: float = 0.0,
+        role: str = "both",
     ) -> None:
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode', or 'both'; got {role!r}"
+            )
         self._scheduler = scheduler
         self._tokenizer = tokenizer
+        # disaggregated-serving tier (fleet/disagg.py): declared in the
+        # health body so the router can route admissions to the prefill
+        # tier and handoffs to the decode tier. "both" (the default) is
+        # a monolithic replica — eligible for either.
+        self.role = role
         # POST /debug/profile?seconds=N target directory (None = the
         # endpoint answers 404; live profiling is an operator opt-in)
         self.profile_dir = profile_dir
@@ -181,7 +192,8 @@ class ServeServer:
                     self._reply_json(code, out)
                     return
                 if path in ("/admin/drain", "/admin/resume", "/admin/swap",
-                            "/admin/admission"):
+                            "/admin/admission", "/admin/kv/export",
+                            "/admin/kv/import"):
                     try:
                         n = int(self.headers.get("Content-Length", 0))
                         doc = json.loads(self.rfile.read(n) or b"{}")
@@ -308,6 +320,14 @@ class ServeServer:
             }
         except QueueFull as e:
             return 429, {"error": str(e)}
+        return self._await_ticket(request, ticket)
+
+    def _await_ticket(self, request: GenRequest,
+                      ticket) -> tuple[int, dict]:
+        """Wait a submitted ticket out and format the HTTP answer — the
+        shared tail of /v1/generate and /admin/kv/import (an imported
+        stream is an in-flight request like any other: cancellable by
+        id, deadline-bounded, same result shape)."""
         # register for /v1/cancel under the SAME id the scheduler will
         # echo (client-supplied, or the scheduler's req-<rid> fallback);
         # a duplicate id overwrites — cancel then targets the newest
@@ -441,6 +461,11 @@ class ServeServer:
             raise ValueError(
                 f"speculate must be a boolean; got {speculate!r}"
             )
+        prefill_only = doc.get("prefill_only", False)
+        if not isinstance(prefill_only, bool):
+            raise ValueError(
+                f"prefill_only must be a boolean; got {prefill_only!r}"
+            )
         deadline = doc.get("deadline_s", self._default_deadline_s)
         # reject impossible shapes at submit time (400), not in the loop
         backend = self._scheduler.backend
@@ -459,7 +484,37 @@ class ServeServer:
             priority=priority,
             prefix_cache=prefix_cache,
             speculate=speculate,
+            prefill_only=prefill_only,
         )
+
+    def _request_spec(self, req: GenRequest, request_id: str) -> dict:
+        """A GenRequest back in wire form — the ``request`` field of a
+        shipped KV payload, so the importing replica rebuilds the EXACT
+        sampling state through its own ``_parse_request`` validation.
+        ``prefill_only`` deliberately does not travel: the import side
+        resumes DECODE. ``deadline_s`` ships as the original relative
+        budget — the decode replica restarts the window at import."""
+        spec = {
+            "token_ids": [int(t) for t in req.prompt],
+            "max_new_tokens": int(req.max_new_tokens),
+            "temperature": float(req.temperature),
+            "top_k": int(req.top_k),
+            "top_p": float(req.top_p),
+            "seed": int(req.seed),
+            "request_id": request_id,
+            "priority": int(req.priority),
+            "prefix_cache": bool(req.prefix_cache),
+            "speculate": bool(req.speculate),
+        }
+        if req.stop_token is not None:
+            spec["stop_token"] = int(req.stop_token)
+        else:
+            # an explicit no-stop must survive the trip: without this,
+            # the importer's default would re-attach its tokenizer EOS
+            spec["stop"] = False
+        if req.deadline_s is not None:
+            spec["deadline_s"] = float(req.deadline_s)
+        return spec
 
     # -- fleet control plane -------------------------------------------------
 
@@ -484,6 +539,10 @@ class ServeServer:
                 }
             except (ValueError, AttributeError) as e:
                 return 400, {"error": str(e)}
+        if path == "/admin/kv/export":
+            return self._handle_kv_export(doc)
+        if path == "/admin/kv/import":
+            return self._handle_kv_import(doc)
         # /admin/swap
         if self._swap_loader is None:
             return 404, {
@@ -526,6 +585,86 @@ class ServeServer:
             **({"step": step} if step is not None else {}),
         }
 
+    # -- KV shipping (disaggregated serving; fleet/disagg.py) ----------------
+
+    def _handle_kv_export(self, doc: dict) -> tuple[int, dict]:
+        """POST /admin/kv/export: ``{"request_id": str}`` — ship a
+        PARKED prefilled stream's KV rows + resume cursor out and free
+        its slot. 404 when nothing by that id is parked (expired past
+        the park TTL, already exported, or never prefilled here)."""
+        rid = doc.get("request_id")
+        if not isinstance(rid, str) or not rid:
+            return 400, {"error": "request_id must be a non-empty string"}
+        if not self.loop_alive():
+            return 503, {"error": "engine loop is not running",
+                         "detail": self._loop_error}
+        sched = self._scheduler
+        handle = sched.call_on_tick(lambda: sched.export_parked(rid))
+        if not handle.wait(self._swap_timeout_s):
+            return 504, {"error": "export did not run within "
+                                  f"{self._swap_timeout_s:.0f}s (tick "
+                                  "loop wedged?)"}
+        if handle.error:
+            return 500, {"error": handle.error}
+        if handle.result is None:
+            return 404, {
+                "error": f"no parked stream {rid!r} (expired, already "
+                         "exported, or never prefilled here)"
+            }
+        raw, parked = handle.result
+        shipped = kvship.ShippedKV(
+            config=raw["config"],
+            generation=raw["generation"],
+            wire_dtype=raw["wire_dtype"],
+            prompt_len=len(parked.request.prompt),
+            pos=raw["pos"],
+            step_idx=len(parked.tokens) - 1,
+            emitted=list(parked.tokens),
+            k=raw["k"], v=raw["v"],
+            ks=raw.get("ks"), vs=raw.get("vs"),
+            request=self._request_spec(parked.request, parked.request_id),
+        )
+        return 200, kvship.pack(shipped)
+
+    def _handle_kv_import(self, doc: dict) -> tuple[int, dict]:
+        """POST /admin/kv/import: body is a packed ship payload
+        (``kvship.pack``) — map the shipped KV rows into this engine's
+        own block pool and resume the stream mid-request. The answer IS
+        the finished generate response (same shape as /v1/generate:
+        the imported stream is in-flight here, cancellable by its id).
+        400 malformed payload, 409 fingerprint mismatch (wrong config /
+        weight generation), 429 no slot or KV blocks right now."""
+        if not self.loop_alive():
+            return 503, {"error": "engine loop is not running",
+                         "detail": self._loop_error}
+        try:
+            shipped = kvship.unpack(doc)
+        except kvship.ShipFormatError as e:
+            return 400, {"error": str(e)}
+        try:
+            request = self._parse_request(dict(shipped.request))
+        except (ValueError, TypeError) as e:
+            return 400, {"error": f"bad shipped request spec: {e}"}
+        sched = self._scheduler
+        handle = sched.call_on_tick(
+            lambda: sched.admit_import(request, shipped)
+        )
+        if not handle.wait(self._swap_timeout_s):
+            return 504, {"error": "import did not run within "
+                                  f"{self._swap_timeout_s:.0f}s (tick "
+                                  "loop wedged?)"}
+        if handle.error:
+            # the tick thread serialized the raise as "Type: message";
+            # map the type back onto the wire contract (409 = the
+            # pairing is wrong and retrying THIS replica is pointless;
+            # 429 = capacity, the router tries another decode replica)
+            if handle.error.startswith("ShipMismatchError"):
+                return 409, {"error": handle.error}
+            if handle.error.startswith(("BlocksExhausted", "QueueFull")):
+                return 429, {"error": handle.error}
+            return 400, {"error": handle.error}
+        return self._await_ticket(request, handle.result)
+
     # -- observability -------------------------------------------------------
 
     def health(self) -> tuple[int, dict]:
@@ -539,8 +678,10 @@ class ServeServer:
             "served": s["served"],
             # the fleet router's routing inputs ride on the liveness
             # body (one GET per health tick, no /metrics parse): current
-            # load, KV headroom, drain state, deploy generation
+            # load, KV headroom, drain state, deploy generation, and the
+            # disaggregated-serving tier this replica belongs to
             "draining": s.get("draining", False),
+            "role": self.role,
         }
         kv = s.get("kv_pool")
         if isinstance(kv, dict) and kv.get("blocks_free") is not None:
@@ -587,6 +728,9 @@ class ServeServer:
              s["slots_busy"]),
             ("nanodiloco_serve_slots_prefilling",
              "slots mid-chunked-prefill", s.get("slots_prefilling")),
+            ("nanodiloco_serve_slots_parked",
+             "slots holding a prefilled stream awaiting KV export (the "
+             "disaggregated handoff window)", s.get("slots_parked")),
             ("nanodiloco_serve_slots_total",
              "decode slots in the engine batch", s["slots_total"]),
             ("nanodiloco_serve_prefill_chunks_pending",
@@ -634,6 +778,54 @@ class ServeServer:
             "prefill chunks run (one per tick interleave slot)",
             [(None, s.get("prefill_chunks_total", 0))],
         ))
+        # disaggregated-serving tier + handoff traffic: the role gauge
+        # (always present — the router's tier map), the abandoned-park
+        # counter, and the KV ship meters (export/import split by the
+        # direction label; present only once a ship has happened)
+        families.append((
+            "nanodiloco_serve_role", "gauge",
+            "disaggregated-serving tier this replica declares (1 under "
+            "its role label: prefill, decode, or both)",
+            [({"role": self.role}, 1)],
+        ))
+        if s.get("park_expired") is not None:
+            families.append((
+                "nanodiloco_serve_park_expired", "counter",
+                "parked prefilled slots reclaimed without export "
+                "(abandoned disaggregated handoffs — TTL or deadline "
+                "fired before /admin/kv/export)",
+                [(None, s["park_expired"])],
+            ))
+        ship = s.get("kvship")
+        if ship is not None:
+            families.append((
+                "nanodiloco_kv_ship_requests", "counter",
+                "KV ship operations by direction (export = parked "
+                "streams shipped out, import = shipped streams resumed "
+                "here)",
+                [({"direction": "export"}, ship["export_requests"]),
+                 ({"direction": "import"}, ship["import_requests"])],
+            ))
+            families.append((
+                "nanodiloco_kv_ship_bytes", "counter",
+                "raw KV payload bytes shipped (pre-base64), by direction",
+                [({"direction": "export"}, ship["export_bytes"]),
+                 ({"direction": "import"}, ship["import_bytes"])],
+            ))
+            families.append((
+                "nanodiloco_kv_ship_blocks", "counter",
+                "KV cache blocks shipped (exporter's block geometry on "
+                "export, importer's on import), by direction",
+                [({"direction": "export"}, ship["export_blocks"]),
+                 ({"direction": "import"}, ship["import_blocks"])],
+            ))
+            families.append((
+                "nanodiloco_kv_ship_seconds", "counter",
+                "host seconds spent gathering/scattering shipped KV, by "
+                "direction",
+                [({"direction": "export"}, ship["export_seconds"]),
+                 ({"direction": "import"}, ship["import_seconds"])],
+            ))
         if s.get("admission_blocked_no_slot") is not None:
             families.append((
                 "nanodiloco_serve_admission_blocked", "counter",

@@ -461,6 +461,23 @@ def summarize_run(path: str) -> dict[str, Any]:
             out["decode_interference_ratio"] = (
                 last["decode_interference_ratio"]
             )
+        # disaggregated serving (PR 19, serve/kvship + fleet/disagg):
+        # parked prefills and KV shipping volume — absent from older
+        # JSONLs, whose summaries are unchanged
+        for key in ("slots_parked", "park_expired"):
+            if last.get(key) is not None:
+                out[f"serve_{key}"] = last[key]
+        ship = last.get("kvship")
+        if isinstance(ship, dict):
+            for key in ("export_requests", "export_bytes", "export_blocks",
+                        "import_requests", "import_bytes", "import_blocks"):
+                if ship.get(key) is not None:
+                    out[f"kv_ship_{key}"] = ship[key]
+            exp = ship.get("export_requests") or 0
+            if exp and ship.get("export_bytes") is not None:
+                out["kv_ship_bytes_per_request"] = round(
+                    ship["export_bytes"] / exp, 1
+                )
     # fleet deployment (nanodiloco_tpu/fleet): the deploy-event timeline
     # a `fleet --events-jsonl` session writes — promote/rollback/eject
     # counts, the last promoted step, and the router's final fleet-
@@ -657,6 +674,17 @@ _COMPARE_METRICS = [
     # summaries carry them.
     ("chaos_goodput_fraction", False),
     ("chaos_dropped_streams", True),
+    # disaggregated serving (serve_bench --workload disagg, PR 19): the
+    # tiered fleet's long-prompt TTFT p95 (latency class/threshold) and
+    # its decode throughput on the decode tier, which the whole split
+    # exists to protect (tps class). kv_ship_bytes_per_request gates
+    # BOTH WAYS on the cost band (_COST_KEYS semantics): heavier ships
+    # mean the wire format bloated, and a wildly LIGHTER ship means the
+    # export stopped carrying the whole cache — both break the
+    # contract. Gated only when both summaries carry them.
+    ("disagg_ttft_p95_s", True),
+    ("disagg_decode_tokens_per_sec", False),
+    ("kv_ship_bytes_per_request", True),
 ]
 
 # share-of-wall-clock keys (already ratios): regress on an ABSOLUTE
@@ -669,7 +697,7 @@ _SHARE_KEYS = {"comm_share_last", "outer_sync_share_sync",
 # serve latency keys (seconds, lower better) that use the dedicated
 # latency threshold instead of the loss one
 _LATENCY_KEYS = {"ttft_p50_s", "ttft_p95_s", "short_ttft_p95_s",
-                 "class0_ttft_p95_s"}
+                 "class0_ttft_p95_s", "disagg_ttft_p95_s"}
 
 # shed counters regress in BOTH directions (see the _COMPARE_METRICS
 # note): |delta| beyond the latency band (relative, floored at 1 so a
@@ -684,8 +712,10 @@ _SLO_BURN_KEYS = {"slo_burn_seconds"}
 # per-token cost keys regress in BOTH directions on the relative
 # latency band: |delta| beyond max_latency_increase x baseline — unlike
 # _SHED_KEYS there is no count floor (the values are tiny fractions of
-# a second, a 1.0 floor would never gate)
-_COST_KEYS = {"device_seconds_per_token"}
+# a second, a 1.0 floor would never gate). kv_ship_bytes_per_request
+# rides the same both-ways band: a heavier ship bloated the wire
+# format, a wildly lighter one stopped shipping the whole cache.
+_COST_KEYS = {"device_seconds_per_token", "kv_ship_bytes_per_request"}
 
 
 def load_comparable(path: str) -> dict[str, Any]:

@@ -2404,6 +2404,289 @@ def phase_chaos() -> None:
     })
 
 
+def phase_disagg() -> None:
+    """Disaggregated prefill/decode drill on this backend: a 1-prefill
+    + 2-decode in-process serve fleet behind a ``DisaggRouter``, every
+    byte crossing a ``ChaosProxy`` wire. Every admitted request is
+    FORCED through the full handoff — prefill_only park on the prefill
+    replica, ``/admin/kv/export`` -> ``/admin/kv/import`` ship, stream
+    resumed mid-request on a decode replica — and every finished
+    stream must be bit-identical to solo ``generate()`` (greedy) or to
+    the same seed-derived doc served monolithically (sampled): the
+    ship format moves the same bits attention would have read locally.
+    The chaos leg blackholes the prefill replica mid-handoff — the
+    router must degrade to ONE honest fallback (a monolithic generate
+    on the decode tier, re-prefilling there) with zero dropped
+    streams, and the tier must heal: the next request hands off again.
+    Tier census, handoff counters, and ship-bytes gauges are scraped
+    from the real ``/metrics`` expositions on both sides of the wire.
+    On CPU this pins the protocol; the interference win the split buys
+    belongs to the chip sitting (bench_serve_disagg_baseline.json)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nanodiloco_tpu.fleet import DisaggRouter, Replica
+    from nanodiloco_tpu.fleet.chaos import ChaosPlan, proxy_fleet
+    from nanodiloco_tpu.models import LlamaConfig, generate, init_params
+    from nanodiloco_tpu.obs.telemetry import parse_metrics_text
+    from nanodiloco_tpu.serve import InferenceEngine, Scheduler, ServeServer
+    from nanodiloco_tpu.serve.client import http_get, http_post_json
+
+    live = chip_is_live()
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, num_hidden_layers=2,
+        max_position_embeddings=128,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    max_new = 24
+    # three prompt lengths straddling the 16-token KV block size: a
+    # partial block, one block + 1 (the gather's off-by-one corner),
+    # and a multi-block prompt
+    prompts = [
+        [(i * 13 + 3) % 256 for i in range(12)],
+        [(i * 7 + 1) % 256 for i in range(17)],
+        [(i * 11 + 5) % 256 for i in range(40)],
+    ]
+    solo = [
+        np.asarray(generate(
+            params, jnp.asarray([p], jnp.int32), cfg, max_new,
+            temperature=0.0,
+        )[0]).tolist()
+        for p in prompts
+    ]
+    sampled_doc = {"token_ids": prompts[0], "max_new_tokens": max_new,
+                   "temperature": 0.9, "top_k": 20, "seed": 7}
+
+    roles = ["prefill", "decode", "decode"]
+    names = ["pf", "d0", "d1"]
+    servers = []
+    for role in roles:
+        eng = InferenceEngine(params, cfg, num_slots=2, max_len=96,
+                              kv_block_size=16)
+        servers.append(ServeServer(Scheduler(eng), port=0,
+                                   host="127.0.0.1",
+                                   max_new_tokens_cap=64,
+                                   role=role).start())
+    router = None
+    proxies = []
+    try:
+        # warm DIRECT to each replica: every prompt bucket on every
+        # replica (the fallback path re-prefills on decode replicas),
+        # checking greedy parity without consuming a chaos ordinal
+        for s in servers:
+            for p, want in zip(prompts, solo):
+                code, out = http_post_json(
+                    f"http://127.0.0.1:{s.port}/v1/generate",
+                    {"token_ids": p, "max_new_tokens": max_new,
+                     "temperature": 0.0},
+                    timeout=600)
+                if code != 200 or out["token_ids"] != want:
+                    record({"phase": "disagg",
+                            "error": f"warmup parity failed ({code})"})
+                    raise SystemExit(1)
+        # the sampled reference comes through the SAME serve stack,
+        # monolithically on d0 — seed-derived sampling means the
+        # handoff boundary must not change a single token
+        code, ref = http_post_json(
+            f"http://127.0.0.1:{servers[1].port}/v1/generate",
+            sampled_doc, timeout=600)
+        if code != 200:
+            record({"phase": "disagg",
+                    "error": f"sampled reference failed ({code})"})
+            raise SystemExit(1)
+        sampled_solo = ref["token_ids"]
+
+        # pf's generate ordinals 0-3 are the four handoff legs below;
+        # ordinal 4 is blackholed mid-handoff (the router's prefill
+        # POST dies on an RST after 2.5s), ordinal 5 is the heal check
+        plan = ChaosPlan.from_dict({"faults": [
+            {"kind": "blackhole", "target": "pf", "requests": [4],
+             "seconds": 2.5},
+        ]})
+        replicas = [Replica(n, f"http://127.0.0.1:{s.port}")
+                    for n, s in zip(names, servers)]
+        proxied, proxies = proxy_fleet(replicas, plan)
+        router = DisaggRouter(
+            proxied, port=0, host="127.0.0.1",
+            health_interval_s=0.3, probe_timeout_s=2.0,
+            handoff_timeout_s=30.0, quiet=True,
+        ).start()
+        url = f"http://127.0.0.1:{router.port}"
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if (router.tier_capacity_names("prefill") == ["pf"]
+                    and len(router.tier_capacity_names("decode")) == 2):
+                break
+            time.sleep(0.2)
+        else:
+            record({"phase": "disagg",
+                    "error": "tiers never became ready"})
+            raise SystemExit(1)
+
+        # leg 1 — forced handoff on every request, greedy parity
+        decode_names = {"d0", "d1"}
+        for i, (p, want) in enumerate(zip(prompts, solo)):
+            code, out = http_post_json(
+                url + "/v1/generate",
+                {"token_ids": p, "max_new_tokens": max_new,
+                 "temperature": 0.0},
+                timeout=600)
+            if (code != 200 or out.get("disagg") != "handoff"
+                    or out.get("prefilled_by") != "pf"
+                    or out.get("served_by") not in decode_names):
+                record({"phase": "disagg", "error":
+                        f"handoff leg {i}: {code} disagg="
+                        f"{out.get('disagg')} via {out.get('served_by')}"})
+                raise SystemExit(1)
+            if out["token_ids"] != want:
+                record({"phase": "disagg", "error":
+                        f"handoff leg {i} (prompt len {len(p)}) is not "
+                        "bit-identical to solo generate()"})
+                raise SystemExit(1)
+
+        # leg 2 — sampled handoff: seed-derived PRNG, so the resumed
+        # stream must match the monolithic reference token for token
+        code, out = http_post_json(url + "/v1/generate", sampled_doc,
+                                   timeout=600)
+        if code != 200 or out.get("disagg") != "handoff":
+            record({"phase": "disagg", "error":
+                    f"sampled handoff: {code} disagg={out.get('disagg')}"})
+            raise SystemExit(1)
+        if out["token_ids"] != sampled_solo:
+            record({"phase": "disagg",
+                    "error": "sampled handoff lost parity with the "
+                             "monolithic serve of the same seed"})
+            raise SystemExit(1)
+
+        # leg 3 — chaos: the prefill POST is blackholed mid-handoff.
+        # One honest fallback (monolithic generate on the decode tier,
+        # re-prefilling there), still 200, still bit-identical.
+        code, out = http_post_json(
+            url + "/v1/generate",
+            {"token_ids": prompts[0], "max_new_tokens": max_new,
+             "temperature": 0.0},
+            timeout=600)
+        if (code != 200 or out.get("disagg") != "fallback"
+                or out.get("served_by") not in decode_names):
+            record({"phase": "disagg", "error":
+                    f"blackhole leg: {code} disagg={out.get('disagg')} "
+                    f"via {out.get('served_by')}"})
+            raise SystemExit(1)
+        if out["token_ids"] != solo[0]:
+            record({"phase": "disagg",
+                    "error": "fallback stream lost parity"})
+            raise SystemExit(1)
+
+        # leg 4 — the tier heals: the blackhole marked pf not-ready;
+        # the health loop must restore it and the next request must
+        # hand off again (the fallback is a degradation, not a latch)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if router.tier_capacity_names("prefill") == ["pf"]:
+                break
+            time.sleep(0.2)
+        else:
+            record({"phase": "disagg",
+                    "error": "prefill tier never healed after the "
+                             "blackhole"})
+            raise SystemExit(1)
+        code, out = http_post_json(
+            url + "/v1/generate",
+            {"token_ids": prompts[1], "max_new_tokens": max_new,
+             "temperature": 0.0},
+            timeout=600)
+        if (code != 200 or out.get("disagg") != "handoff"
+                or out["token_ids"] != solo[1]):
+            record({"phase": "disagg", "error":
+                    f"heal leg: {code} disagg={out.get('disagg')}"})
+            raise SystemExit(1)
+
+        # scrape both sides of the wire
+        status = json.loads(http_get(url + "/fleet/status",
+                                     timeout=5)[1])
+        d = status.get("disagg") or {}
+        checks = {
+            "handoffs": d.get("handoffs", 0) >= 5,
+            "one_fallback": d.get("fallbacks", 0) == 1,
+            "fallback_reason": d.get("fallbacks_by_reason", {}).get(
+                "prefill_unreachable", 0) == 1,
+            "ship_bytes": d.get("ship_bytes", 0) > 0,
+            "tier_census": status.get("replicas_by_tier", {}).get(
+                "prefill") == 1
+                and status["replicas_by_tier"].get("decode") == 2,
+            "zero_ejections": status["replicas_ejected"] == 0,
+        }
+        if not all(checks.values()):
+            record({"phase": "disagg", "error": "counter checks failed",
+                    "checks": checks, "disagg": d,
+                    "replicas_by_tier": status.get("replicas_by_tier")})
+            raise SystemExit(1)
+        m = parse_metrics_text(http_get(url + "/metrics", timeout=5)[1])
+        pf_m = parse_metrics_text(http_get(
+            f"http://127.0.0.1:{servers[0].port}/metrics", timeout=5)[1])
+        dec_m = [parse_metrics_text(http_get(
+            f"http://127.0.0.1:{s.port}/metrics", timeout=5)[1])
+            for s in servers[1:]]
+        scraped = {
+            "fleet_handoffs": m.get("nanodiloco_fleet_handoffs_total"),
+            "fleet_fallbacks": m.get(
+                "nanodiloco_fleet_handoff_fallbacks_total"),
+            "fleet_ship_bytes": m.get("nanodiloco_fleet_ship_bytes_total"),
+            "handoff_seconds_count": m.get(
+                "nanodiloco_fleet_handoff_seconds_count"),
+            "tier_prefill": m.get(
+                'nanodiloco_fleet_tier_replicas{tier="prefill"}'),
+            "tier_decode": m.get(
+                'nanodiloco_fleet_tier_replicas{tier="decode"}'),
+            "pf_role": pf_m.get('nanodiloco_serve_role{role="prefill"}'),
+            "pf_exports": pf_m.get(
+                'nanodiloco_kv_ship_requests_total{direction="export"}'),
+            "dec_imports": sum(
+                dm.get('nanodiloco_kv_ship_requests_total'
+                       '{direction="import"}', 0) for dm in dec_m),
+        }
+        gauge_ok = {
+            "tier_gauges": scraped["tier_prefill"] == 1
+            and scraped["tier_decode"] == 2,
+            "handoff_counters": (scraped["fleet_handoffs"] or 0) >= 5
+            and (scraped["fleet_fallbacks"] or 0) >= 1
+            and (scraped["fleet_ship_bytes"] or 0) > 0,
+            "ship_counters": (scraped["pf_exports"] or 0) >= 5
+            and scraped["dec_imports"] >= 5,
+            "role_gauge": scraped["pf_role"] == 1,
+        }
+        if not all(gauge_ok.values()):
+            record({"phase": "disagg",
+                    "error": "tier/ship gauges missing from /metrics",
+                    "gauge_ok": gauge_ok, "scraped": scraped})
+            raise SystemExit(1)
+        injected = plan.counts()
+        fired = plan.drain_fired()
+    finally:
+        if router is not None:
+            router.stop()
+        for p in proxies:
+            p.stop()
+        for s in servers:
+            s.stop()
+    record({
+        "phase": "disagg",
+        "backend_live": live,
+        "chaos_injected": injected,
+        "chaos_fired": len(fired),
+        "parity_streams": len(prompts) + 1,   # greedy legs + heal leg
+        "sampled_parity": True,
+        "fallback_parity": True,
+        "handoffs": d.get("handoffs"),
+        "fallbacks_by_reason": d.get("fallbacks_by_reason"),
+        "ship_bytes": d.get("ship_bytes"),
+        "handoff_seconds_sum": d.get("handoff_seconds_sum"),
+        "scraped": scraped,
+    })
+
+
 def phase_slo_watch() -> None:
     """Fleet observability drill on this backend: train a tiny
     checkpoint, boot a 2-replica `serve` fleet behind the `fleet`
@@ -3461,6 +3744,7 @@ PHASES = {
     "tp_decode": phase_tp_decode,
     "fleet": phase_fleet,
     "chaos": phase_chaos,
+    "disagg": phase_disagg,
     "slo_watch": phase_slo_watch,
     "autoscale_surge": phase_autoscale_surge,
     "devtime": phase_devtime,
@@ -3513,6 +3797,7 @@ PHASE_TIMEOUT_S = {
     "tp_decode": 1200,
     "fleet": 1800,
     "chaos": 900,
+    "disagg": 1200,
     "slo_watch": 1500,
     "autoscale_surge": 1800,
     "devtime": 1200,

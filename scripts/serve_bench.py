@@ -66,6 +66,22 @@ Workloads:
   no client past T + one hedge delay, and ``chaos_goodput_fraction``
   holding (``chaos_dropped_streams`` gates both ways, shed-style).
 
+- ``disagg``: the disaggregated prefill/decode comparison
+  (``serve/kvship.py`` + ``fleet/disagg.py``). Two fleets of EQUAL
+  device count run the same mixed long-prompt + chatty traffic: a
+  tiered fleet (1 prefill + ``--disagg-decode-replicas`` decode
+  replicas behind a ``DisaggRouter`` — every stream prefills on the
+  prefill tier, its KV ships over the wire, and decode resumes on the
+  decode tier) and a monolithic control (same replica count, all
+  ``role=both``). Gated keys: ``disagg_ttft_p95_s`` (tiered
+  chatty-class first-token latency, end-to-end through the handoff),
+  ``disagg_decode_tokens_per_sec`` (decode-tier token rate — the
+  number long-prompt interference erodes on a monolithic fleet), and
+  ``kv_ship_bytes_per_request`` (both directions: a heavier ship
+  bloated the wire format, a far lighter one stopped carrying the
+  cache). The monolithic control's numbers and the interference ratio
+  ride along in every record.
+
 - ``repetitive``: the speculative-decoding sweep. Four legs on the same
   build: templated GREEDY prompts (pattern x reps + unique tail — the
   few-shot/templated shape where prompt-lookup speculation shines,
@@ -124,7 +140,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--step", type=int, default=None)
     p.add_argument("--workload",
                    choices=("uniform", "mixed", "capacity", "repetitive",
-                            "surge", "chaos"),
+                            "surge", "chaos", "disagg"),
                    default="uniform",
                    help="uniform: every client cycles --prompt-lens; "
                         "mixed: long-prompt interference + shared-prefix "
@@ -142,7 +158,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "schedule (fleet/chaos.py DRILL_PLAN) against a "
                         "3-replica fleet behind chaos proxies — gates "
                         "zero dropped streams, bit-parity of every "
-                        "surviving stream, and goodput under chaos")
+                        "surviving stream, and goodput under chaos; "
+                        "disagg: tiered prefill/decode fleet vs a "
+                        "monolithic fleet of EQUAL device count under "
+                        "mixed long-prompt + chatty traffic — gates the "
+                        "tiered fleet's TTFT, its decode-tier "
+                        "throughput, and the KV ship weight per handoff")
     p.add_argument("--slots", type=int, default=4)
     p.add_argument("--max-len", type=int, default=256)
     p.add_argument("--max-queue", type=int, default=256)
@@ -237,6 +258,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "committed CPU baseline runs --slots 2 "
                         "--max-new-tokens 48 so the tiny model "
                         "actually saturates)")
+    # the disagg workload's tiered-vs-monolithic comparison shape
+    p.add_argument("--disagg-decode-replicas", type=int, default=1,
+                   help="[disagg] decode-tier replicas behind the "
+                        "tiered router (the tiered fleet is 1 prefill "
+                        "+ this many decode; the monolithic control "
+                        "fleet is the SAME total replica count, all "
+                        "role=both — equal device count by "
+                        "construction)")
     p.add_argument("--chaos-plan", type=str, default=None,
                    help="[chaos] JSON fault-plan path (fleet/chaos.py "
                         "format); default: the committed DRILL_PLAN — "
@@ -1145,6 +1174,275 @@ def run_chaos(args, cfg, params, jax) -> None:
         raise SystemExit("chaos gate FAILED:\n  - " + "\n  - ".join(failures))
 
 
+def _disagg_leg(args, cfg, params, *, tiered: bool) -> dict:
+    """One fleet build + mixed-traffic run: ``tiered`` = 1 prefill +
+    ``--disagg-decode-replicas`` decode replicas behind a
+    ``DisaggRouter``; the control is the SAME total replica count, all
+    ``role=both``, behind a plain ``FleetRouter`` — equal device count
+    by construction, so the delta is the disaggregation, not extra
+    hardware. Long prompts run closed-loop, chatty shorts OPEN-LOOP
+    (the only honest way to observe prefill interference — a closed
+    loop self-synchronizes away from the stall, PERF.md)."""
+    from nanodiloco_tpu.fleet import DisaggRouter, FleetRouter, Replica
+    from nanodiloco_tpu.serve import (
+        InferenceEngine,
+        Scheduler,
+        ServeServer,
+        http_post_json,
+    )
+
+    lens = [int(x) for x in args.prompt_lens.split(",") if x]
+    warm_lens = sorted(set(lens) | {args.long_prompt_len})
+
+    def make_server(role: str) -> ServeServer:
+        engine = InferenceEngine(
+            params, cfg, num_slots=args.slots,
+            max_len=min(args.max_len, cfg.max_position_embeddings),
+            chunk_size=args.chunk_size,
+            prefix_cache_tokens=args.prefix_cache_tokens,
+            kv_block_size=args.kv_block_size, kv_dtype=args.kv_dtype,
+            kv_pool_blocks=args.kv_pool_blocks, tp=args.tp,
+        )
+        srv = ServeServer(
+            Scheduler(engine, max_queue=args.max_queue),
+            port=0, host="127.0.0.1",
+            max_new_tokens_cap=args.max_new_tokens,
+            role=role,
+        ).start()
+        # compile every prompt bucket + the decode tick straight at the
+        # replica, outside the timed window (decode replicas too: the
+        # fallback path re-prefills there, and a compile stall inside
+        # the window would corrupt the comparison)
+        for n, p_len in enumerate(warm_lens):
+            code, out = http_post_json(
+                f"http://127.0.0.1:{srv.port}/v1/generate",
+                {"token_ids": [(i * 7 + 3) % cfg.vocab_size
+                               for i in range(p_len)],
+                 "max_new_tokens": 2, "temperature": args.temperature,
+                 "top_k": args.top_k, "seed": 90_000 + n, "stop": False,
+                 "prefix_cache": False},
+            )
+            if code != 200:
+                srv.stop()
+                raise SystemExit(
+                    f"disagg warmup (prompt_len={p_len}) failed with "
+                    f"{code}: {out.get('error')}"
+                )
+        return srv
+
+    n_dec = int(args.disagg_decode_replicas)
+    roles = ((["prefill"] + ["decode"] * n_dec) if tiered
+             else ["both"] * (1 + n_dec))
+    servers = [make_server(r) for r in roles]
+    replicas = [Replica(name=f"r{i}", url=f"http://127.0.0.1:{s.port}")
+                for i, s in enumerate(servers)]
+    router_cls = DisaggRouter if tiered else FleetRouter
+    router = router_cls(
+        replicas, port=0, host="127.0.0.1",
+        health_interval_s=0.2, quiet=True,
+    ).start()
+    # wait for the health loop to see every replica ready (and, tiered,
+    # to learn the roles) — otherwise the first arrivals take the
+    # monolithic fallback and the handoff count lies
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        if len(router.tier_capacity_names(None)) == len(replicas):
+            break
+        time.sleep(0.1)
+    else:
+        raise SystemExit("disagg fleet never became ready")
+
+    results: list[dict] = []
+    errors: list[tuple[int, dict]] = []
+    lock = threading.Lock()
+    rng = __import__("random").Random(args.seed)
+
+    def run_request(doc: dict, cls: str) -> None:
+        code, out = http_post_json(
+            f"http://127.0.0.1:{router.port}/v1/generate", doc,
+            timeout=180.0,
+        )
+        with lock:
+            if code == 200:
+                out["_class"] = cls
+                results.append(out)
+            else:
+                errors.append((code, out))
+
+    t_start = time.monotonic()
+
+    def short_client(cid: int) -> None:
+        workers = []
+        for r in range(args.requests_per_client):
+            p_len = lens[(cid + r) % len(lens)]
+            doc = {
+                "token_ids": [rng.randrange(cfg.vocab_size)
+                              for _ in range(p_len)],
+                "max_new_tokens": args.max_new_tokens,
+                "temperature": args.temperature, "top_k": args.top_k,
+                "seed": cid * 1000 + r, "stop": False,
+                "prefix_cache": False,
+            }
+            due = t_start + (cid + r * args.clients) * args.short_interval_s
+            delay = due - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            w = threading.Thread(target=run_request, args=(doc, "short"))
+            w.start()
+            workers.append(w)
+        for w in workers:
+            w.join()
+
+    def long_client(cid: int) -> None:
+        for r in range(args.requests_per_client):
+            run_request({
+                "token_ids": [rng.randrange(cfg.vocab_size)
+                              for _ in range(args.long_prompt_len)],
+                "max_new_tokens": args.max_new_tokens,
+                "temperature": args.temperature, "top_k": args.top_k,
+                "seed": 500_000 + cid * 1000 + r, "stop": False,
+                "prefix_cache": False,
+            }, "long")
+
+    threads = ([threading.Thread(target=short_client, args=(c,))
+                for c in range(args.clients)]
+               + [threading.Thread(target=long_client, args=(c,))
+                  for c in range(args.long_clients)])
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.monotonic() - t0
+
+    fleet = router.fleet_stats()
+    # decode-tier device economics: tokens per second OF DECODE WORK on
+    # the replicas that serve decode (tiered: the decode tier;
+    # monolithic: everyone) — the number long-prompt interference
+    # erodes, because a prefill chunk interleaved into the tick loop
+    # stretches every live stream's inter-token time
+    decode_tokens = 0
+    decode_s = 0.0
+    for srv, role in zip(servers, roles):
+        if role in ("decode", "both"):
+            s = srv._scheduler.stats()
+            decode_tokens += s.get("decode_tokens") or 0
+            decode_s += s.get("decode_s") or 0.0
+    router.stop()
+    for srv in servers:
+        srv.stop()
+
+    def ttft(r: dict) -> float:
+        # a handoff stream's honest end-to-end first-token latency is
+        # the router's receipt->prefill-reply span; the decode
+        # replica's own timing only covers the resumed tail
+        return r.get("handoff_ttft_s") or r["timing"]["ttft_s"]
+
+    short_ttfts = sorted(ttft(r) for r in results if r["_class"] == "short")
+    long_ttfts = sorted(ttft(r) for r in results if r["_class"] == "long")
+    all_ttfts = sorted(ttft(r) for r in results)
+    disagg = fleet.get("disagg") or {}
+    return {
+        "replicas": len(replicas),
+        "roles": roles,
+        "requests": len(results),
+        "rejected_or_failed": len(errors),
+        "wall_s": round(wall_s, 3),
+        "ttft_p95_s": round(_pct(all_ttfts, 0.95), 4) if all_ttfts else None,
+        "short_ttft_p95_s": (
+            round(_pct(short_ttfts, 0.95), 4) if short_ttfts else None
+        ),
+        "long_ttft_p50_s": (
+            round(_pct(long_ttfts, 0.50), 4) if long_ttfts else None
+        ),
+        "decode_tokens": decode_tokens,
+        "decode_s": round(decode_s, 4),
+        "decode_tokens_per_sec": (
+            round(decode_tokens / decode_s, 1) if decode_s > 0 else None
+        ),
+        "completion_tokens": sum(r["completion_tokens"] for r in results),
+        "handoffs": disagg.get("handoffs", 0),
+        "handoff_fallbacks": disagg.get("fallbacks", 0),
+        "fallbacks_by_reason": disagg.get("fallbacks_by_reason"),
+        "ship_bytes": disagg.get("ship_bytes", 0),
+        "handoff_seconds_sum": disagg.get("handoff_seconds_sum"),
+    }
+
+
+def run_disagg(args, cfg, params, jax) -> None:
+    """Tiered vs monolithic at EQUAL device count under the same mixed
+    long-prompt + chatty traffic, one ``BENCH_SERVE`` record. Gated
+    keys: ``disagg_ttft_p95_s`` (the tiered fleet's chatty-class
+    first-token latency), ``disagg_decode_tokens_per_sec`` (decode-tier
+    token rate — what the split exists to protect from long-prompt
+    interference), and ``kv_ship_bytes_per_request`` (ship weight per
+    handoff, both directions: bloat OR a payload that stopped carrying
+    the cache). The monolithic control's numbers ride along so the
+    interference ratio is visible in every record."""
+    tiered = _disagg_leg(args, cfg, params, tiered=True)
+    if not tiered["handoffs"]:
+        raise SystemExit(
+            "disagg bench invalid: the tiered leg completed zero "
+            "handoffs — every request fell back to the monolithic path"
+        )
+    mono = _disagg_leg(args, cfg, params, tiered=False)
+    ship_per_req = (round(tiered["ship_bytes"] / tiered["handoffs"], 1)
+                    if tiered["handoffs"] else None)
+    d_tps, m_tps = (tiered["decode_tokens_per_sec"],
+                    mono["decode_tokens_per_sec"])
+    rec = {
+        "metric": "BENCH_SERVE",
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "model": f"random-init llama (hidden {cfg.hidden_size} x "
+                 f"{cfg.num_hidden_layers}L, vocab {cfg.vocab_size})",
+        "workload": "disagg",
+        "tp_degree": args.tp,
+        "slots": args.slots,
+        "kv_block_size": args.kv_block_size,
+        "kv_dtype": args.kv_dtype,
+        "disagg_decode_replicas": args.disagg_decode_replicas,
+        "clients": args.clients,
+        "long_clients": args.long_clients,
+        "long_prompt_len": args.long_prompt_len,
+        "short_interval_s": args.short_interval_s,
+        "max_new_tokens": args.max_new_tokens,
+        # the gated disagg contract
+        "disagg_ttft_p95_s": tiered["short_ttft_p95_s"],
+        "disagg_decode_tokens_per_sec": d_tps,
+        "kv_ship_bytes_per_request": ship_per_req,
+        # the monolithic control at the same device count, and the
+        # headline ratio the split is FOR (>= 1 means the decode tier
+        # really is shielded from long-prompt admissions)
+        "mono_ttft_p95_s": mono["short_ttft_p95_s"],
+        "mono_decode_tokens_per_sec": m_tps,
+        "disagg_interference_ratio": (
+            round(d_tps / m_tps, 4) if d_tps and m_tps else None
+        ),
+        "handoffs": tiered["handoffs"],
+        "handoff_fallbacks": tiered["handoff_fallbacks"],
+        "handoff_seconds_sum": tiered["handoff_seconds_sum"],
+        "tiered": tiered,
+        "monolithic": mono,
+    }
+    print(
+        f"# disagg tiered: {tiered['requests']} ok, "
+        f"{tiered['handoffs']} handoffs, "
+        f"{tiered['handoff_fallbacks']} fallbacks, decode "
+        f"{d_tps} tok/s | mono: {mono['requests']} ok, decode "
+        f"{m_tps} tok/s",
+        file=sys.stderr, flush=True,
+    )
+    print(json.dumps(rec), flush=True)
+    if tiered["rejected_or_failed"] or mono["rejected_or_failed"]:
+        raise SystemExit(
+            f"disagg gate FAILED: {tiered['rejected_or_failed']} tiered "
+            f"+ {mono['rejected_or_failed']} monolithic requests "
+            "errored — a handoff failure must degrade to a fallback, "
+            "never an error"
+        )
+
+
 def main() -> None:
     args = build_parser().parse_args()
     if args.force_cpu_devices:
@@ -1185,6 +1483,9 @@ def main() -> None:
         return
     if args.workload == "chaos":
         run_chaos(args, cfg, params, jax)
+        return
+    if args.workload == "disagg":
+        run_disagg(args, cfg, params, jax)
         return
     if args.workload == "repetitive":
         if args.spec_k is None:

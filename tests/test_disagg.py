@@ -1,0 +1,588 @@
+"""Disaggregated-fleet policy tests (nanodiloco_tpu/fleet/disagg).
+
+All router tests run the ScriptedFleet pattern — scripted probe/post
+with an injected clock, no sockets, no model — pinning the TWO-PHASE
+request path (prefill-only admission -> /admin/kv/export ->
+/admin/kv/import) and every degradation edge: a blackholed prefill
+replica, an expired export, an import refusal, a terminal class shed.
+The tier autoscalers run the scripted router/provider/model fakes from
+the base autoscaler suite, pinning tier-scoped capacity (the
+small-fix satellite: an unusable prefill replica never counts toward
+decode supply) and the burn-keyword routing. The wire-level parity
+bar lives in tests/test_kvship.py; the end-to-end socket drill in the
+chip_agenda disagg phase.
+
+Tier-1 budget: host-only; no sockets, no jax, no compiled programs.
+"""
+
+import json
+
+import pytest
+
+from nanodiloco_tpu.fleet import DisaggAutoscaler, DisaggRouter, Replica, TierAutoscaler
+from nanodiloco_tpu.obs.forecast import CapacityEstimate
+
+# a packed ship doc as the router sees it: opaque payload fields whose
+# base64 length is all the router reads (9 raw bytes: 6 in k, 3 in v)
+SHIP = {"config": "cafe", "generation": 0, "wire_dtype": "float32",
+        "k": "AAAAAAAA", "v": "AAAA", "pos": 3, "emitted": [7]}
+SHIP_BYTES = 9
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class DisaggFleet:
+    """Scripted probe/post for a tiered fleet: per-replica health docs
+    carrying the declared role, per-(replica, path) reply overrides
+    (a tuple, a callable, or an exception to raise), and a log of every
+    post with its wire timeout."""
+
+    def __init__(self, roles):
+        self.docs = {
+            n: {"reachable": True, "live": True, "ready": True,
+                "stats": {"queue_depth": 0, "slots_busy": 0,
+                          "kv_blocks_free": 10, "in_flight": 0,
+                          "role": role}}
+            for n, role in roles.items()
+        }
+        self.posts = []
+        self.reply = {}
+
+    def probe(self, replica):
+        d = self.docs[replica.name]
+        return {k: (dict(v) if isinstance(v, dict) else v)
+                for k, v in d.items()}
+
+    def post(self, replica, path, doc, timeout=None):
+        self.posts.append((replica.name, path, dict(doc), timeout))
+        r = self.reply.get((replica.name, path))
+        if isinstance(r, Exception):
+            raise r
+        if callable(r):
+            return r(doc)
+        if r is not None:
+            code, out = r
+            return code, dict(out)
+        if path == "/v1/generate":
+            return 200, {"token_ids": [1, 2], "finish_reason": "length",
+                         "request_id": doc.get("request_id")}
+        raise AssertionError(f"unexpected post: {replica.name} {path}")
+
+
+def _router(tmp_path, roles, **kw):
+    clock = FakeClock()
+    fleet = DisaggFleet(roles)
+    router = DisaggRouter(
+        [Replica(n, f"http://fake/{n}") for n in roles],
+        probe=fleet.probe, post=fleet.post, clock=clock,
+        sleep=lambda s: clock.advance(s),
+        events_jsonl=str(tmp_path / "deploy.jsonl"), quiet=True, **kw,
+    )
+    router.health_tick()
+    return router, fleet, clock
+
+
+def _wire_happy(fleet, pf="pf", dec="d0"):
+    """Script the full happy handoff: prefilled on pf, exported, and
+    the import on ``dec`` answering with the finished stream."""
+    fleet.reply[(pf, "/v1/generate")] = lambda doc: (
+        200, {"token_ids": [7], "finish_reason": "prefilled",
+              "request_id": doc.get("request_id")})
+    fleet.reply[(pf, "/admin/kv/export")] = (200, SHIP)
+    fleet.reply[(dec, "/admin/kv/import")] = (
+        200, {"token_ids": [7, 8, 9], "finish_reason": "length"})
+
+
+ROLES = {"pf": "prefill", "d0": "decode", "d1": "decode"}
+
+
+# -- the two-phase request path ----------------------------------------------
+
+
+def test_handoff_two_phase_path(tmp_path):
+    """The happy handoff: prefill-only admission on the prefill tier,
+    export, import on the least-loaded decode replica — the reply
+    carries both replicas' names, the handoff accounting sticks, and
+    the handoff legs (not the decode stream) run under
+    handoff_timeout_s."""
+    router, fleet, _ = _router(tmp_path, ROLES, handoff_timeout_s=7.5)
+    _wire_happy(fleet)
+    code, out = router.handle_generate(
+        {"token_ids": [5, 9], "max_new_tokens": 4, "stop": False})
+    assert code == 200
+    assert out["disagg"] == "handoff"
+    assert out["prefilled_by"] == "pf" and out["served_by"] == "d0"
+    assert out["token_ids"] == [7, 8, 9]
+    assert out["handoff_ttft_s"] >= 0.0
+    rid = out["request_id"]
+    legs = [(n, p, d.get("request_id"), t) for n, p, d, t in fleet.posts]
+    assert legs == [
+        ("pf", "/v1/generate", rid, 7.5),
+        ("pf", "/admin/kv/export", rid, 7.5),
+        ("d0", "/admin/kv/import", None, None),
+    ]
+    # the prefill leg carried the protocol flag; the import leg carried
+    # the ship doc verbatim
+    assert fleet.posts[0][2]["prefill_only"] is True
+    assert fleet.posts[2][2] == SHIP
+    d = router.fleet_stats()["disagg"]
+    assert d["handoffs"] == 1 and d["fallbacks"] == 0
+    assert d["ship_bytes"] == SHIP_BYTES
+    assert d["handoff_count"] == 1
+    text = router.render_metrics()
+    assert "nanodiloco_fleet_handoffs_total 1" in text
+    assert f"nanodiloco_fleet_ship_bytes_total {SHIP_BYTES}" in text
+    assert "nanodiloco_fleet_handoff_seconds_count 1" in text
+    assert 'nanodiloco_fleet_tier_replicas{tier="prefill"} 1' in text
+    assert 'nanodiloco_fleet_tier_replicas{tier="decode"} 2' in text
+
+
+def test_both_fleet_is_a_dropin_monolith(tmp_path):
+    """A fleet of role=both replicas behind a DisaggRouter behaves
+    exactly like one behind a FleetRouter: no replica DECLARED the
+    prefill role, so no handoff machinery runs — one plain generate."""
+    router, fleet, _ = _router(tmp_path, {"r0": "both", "r1": "both"})
+    code, out = router.handle_generate(
+        {"token_ids": [5], "max_new_tokens": 4, "stop": False})
+    assert code == 200 and "disagg" not in out
+    paths = [p for _, p, _, _ in fleet.posts]
+    assert paths == ["/v1/generate"]
+    assert "prefill_only" not in fleet.posts[0][2]
+    assert router.fleet_stats()["disagg"]["handoffs"] == 0
+
+
+def test_no_decode_tier_stays_monolithic(tmp_path):
+    """A prefill tier with nothing to import into must not park KV
+    nobody will ever fetch: the request takes the base path."""
+    router, fleet, _ = _router(tmp_path, {"pf": "prefill"})
+    code, out = router.handle_generate(
+        {"token_ids": [5], "max_new_tokens": 4, "stop": False})
+    assert code == 200
+    assert "prefill_only" not in fleet.posts[0][2]
+    assert router.fleet_stats()["disagg"]["handoffs"] == 0
+
+
+def test_client_prefill_only_bypasses_the_handoff(tmp_path):
+    """A client explicitly driving the protocol (the chip_agenda
+    harness exporting by hand) gets the base path with its flag intact
+    — the router must not stack its own handoff on top."""
+    router, fleet, _ = _router(tmp_path, ROLES)
+    fleet.reply[("pf", "/v1/generate")] = (
+        200, {"token_ids": [7], "finish_reason": "prefilled"})
+    code, out = router.handle_generate(
+        {"token_ids": [5], "max_new_tokens": 4, "stop": False,
+         "prefill_only": True, "request_id": "mine-1"})
+    assert code == 200
+    gens = [(n, d) for n, p, d, _ in fleet.posts if p == "/v1/generate"]
+    assert len(gens) == 1 and gens[0][1]["prefill_only"] is True
+    assert not any(p.startswith("/admin/kv") for _, p, _, _ in fleet.posts)
+    assert router.fleet_stats()["disagg"]["handoffs"] == 0
+
+
+def test_finished_at_first_token_needs_no_handoff(tmp_path):
+    """A stream that finishes AT its first token (stop token or
+    max_new_tokens == 1): the prefill replica's answer is complete —
+    returned as-is, nothing exported."""
+    router, fleet, _ = _router(tmp_path, ROLES)
+    fleet.reply[("pf", "/v1/generate")] = (
+        200, {"token_ids": [9], "finish_reason": "stop"})
+    code, out = router.handle_generate(
+        {"token_ids": [5], "max_new_tokens": 1, "stop": False})
+    assert code == 200
+    assert out["served_by"] == "pf" and out["token_ids"] == [9]
+    assert len(fleet.posts) == 1
+    d = router.fleet_stats()["disagg"]
+    assert d["handoffs"] == 0 and d["fallbacks"] == 0
+
+
+def test_shed_429_stays_terminal(tmp_path):
+    """Class shed is FLEET policy: a shed 429 from the prefill leg is
+    answered to the client verbatim, never laundered through a
+    fallback that would defeat the overload controller."""
+    router, fleet, _ = _router(tmp_path, ROLES)
+    fleet.reply[("pf", "/v1/generate")] = (
+        429, {"shed": True, "error": "priority 3 shed"})
+    code, out = router.handle_generate(
+        {"token_ids": [5], "max_new_tokens": 4, "stop": False})
+    assert code == 429 and out["shed"] and out["replica"] == "pf"
+    assert len(fleet.posts) == 1
+    d = router.fleet_stats()["disagg"]
+    assert d["fallbacks"] == 0 and d["fallbacks_by_reason"] == {}
+
+
+# -- degradation: every handoff failure is ONE honest fallback ----------------
+
+
+def test_prefill_unreachable_falls_back_and_marks_replica(tmp_path):
+    """The blackholed-prefill case: the wire error degrades to a
+    monolithic generate on the decode tier (same request id, no
+    prefill_only), the replica is marked not-ready so the next pick
+    skips it, and the reason is counted."""
+    router, fleet, _ = _router(tmp_path, ROLES)
+    fleet.reply[("pf", "/v1/generate")] = OSError("connection reset")
+    code, out = router.handle_generate(
+        {"token_ids": [5], "max_new_tokens": 4, "stop": False})
+    assert code == 200
+    assert out["disagg"] == "fallback"
+    assert out["served_by"] in ("d0", "d1")
+    fb = fleet.posts[-1]
+    assert fb[1] == "/v1/generate" and "prefill_only" not in fb[2]
+    assert fb[2]["request_id"] == out["request_id"]
+    d = router.fleet_stats()["disagg"]
+    assert d["handoffs"] == 0 and d["fallbacks"] == 1
+    assert d["fallbacks_by_reason"] == {"prefill_unreachable": 1}
+    # marked not-ready: the tier has no usable capacity until the
+    # health loop heals it
+    assert router.tier_capacity_names("prefill") == []
+    assert router.render_metrics().count(
+        "nanodiloco_fleet_handoff_fallbacks_total 1") == 1
+
+
+def test_prefill_5xx_falls_back_with_the_code_in_the_reason(tmp_path):
+    router, fleet, _ = _router(tmp_path, ROLES)
+    fleet.reply[("pf", "/v1/generate")] = (500, {"error": "boom"})
+    code, out = router.handle_generate(
+        {"token_ids": [5], "max_new_tokens": 4, "stop": False})
+    assert code == 200 and out["disagg"] == "fallback"
+    reasons = router.fleet_stats()["disagg"]["fallbacks_by_reason"]
+    assert reasons == {"prefill_500": 1}
+
+
+def test_export_404_falls_back(tmp_path):
+    """The park TTL (or the deadline) reclaimed the slot before the
+    export landed: re-prefill on the decode tier, count the reason."""
+    router, fleet, _ = _router(tmp_path, ROLES)
+    fleet.reply[("pf", "/v1/generate")] = lambda doc: (
+        200, {"token_ids": [7], "finish_reason": "prefilled",
+              "request_id": doc.get("request_id")})
+    fleet.reply[("pf", "/admin/kv/export")] = (
+        404, {"error": "no parked stream"})
+    code, out = router.handle_generate(
+        {"token_ids": [5], "max_new_tokens": 4, "stop": False})
+    assert code == 200 and out["disagg"] == "fallback"
+    reasons = router.fleet_stats()["disagg"]["fallbacks_by_reason"]
+    assert reasons == {"export_404": 1}
+
+
+def test_import_429_tries_one_other_decode_replica(tmp_path):
+    """A full decode replica (429 import) is capacity, not corruption:
+    ONE other decode replica gets the payload and the handoff
+    completes there."""
+    router, fleet, _ = _router(tmp_path, ROLES)
+    _wire_happy(fleet)
+    fleet.reply[("d0", "/admin/kv/import")] = (429, {"error": "busy"})
+    fleet.reply[("d1", "/admin/kv/import")] = (
+        200, {"token_ids": [7, 8], "finish_reason": "length"})
+    code, out = router.handle_generate(
+        {"token_ids": [5], "max_new_tokens": 4, "stop": False})
+    assert code == 200
+    assert out["disagg"] == "handoff" and out["served_by"] == "d1"
+    imports = [n for n, p, _, _ in fleet.posts if p == "/admin/kv/import"]
+    assert imports == ["d0", "d1"]
+    d = router.fleet_stats()["disagg"]
+    assert d["handoffs"] == 1 and d["fallbacks"] == 0
+
+
+def test_import_409_falls_back_without_spraying(tmp_path):
+    """A 409 fingerprint mismatch (mixed weight generations mid-push)
+    would 409 everywhere — fall back immediately, don't spray the
+    payload across the tier."""
+    router, fleet, _ = _router(tmp_path, ROLES)
+    _wire_happy(fleet)
+    fleet.reply[("d0", "/admin/kv/import")] = (
+        409, {"error": "config fingerprint mismatch"})
+    code, out = router.handle_generate(
+        {"token_ids": [5], "max_new_tokens": 4, "stop": False})
+    assert code == 200 and out["disagg"] == "fallback"
+    imports = [n for n, p, _, _ in fleet.posts if p == "/admin/kv/import"]
+    assert imports == ["d0"]
+    reasons = router.fleet_stats()["disagg"]["fallbacks_by_reason"]
+    assert reasons == {"import_failed": 1}
+
+
+def test_tier_capacity_excludes_draining_and_open_breaker(tmp_path):
+    """The small-fix satellite at the router: tier capacity counts
+    serving + ready + breaker-closed + role-matching replicas ONLY —
+    a draining prefill replica or an open-breaker decode replica is
+    routed around, so it is not credible supply for its tier (and
+    never for the OTHER tier)."""
+    router, fleet, _ = _router(tmp_path, ROLES)
+    assert router.tier_capacity_names("prefill") == ["pf"]
+    assert router.tier_capacity_names("decode") == ["d0", "d1"]
+    fleet.docs["pf"]["ready"] = False          # draining
+    router.health_tick()
+    assert router.tier_capacity_names("prefill") == []
+    assert router.tier_capacity_names("decode") == ["d0", "d1"]
+    fleet.docs["pf"]["ready"] = True
+    st = next(s for s in router._states if s.replica.name == "d1")
+    for _ in range(5):
+        st.breaker.note(False)                 # trip d1's breaker
+    router.health_tick()
+    assert st.breaker.current() == "open"
+    assert router.tier_capacity_names("prefill") == ["pf"]
+    assert router.tier_capacity_names("decode") == ["d0"]
+
+
+# -- tier-scoped autoscaling --------------------------------------------------
+
+
+def est(*, kv_eta=None, q_eta=None, slope=0.0, confident=True):
+    return CapacityEstimate(
+        at=0.0, replicas=2, queue_depth=1.0, queue_slope=slope,
+        request_rate=1.0, kv_blocks_free=100.0, kv_exhaustion_s=kv_eta,
+        queue_exhaustion_s=q_eta, horizon_s=10.0, confident=confident,
+    )
+
+
+PRESSURE = est(kv_eta=5.0, slope=2.0)
+HEADROOM = est(slope=-0.5)
+NEUTRAL = est(slope=1.0)
+
+
+class TierRouter:
+    """Scripted tiered fleet for the autoscaler loops: serving replicas
+    with declared roles, booting ones with none yet (a booting replica
+    has not answered a health probe)."""
+
+    def __init__(self, roles):
+        self.roles = dict(roles)
+        self.scaling = set()
+        self.events = []
+        self.removed = []
+        self.admission = 9
+        self.burning = []          # fleet-scope burning SLO rule names
+        self.tiers = {}            # tier -> usable-names override
+
+    def fleet_stats(self):
+        return {"replicas_serving": len(self.roles),
+                "replicas_scaling_up": len(self.scaling)}
+
+    def add_replica(self, replica, source=None):
+        self.scaling.add(replica.name)
+
+    def remove_replica(self, name, drain=True, reason=None):
+        self.roles.pop(name, None)
+        self.scaling.discard(name)
+        self.removed.append((name, drain, reason))
+
+    def replica_names(self):
+        return list(self.roles) + sorted(self.scaling)
+
+    def state_of(self, name):
+        if name in self.scaling:
+            return {"status": "scaling_up", "stats": {}}
+        return {"status": "serving", "stats": {"role": self.roles[name]}}
+
+    def log_event(self, kind, replica=None, reason=None):
+        self.events.append((kind, replica, reason))
+
+    def admission_max_priority(self):
+        return self.admission
+
+    def set_admission(self, n, reason=None):
+        self.admission = n
+        return n
+
+    def slo_burning(self):
+        return bool(self.burning)
+
+    def slo_state(self):
+        return {"slo_fleet_burning": list(self.burning)}
+
+    def tier_capacity_names(self, tier):
+        if tier in self.tiers:
+            return list(self.tiers[tier])
+        return sorted(n for n, r in self.roles.items()
+                      if r in (tier, "both"))
+
+
+class TierProvider:
+    def __init__(self):
+        self.seq = 0
+        self.retired = []
+
+    def launch(self):
+        self.seq += 1
+        return Replica(name=f"auto{self.seq}", url="http://test")
+
+    def retire(self, name):
+        self.retired.append(name)
+
+    def preempted(self):
+        return []
+
+
+class TierModel:
+    def __init__(self, estimate=NEUTRAL):
+        self.current = estimate
+        self.targets = None
+
+    def estimate(self, now):
+        return self.current
+
+    def set_targets(self, names):
+        self.targets = list(names)
+
+
+def make_tier(roles, tier, estimate=NEUTRAL, **kw):
+    router = TierRouter(roles)
+    provider, model, clock = TierProvider(), TierModel(estimate), FakeClock()
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("cooldown_s", 1.0)
+    kw.setdefault("hysteresis_ticks", 2)
+    kw.setdefault("scale_out_horizon_s", 30.0)
+    kw.setdefault("scale_in_idle_ticks", 2)
+    scaler = TierAutoscaler(router, model, provider, tier=tier,
+                            clock=clock, **kw)
+    return scaler, router, provider, model, clock
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError, match="tier"):
+        make_tier(ROLES, "mixed")
+    pf = make_tier(ROLES, "prefill")[0]
+    dec = make_tier(ROLES, "decode")[0]
+    with pytest.raises(ValueError, match="prefill-tier"):
+        DisaggAutoscaler(dec, pf)
+    pf2 = make_tier(ROLES, "prefill", manage_admission=True)[0]
+    dec2 = make_tier(ROLES, "decode", manage_admission=True)[0]
+    with pytest.raises(ValueError, match="admission"):
+        DisaggAutoscaler(pf2, dec2)
+
+
+def test_model_pinned_to_tier_usable_supply_every_tick():
+    """THE tier-scoped capacity fix: before estimating, the loop pins
+    its CapacityModel to the replicas that are usable FOR ITS TIER —
+    an open-breaker or draining prefill replica never counts toward
+    decode capacity."""
+    scaler, router, _, model, clock = make_tier(ROLES, "decode")
+    router.tiers["decode"] = ["d0", "d1"]
+    scaler.tick()
+    assert model.targets == ["d0", "d1"]
+    router.tiers["decode"] = ["d0"]       # d1 tripped its breaker
+    clock.t = 1.0
+    scaler.tick()
+    assert model.targets == ["d0"]
+
+
+def test_fleet_size_and_launch_are_tier_scoped():
+    """The decode loop's census counts decode replicas (+ its own
+    boots) only; its launches are tagged with the tier; a boot the
+    OTHER tier's loop started is never counted here."""
+    scaler, router, provider, _, clock = make_tier(
+        ROLES, "decode", estimate=PRESSURE)
+    assert scaler._fleet_size() == 2      # d0 + d1, never pf
+    scaler.tick()
+    clock.t = 2.0
+    rec = scaler.tick()
+    assert rec["scaled_up"] == ["auto1"] and rec["tier"] == "decode"
+    assert "auto1" in scaler._mine
+    assert scaler._fleet_size() == 3      # the booting auto1 is mine
+    kind, name, reason = router.events[-1]
+    assert kind == "scale_up" and name == "auto1"
+    assert reason.startswith("[decode]")
+    # the prefill loop over the SAME fleet does not count that boot
+    other = make_tier(ROLES, "prefill")[0]
+    other.router = router
+    assert not other._in_tier("auto1")
+    assert other._fleet_size() == 1       # pf only
+
+
+def test_retire_scoped_to_tier_newest_first():
+    roles = {"pf": "prefill", "d0": "decode", "d1": "decode",
+             "d2": "decode"}
+    scaler, router, provider, _, clock = make_tier(
+        roles, "decode", estimate=HEADROOM, scale_in_idle_ticks=2,
+        min_replicas=1)
+    scaler.tick()
+    clock.t = 2.0
+    rec = scaler.tick()
+    assert rec["scaled_down"] == ["d2"]
+    assert router.removed == [("d2", True, "scale_down")]
+    assert provider.retired == ["d2"]
+    assert "pf" in router.roles           # the other tier is untouched
+    _, name, reason = router.events[-1]
+    assert name == "d2" and reason.startswith("[decode]")
+
+
+def test_burn_keyword_routes_the_scale_vote_to_its_tier():
+    """PR-15 burn signals drive the split: a TTFT burn is prefill
+    starvation — the prefill loop scales out on it (even on a neutral
+    forecast), the decode loop holds."""
+    pf, router, *_ , clock = make_tier(ROLES, "prefill", estimate=NEUTRAL)
+    router.burning = ["serve_ttft_p95_burn"]
+    pf.tick()
+    clock.t = 2.0
+    rec = pf.tick()
+    assert rec["scaled_up"] == ["auto1"]
+    reason = router.events[-1][2]
+    assert "slo burn" in reason and "prefill tier" in reason
+    dec, drouter, *_, dclock = make_tier(ROLES, "decode", estimate=NEUTRAL)
+    drouter.burning = ["serve_ttft_p95_burn"]
+    for dclock.t in (0.0, 2.0, 4.0, 6.0):
+        assert "scaled_up" not in dec.tick()
+
+
+def test_admission_ceiling_owned_by_one_tier_only():
+    """Two shed ladders over one fleet would fight each other one
+    class per tick: only the loop with manage_admission walks the
+    ceiling; the other records it read-only."""
+    dec, router, *_ = make_tier(ROLES, "decode", manage_admission=True)
+    router.burning = ["serve_decode_tokens_per_sec_burn"]
+    rec = dec.tick()
+    assert rec["shed_to"] == 8 and router.admission == 8
+    pf, prouter, *_ = make_tier(ROLES, "prefill")
+    prouter.burning = ["serve_ttft_p95_burn"]
+    rec = pf.tick()
+    assert "shed_to" not in rec
+    assert rec["admission_max_priority"] == 9 and prouter.admission == 9
+
+
+def test_disagg_autoscaler_ticks_both_tiers():
+    pf = make_tier(ROLES, "prefill", interval_s=5.0)[0]
+    dec = make_tier(ROLES, "decode", interval_s=3.0)[0]
+    pair = DisaggAutoscaler(pf, dec)
+    assert pair.interval_s == 3.0
+    rec = pair.tick()
+    assert rec["prefill"]["tier"] == "prefill"
+    assert rec["decode"]["tier"] == "decode"
+
+
+# -- summarize_run surfacing --------------------------------------------------
+
+
+def test_summarize_run_surfaces_disagg_keys(tmp_path):
+    """The disagg serve keys ride the stats JSONL into summarize_run —
+    parked slots, ship volume, and bytes-per-request; older JSONLs
+    without them summarize unchanged."""
+    from nanodiloco_tpu.training.metrics import summarize_run
+
+    new = tmp_path / "new.jsonl"
+    new.write_text(json.dumps({
+        "serve_stats": True, "served": 6, "slots_parked": 1,
+        "park_expired": 2,
+        "kvship": {"export_requests": 4, "export_bytes": 4000,
+                   "export_blocks": 12, "import_requests": 3,
+                   "import_bytes": 3000, "import_blocks": 9},
+    }) + "\n")
+    s = summarize_run(str(new))
+    assert s["serve_slots_parked"] == 1
+    assert s["serve_park_expired"] == 2
+    assert s["kv_ship_export_requests"] == 4
+    assert s["kv_ship_import_blocks"] == 9
+    assert s["kv_ship_bytes_per_request"] == 1000.0
+    old = tmp_path / "old.jsonl"
+    old.write_text(json.dumps({"serve_stats": True, "served": 1}) + "\n")
+    s2 = summarize_run(str(old))
+    assert not any(k.startswith("kv_ship") for k in s2)
+    assert "serve_slots_parked" not in s2

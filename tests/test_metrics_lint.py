@@ -35,7 +35,7 @@ METRIC_TYPES = {"counter", "gauge", "histogram"}
 LABEL_ALLOWLIST = {
     "outcome", "reason", "result", "priority", "shard", "worker",
     "target", "kind", "op", "cause", "phase", "event", "state",
-    "replica", "rule", "program",
+    "replica", "rule", "program", "tier", "direction", "role",
     "le",  # histogram bucket bound (rendered by the exposition layer)
 }
 
