@@ -592,6 +592,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "trace-event JSON at shutdown — merges with "
                         "training shards via `report merge-trace` onto "
                         "one Perfetto timeline")
+    p.add_argument("--trace-sample-rate", type=float, default=1.0,
+                   metavar="RATE",
+                   help="head-sampling rate for causal trace contexts "
+                        "minted at this edge (deterministic on trace id; "
+                        "a context accepted off the wire keeps ITS "
+                        "decision). 1.0 (default) samples everything")
+    p.add_argument("--trace-reservoir", type=int, default=2, metavar="N",
+                   help="always-on reservoir: up to N unsampled traces "
+                        "per window are promoted anyway, so a low "
+                        "--trace-sample-rate still yields exemplars "
+                        "(default 2)")
     p.add_argument("--blackbox", type=str, default=None, metavar="JSON",
                    help="arm the crash flight recorder (obs/flightrec): "
                         "keep a bounded ring of recent request outcomes "
@@ -670,7 +681,10 @@ def serve_main(argv: list[str]) -> None:
         # the recorded request-phase timestamps land on this tracer's
         # timebase; a distinct process name keeps the serve lane
         # labeled when merged with training shards
-        tracer = SpanTracer(clock=time.monotonic, process_name="nanodiloco serve")
+        tracer = SpanTracer(clock=time.monotonic,
+                            process_name="nanodiloco serve",
+                            sample_rate=args.trace_sample_rate,
+                            reservoir_per_window=args.trace_reservoir)
     scheduler = Scheduler(
         engine, max_queue=args.max_queue, tracer=tracer,
         starvation_s=args.starvation_s if args.starvation_s > 0 else None,
@@ -860,6 +874,15 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                         "shards so one Perfetto timeline shows client "
                         "wait vs router hop vs queue vs prefill vs "
                         "decode per request")
+    p.add_argument("--trace-sample-rate", type=float, default=1.0,
+                   metavar="RATE",
+                   help="head-sampling rate for causal trace contexts "
+                        "minted at this router (the fleet edge decides "
+                        "once; replicas inherit the decision off the "
+                        "wire). 1.0 (default) samples everything")
+    p.add_argument("--trace-reservoir", type=int, default=2, metavar="N",
+                   help="always-on reservoir: up to N unsampled traces "
+                        "per window are promoted anyway (default 2)")
     # predictive autoscaling (fleet/autoscaler.py): an embedded
     # collector scrapes the replicas, obs/forecast's CapacityModel
     # turns the series into exhaustion forecasts, and the control loop
@@ -1024,7 +1047,9 @@ def fleet_main(argv: list[str]) -> None:
         # distinct process name keeps the router lane labeled when
         # merged with the replicas' serve shards
         tracer = SpanTracer(clock=time.monotonic,
-                            process_name="nanodiloco router")
+                            process_name="nanodiloco router",
+                            sample_rate=args.trace_sample_rate,
+                            reservoir_per_window=args.trace_reservoir)
     router_cls = FleetRouter
     router_kw = {}
     if args.disagg:
@@ -1525,7 +1550,15 @@ def report_main(argv: list[str]) -> None:
     shards (rank 0's ``--trace-out`` file + the ``*.rank{k}.json``
     shards the other hosts wrote) into ONE Chrome trace with pid =
     process index — both hosts' sync spans on a single Perfetto
-    timeline.
+    timeline. Causal shards (spans carrying trace/span ids) merge the
+    same way — the ids ride along in ``args`` untouched.
+
+    ``report trace NEEDLE SHARD...``: stitch per-process shards into
+    ONE causal tree for the request or trace matching ``NEEDLE`` (a
+    ``request_id`` or a 32-hex ``trace_id``), render the waterfall,
+    and print the critical path — where the latency went, hop by hop,
+    with network/stitch slack reported honestly as ``residual``
+    segments. Old shards without causal ids still join by request_id.
 
     ``report cost RUN.jsonl``: reconcile the run's captured XLA
     cost_analysis record against its measured throughput and wire
@@ -1577,6 +1610,9 @@ def report_main(argv: list[str]) -> None:
         return
     if argv[:1] == ["merge-trace"]:
         report_merge_trace_main(argv[1:])
+        return
+    if argv[:1] == ["trace"]:
+        report_trace_main(argv[1:])
         return
     if argv[:1] == ["cost"]:
         report_cost_main(argv[1:])
@@ -1666,7 +1702,14 @@ def report_compare_main(argv: list[str]) -> None:
 
 
 def report_merge_trace_main(argv: list[str]) -> None:
-    p = argparse.ArgumentParser(prog="nanodiloco_tpu report merge-trace")
+    p = argparse.ArgumentParser(
+        prog="nanodiloco_tpu report merge-trace",
+        description="Fold per-process Chrome trace shards into one "
+                    "timeline. Shards from causal tracing (spans "
+                    "carrying trace_id/span_id in args) remain "
+                    "backward-compatible: the ids merge through "
+                    "untouched, and shards WITHOUT ids still join by "
+                    "request_id — mix old and new freely.")
     p.add_argument("shards", nargs="+",
                    help="per-process Chrome trace shards: rank 0's "
                         "--trace-out file plus the trace.rank{k}.json "
@@ -1695,6 +1738,61 @@ def report_merge_trace_main(argv: list[str]) -> None:
         f"merged {len(docs)} shard(s) -> {args.out} "
         f"({spans} spans across {len(pids)} process(es))"
     )
+
+
+def report_trace_main(argv: list[str]) -> None:
+    """``report trace NEEDLE SHARD...``: the hop-by-hop answer to
+    "where did this request's latency go" — stitch per-process trace
+    shards into one causal tree (parent links where the spans carry
+    ids, request_id fallback where they don't), render the waterfall,
+    and walk the critical path with the un-attributed remainder
+    (network + stitch slack) reported as its own ``residual`` segment
+    instead of silently dropped."""
+    p = argparse.ArgumentParser(prog="nanodiloco_tpu report trace")
+    p.add_argument("needle",
+                   help="request_id or 32-hex trace_id to reconstruct")
+    p.add_argument("shards", nargs="+",
+                   help="per-process Chrome trace shards (tracer "
+                        "export_chrome / --trace-out files) — router + "
+                        "each tier's shard for a fleet request")
+    p.add_argument("--width", type=int, default=56,
+                   help="waterfall bar width in characters (default 56)")
+    p.add_argument("--json", action="store_true",
+                   help="print the stitched tree + critical path as one "
+                        "JSON object instead of the rendered waterfall")
+    args = p.parse_args(argv)
+
+    from nanodiloco_tpu.obs.tracer import (
+        critical_path,
+        render_waterfall,
+        stitch_trace,
+    )
+
+    docs = []
+    for path in args.shards:
+        with open(path) as f:
+            docs.append(json.load(f))
+    try:
+        stitched = stitch_trace(docs, args.needle)
+    except ValueError as e:
+        print(f"error: {e}")
+        raise SystemExit(1)
+    segments = critical_path(stitched["root"])
+    if args.json:
+        print(json.dumps({**stitched, "critical_path": segments}))
+        return
+    print(render_waterfall(stitched, width=args.width))
+    root = stitched["root"]
+    total = root["end_s"] - root["start_s"]
+    print(f"\ncritical path ({total * 1e3:.1f} ms total):")
+    for seg in segments:
+        share = seg["seconds"] / total if total > 0 else 0.0
+        tail = f" [{seg['outcome']}]" if seg.get("outcome") else ""
+        kind = "" if seg["kind"] == "span" else f" ({seg['kind']})"
+        print(
+            f"  {seg['seconds'] * 1e3:9.2f} ms {share:6.1%}  "
+            f"{seg['span']}{kind}  @{seg['process']}{tail}"
+        )
 
 
 def report_timeseries_main(argv: list[str]) -> None:

@@ -124,6 +124,11 @@ class DisaggRouter(FleetRouter):
         if pf is None or not self.tier_capacity_names("decode"):
             return super().handle_generate({**doc, "request_id": rid})
 
+        # the disagg route span's causal context: every handoff leg,
+        # the fallback, and (through the injected wire context) the
+        # replicas' own spans hang under it — one tree per request
+        route_ctx = self._accept_trace(doc)
+
         # phase 1 — prefill-only admission on the prefill tier. The
         # client's timeout_s stays OFF this leg (it is the base
         # router's deadline machinery; the handoff legs run under
@@ -131,6 +136,9 @@ class DisaggRouter(FleetRouter):
         fwd = {k: v for k, v in doc.items() if k != "timeout_s"}
         fwd["request_id"] = rid
         fwd["prefill_only"] = True
+        pf_ctx = route_ctx.child() if route_ctx is not None else None
+        if pf_ctx is not None:
+            fwd["trace_context"] = pf_ctx.to_wire()
         t_req = self._clock()
         t0 = t_req
         with self._lock:
@@ -151,15 +159,26 @@ class DisaggRouter(FleetRouter):
                 pf.set(ready=False)
             self._breaker_note(pf, ok=False,
                                latency_s=max(0.0, self._clock() - t0))
-            return self._fallback(doc, rid, "prefill_unreachable")
+            self._span("handoff_prefill", t0, self._clock(), rid,
+                       ctx=pf_ctx, replica=pf.replica.name,
+                       outcome="error")
+            return self._fallback(doc, rid, "prefill_unreachable",
+                                  ctx=route_ctx, t_req=t_req)
         self._breaker_note(pf, ok=code < 500 or code == 503,
                            latency_s=max(0.0, self._clock() - t0))
+        self._span("handoff_prefill", t0, self._clock(), rid,
+                   ctx=pf_ctx, replica=pf.replica.name, code=code,
+                   outcome="ok" if code == 200 else "error")
         if code == 429 and isinstance(out, dict) and out.get("shed"):
             # class-shed stays TERMINAL fleet policy — never rerouted
+            self._span("route", t_req, self._clock(), rid,
+                       ctx=route_ctx, outcome="shed",
+                       replica=pf.replica.name)
             return 429, {**out, "replica": pf.replica.name,
                          "request_id": rid}
         if code != 200 or not isinstance(out, dict):
-            return self._fallback(doc, rid, f"prefill_{code}")
+            return self._fallback(doc, rid, f"prefill_{code}",
+                                  ctx=route_ctx, t_req=t_req)
         if out.get("finish_reason") != "prefilled":
             # the stream finished AT its first token (stop token or
             # max_new_tokens == 1): the prefill replica's answer is
@@ -167,19 +186,34 @@ class DisaggRouter(FleetRouter):
             out = {**out, "replica": pf.replica.name,
                    "served_by": pf.replica.name}
             out.setdefault("request_id", rid)
+            self._span("route", t_req, self._clock(), rid,
+                       ctx=route_ctx, outcome="ok",
+                       served_by=pf.replica.name)
             return code, out
 
         # phase 2 — export the parked KV rows + resume cursor
         t_pf_done = self._clock()
+        exp_ctx = route_ctx.child() if route_ctx is not None else None
+        exp_doc = {"request_id": rid}
+        if exp_ctx is not None:
+            exp_doc["trace_context"] = exp_ctx.to_wire()
         try:
             ecode, ship = self._post(pf.replica, "/admin/kv/export",
-                                     {"request_id": rid},
+                                     exp_doc,
                                      timeout=self.handoff_timeout_s)
         except _WIRE_ERRORS:
-            return self._fallback(doc, rid, "export_unreachable")
+            self._span("handoff_export", t_pf_done, self._clock(), rid,
+                       ctx=exp_ctx, replica=pf.replica.name,
+                       outcome="error")
+            return self._fallback(doc, rid, "export_unreachable",
+                                  ctx=route_ctx, t_req=t_req)
+        self._span("handoff_export", t_pf_done, self._clock(), rid,
+                   ctx=exp_ctx, replica=pf.replica.name, code=ecode,
+                   outcome="ok" if ecode == 200 else "error")
         if ecode != 200 or not isinstance(ship, dict):
             # 404 = the park TTL or deadline reclaimed the slot first
-            return self._fallback(doc, rid, f"export_{ecode}")
+            return self._fallback(doc, rid, f"export_{ecode}",
+                                  ctx=route_ctx, t_req=t_req)
 
         # phase 3 — import on the least-loaded decode replica, which
         # resumes the stream and answers with the finished result. A
@@ -192,27 +226,64 @@ class DisaggRouter(FleetRouter):
                 break
             tried.add(dec.replica.name)
             t_imp = self._clock()
+            imp_ctx = route_ctx.child() if route_ctx is not None else None
+            imp_doc = ship
+            if imp_ctx is not None:
+                imp_doc = {**ship, "trace_context": imp_ctx.to_wire()}
             with self._lock:
                 dec.router_inflight += 1
             try:
                 try:
                     icode, iout = self._post(dec.replica,
-                                             "/admin/kv/import", ship)
+                                             "/admin/kv/import", imp_doc)
                 finally:
                     with self._lock:
                         dec.router_inflight -= 1
             except _WIRE_ERRORS:
                 self._breaker_note(dec, ok=False)
+                self._span("handoff_import", t_imp, self._clock(), rid,
+                           ctx=imp_ctx, replica=dec.replica.name,
+                           outcome="error")
                 continue
             self._breaker_note(dec, ok=icode < 500)
+            self._span("handoff_import", t_imp, self._clock(), rid,
+                       ctx=imp_ctx, replica=dec.replica.name, code=icode,
+                       outcome=("ok" if icode == 200
+                                else "busy" if icode == 429 else "error"))
             if icode == 200 and isinstance(iout, dict):
                 with self._lock:
                     self._disagg["handoffs"] += 1
                     self._disagg["ship_bytes"] += _ship_payload_bytes(ship)
                 self.hist_handoff.observe(max(0.0, t_imp - t_pf_done))
                 self._span("handoff", t_pf_done, t_imp, rid,
+                           ctx=(route_ctx.child()
+                                if route_ctx is not None else None),
                            prefilled_by=pf.replica.name,
                            decoded_by=dec.replica.name)
+                t_done = self._clock()
+                self._span("route", t_req, t_done, rid, ctx=route_ctx,
+                           outcome="ok", served_by=dec.replica.name,
+                           prefilled_by=pf.replica.name)
+                # per-phase TTFT waterfall, from the clocks that own
+                # each boundary: the prefill replica's own queue/compute
+                # split, the router's ship window (export leg + decode
+                # pick), and the import leg's admission overhead (wire +
+                # KV mapping, the decode work itself subtracted out)
+                pf_timing = out.get("timing") or {}
+                imp_leg_s = max(0.0, self._clock() - t_imp)
+                it = iout.get("timing") or {}
+                phases = {}
+                if isinstance(pf_timing.get("queued_s"), (int, float)):
+                    phases["queue_s"] = round(
+                        float(pf_timing["queued_s"]), 6)
+                    if isinstance(pf_timing.get("ttft_s"), (int, float)):
+                        phases["prefill_s"] = round(max(
+                            0.0, float(pf_timing["ttft_s"])
+                            - float(pf_timing["queued_s"])), 6)
+                phases["ship_s"] = round(max(0.0, t_imp - t_pf_done), 6)
+                if isinstance(it.get("total_s"), (int, float)):
+                    phases["decode_admission_s"] = round(max(
+                        0.0, imp_leg_s - float(it["total_s"])), 6)
                 iout = {**iout, "replica": dec.replica.name,
                         "served_by": dec.replica.name,
                         "prefilled_by": pf.replica.name,
@@ -221,20 +292,27 @@ class DisaggRouter(FleetRouter):
                         # to the prefill reply (the first token exists
                         # from then on) — the decode replica's own
                         # timing.ttft_s only covers the resumed stream
-                        "handoff_ttft_s": round(t_pf_done - t_req, 6)}
+                        "handoff_ttft_s": round(t_pf_done - t_req, 6),
+                        "handoff_phases": phases}
                 iout.setdefault("request_id", rid)
+                if route_ctx is not None and route_ctx.sampled:
+                    iout.setdefault("trace_id", route_ctx.trace_id)
                 return 200, iout
             if icode == 429:
                 continue  # this decode replica is full; try another
             break  # 409 mismatch / 400 / 5xx: fall back, don't spray
-        return self._fallback(doc, rid, "import_failed")
+        return self._fallback(doc, rid, "import_failed",
+                              ctx=route_ctx, t_req=t_req)
 
-    def _fallback(self, doc: dict, rid: str,
-                  reason: str) -> tuple[int, dict]:
+    def _fallback(self, doc: dict, rid: str, reason: str,
+                  ctx=None, t_req: float | None = None) -> tuple[int, dict]:
         """The ONE honest retry: a plain monolithic generate on the
         decode tier (which re-prefills locally). Counted per reason;
         when even that finds no decode replica, the base router's full
-        resilience stack is the last resort."""
+        resilience stack is the last resort. ``ctx``/``t_req`` carry
+        the disagg route span: each fallback attempt is its own child
+        span tagged with the reason, and the route span closes with
+        ``outcome="fallback"`` on every path out of here."""
         with self._lock:
             self._disagg["fallbacks"] += 1
             self._fallback_reasons[reason] = (
@@ -248,6 +326,10 @@ class DisaggRouter(FleetRouter):
             if st is None:
                 break
             tried.add(st.replica.name)
+            fb_ctx = ctx.child() if ctx is not None else None
+            if fb_ctx is not None:
+                fwd = {**fwd, "trace_context": fb_ctx.to_wire()}
+            t0 = self._clock()
             with self._lock:
                 st.router_inflight += 1
             try:
@@ -258,8 +340,17 @@ class DisaggRouter(FleetRouter):
                         st.router_inflight -= 1
             except _WIRE_ERRORS:
                 self._breaker_note(st, ok=False)
+                self._span("fallback", t0, self._clock(), rid,
+                           ctx=fb_ctx, replica=st.replica.name,
+                           reason=reason, outcome="error")
                 continue
             self._breaker_note(st, ok=code < 500 or code == 503)
+            self._span("fallback", t0, self._clock(), rid, ctx=fb_ctx,
+                       replica=st.replica.name, reason=reason, code=code,
+                       outcome=("ok" if code == 200
+                                else "busy" if code == 429
+                                else "unavailable" if code == 503
+                                else "error"))
             if code in (429, 503) and not (
                     isinstance(out, dict) and out.get("shed")):
                 continue
@@ -268,8 +359,28 @@ class DisaggRouter(FleetRouter):
                        "served_by": st.replica.name,
                        "disagg": "fallback"}
                 out.setdefault("request_id", rid)
+                if ctx is not None and ctx.sampled:
+                    out.setdefault("trace_id", ctx.trace_id)
+            else:
+                # a non-dict body must still carry the join key — the
+                # fallback path is exactly where a client needs it
+                out = {"error": out, "replica": st.replica.name,
+                       "disagg": "fallback", "request_id": rid}
+            if ctx is not None and t_req is not None:
+                self._span("route", t_req, self._clock(), rid, ctx=ctx,
+                           outcome="fallback", reason=reason,
+                           served_by=st.replica.name)
             return code, out
-        return super().handle_generate(fwd)
+        # last resort: the base router's full resilience stack, its
+        # route span nested under the disagg route span via the wire
+        # context so the trace stays one tree
+        if ctx is not None:
+            fwd = {**fwd, "trace_context": ctx.to_wire()}
+        code, out = super().handle_generate(fwd)
+        if ctx is not None and t_req is not None:
+            self._span("route", t_req, self._clock(), rid, ctx=ctx,
+                       outcome="fallback", reason=reason)
+        return code, out
 
     # -- observability --------------------------------------------------------
 

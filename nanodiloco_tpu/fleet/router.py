@@ -80,6 +80,7 @@ from typing import Callable
 
 from nanodiloco_tpu.obs import flightrec
 from nanodiloco_tpu.obs.goodput import FLEET_STATE_CAUSES
+from nanodiloco_tpu.obs.tracer import TraceContext
 from nanodiloco_tpu.obs.telemetry import (
     OPENMETRICS_CONTENT_TYPE,
     nearest_rank_percentile,
@@ -909,11 +910,23 @@ class FleetRouter:
             )
 
     def _span(self, name: str, t0: float, t1: float, request_id: str,
-              **args) -> None:
+              ctx=None, **args) -> None:
         if self.tracer is not None:
             self.tracer.record_span(
-                name, t0, t1, request_id=request_id, **args
+                name, t0, t1, ctx=ctx, request_id=request_id, **args
             )
+
+    def _accept_trace(self, doc: dict):
+        """The route span's causal context: adopt the client's wire
+        context (its sampling decision wins) or mint a fresh trace at
+        this edge. None when no tracer is installed — every ctx=
+        consumer treats None as untraced."""
+        if self.tracer is None:
+            return None
+        wire = TraceContext.from_wire(doc.get("trace_context"))
+        if wire is not None:
+            return wire.child()
+        return self.tracer.new_trace()
 
     def handle_generate(self, doc: dict) -> tuple[int, dict]:
         """Forward one request with the full resilience stack:
@@ -955,6 +968,7 @@ class FleetRouter:
                 self._req_seq += 1
                 rid = f"rtr-{self._req_seq}"
         doc = {**doc, "request_id": rid}
+        route_ctx = self._accept_trace(doc)
         timeout_s = doc.pop("timeout_s", None)
         if timeout_s is not None:
             if (isinstance(timeout_s, bool)
@@ -986,7 +1000,7 @@ class FleetRouter:
                 )
         if prio > ceiling:
             self._span("route", t_route, self._clock(), rid,
-                       outcome="shed", shed_class=prio)
+                       ctx=route_ctx, outcome="shed", shed_class=prio)
             return 429, {
                 "error": f"priority class {prio} is shed under overload "
                          f"(admitting classes 0..{ceiling})",
@@ -1014,6 +1028,13 @@ class FleetRouter:
                 st.router_inflight += 1
             remaining = max(0.05, deadline_at - self._clock())
             fwd = dict(doc)
+            # every attempt — first pick, retry, hedge — is its OWN
+            # child span of the route span, and the replica parents its
+            # queued/prefill/decode spans under this attempt's id: a
+            # hedge's two legs stay two branches of one tree
+            fwd_ctx = route_ctx.child() if route_ctx is not None else None
+            if fwd_ctx is not None:
+                fwd["trace_context"] = fwd_ctx.to_wire()
             if timeout_s is not None or doc.get("deadline_s") is not None:
                 # propagate the deadline replica-side: the scheduler's
                 # expiry machinery stops decoding for a client that has
@@ -1057,8 +1078,8 @@ class FleetRouter:
                         st, ok=False,
                         latency_s=max(0.0, self._clock() - t0))
                     self._span("forward", t0, self._clock(), rid,
-                               replica=name, retry=idx > 0,
-                               outcome="error")
+                               ctx=fwd_ctx, replica=name, retry=idx > 0,
+                               hedge=is_hedge, outcome="error")
                     results.put((is_hedge, idx, st, None, None, t0))
                     return
                 # 503 (dead loop or draining) and 429 (backpressure)
@@ -1068,7 +1089,12 @@ class FleetRouter:
                     st, ok=code < 500 or code == 503,
                     latency_s=max(0.0, self._clock() - t0))
                 self._span("forward", t0, self._clock(), rid,
-                           replica=name, retry=idx > 0, code=code)
+                           ctx=fwd_ctx, replica=name, retry=idx > 0,
+                           hedge=is_hedge, code=code,
+                           outcome=("ok" if code == 200
+                                    else "busy" if code == 429
+                                    else "unavailable" if code == 503
+                                    else "error"))
                 results.put((is_hedge, idx, st, code, out, t0))
 
             threading.Thread(
@@ -1087,7 +1113,7 @@ class FleetRouter:
                     self._resilience["deadline_expired"] += 1
                 for lst in outstanding.values():
                     self._cancel_request(lst.replica, rid)
-                self._span("route", t_route, now, rid,
+                self._span("route", t_route, now, rid, ctx=route_ctx,
                            outcome="deadline_expired", attempts=launched)
                 return 504, {
                     "error": f"deadline exceeded: timeout_s="
@@ -1102,7 +1128,7 @@ class FleetRouter:
                 st = self._pick_excluding(tried)
                 if st is None:
                     self._span("route", t_route, self._clock(), rid,
-                               outcome="no_ready_replica")
+                               ctx=route_ctx, outcome="no_ready_replica")
                     return 503, {"error": "no ready replica",
                                  "request_id": rid,
                                  **({"tried": sorted(tried)}
@@ -1160,7 +1186,7 @@ class FleetRouter:
                     for lst in outstanding.values():
                         self._cancel_request(lst.replica, rid)
                     self._span("route", t_route, self._clock(), rid,
-                               outcome="shed", replica=name)
+                               ctx=route_ctx, outcome="shed", replica=name)
                     return 429, {**out, "replica": name,
                                  "request_id": rid}
                 # busy 429: queue full HERE, not fleet-wide — try
@@ -1168,9 +1194,15 @@ class FleetRouter:
                 # client gets the honest 429 (backpressure), never a
                 # fake 503 — with the join key, so the overload is
                 # traceable
+                # a non-dict body (an intermediary's error page) is
+                # wrapped rather than passed through raw: EVERY router
+                # response carries the request_id join key, including
+                # the ones that needed diagnosing most
                 last_429 = (code, {**out, "replica": name,
                                    "request_id": rid}
-                            if isinstance(out, dict) else out)
+                            if isinstance(out, dict)
+                            else {"error": out, "replica": name,
+                                  "request_id": rid})
                 continue
             if code >= 500:
                 # any other 5xx (chaos-injected or a replica bug):
@@ -1179,7 +1211,9 @@ class FleetRouter:
                 # replica's own error, not a synthesized 503
                 last_err = (code, {**out, "replica": name,
                                    "request_id": rid}
-                            if isinstance(out, dict) else out)
+                            if isinstance(out, dict)
+                            else {"error": out, "replica": name,
+                                  "request_id": rid})
                 continue
             # first usable answer wins
             if code == 200:
@@ -1195,10 +1229,13 @@ class FleetRouter:
             if isinstance(out, dict):
                 out = {**out, "replica": name, "served_by": name}
                 out.setdefault("request_id", rid)
+                if route_ctx is not None and route_ctx.sampled:
+                    out.setdefault("trace_id", route_ctx.trace_id)
             self._span("route", t_route, self._clock(), rid,
-                       served_by=name, attempts=launched)
+                       ctx=route_ctx, outcome="ok", served_by=name,
+                       attempts=launched)
             return code, out
-        self._span("route", t_route, self._clock(), rid,
+        self._span("route", t_route, self._clock(), rid, ctx=route_ctx,
                    outcome="exhausted", attempts=len(tried))
         if last_429 is not None:
             return last_429

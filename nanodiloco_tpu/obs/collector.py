@@ -152,10 +152,37 @@ def _parse_labels(s: str) -> tuple[dict[str, str], str]:
     return labels, s[i + 1:]
 
 
-def parse_sample_line(line: str) -> tuple[str, dict[str, str] | None, float]:
-    """One exposition sample line -> ``(sample_name, labels, value)``.
-    Raises ValueError on anything that is not a sample (comments,
-    blanks, junk) — callers decide how tolerant to be."""
+def _split_exemplar(rest: str) -> tuple[str, tuple[dict, float] | None]:
+    """Split an OpenMetrics exemplar suffix (`` # {labels} value``) off
+    the text FOLLOWING a sample's label set (safe: label values were
+    already consumed, so a ``#`` here cannot be inside a quoted
+    string). Returns ``(value_text, exemplar_or_None)`` with exemplar
+    as ``(labels, value)``. A malformed suffix is kept in the value
+    text untouched — tolerance belongs to the caller's float()."""
+    cut = rest.find(" # ")
+    if cut < 0:
+        return rest, None
+    head, tail = rest[:cut], rest[cut + 3:].strip()
+    if not tail.startswith("{"):
+        return rest, None
+    try:
+        ex_labels, ex_rest = _parse_labels(tail)
+        parts = ex_rest.strip().split()
+        if not parts:
+            return rest, None
+        return head, (ex_labels, float(parts[0]))
+    except (ValueError, IndexError):
+        return rest, None
+
+
+def parse_sample_line_ex(
+    line: str,
+) -> tuple[str, dict[str, str] | None, float, tuple[dict, float] | None]:
+    """One exposition sample line -> ``(sample_name, labels, value,
+    exemplar)`` where ``exemplar`` is ``(labels, value)`` from an
+    OpenMetrics `` # {...} v`` suffix, or None. Raises ValueError on
+    anything that is not a sample (comments, blanks, junk) — callers
+    decide how tolerant to be."""
     line = line.strip()
     if not line or line.startswith("#"):
         raise ValueError("not a sample line")
@@ -163,15 +190,24 @@ def parse_sample_line(line: str) -> tuple[str, dict[str, str] | None, float]:
     if brace >= 0:
         name = line[:brace]
         labels, rest = _parse_labels(line[brace:])
+        rest, ex = _split_exemplar(rest)
         parts = rest.strip().split()
         if not parts:  # truncated line: ValueError, never IndexError —
             # scrape_once's per-target isolation catches ValueError
             raise ValueError(f"no value on sample line: {line!r}")
-        return name, labels, float(parts[0])
+        return name, labels, float(parts[0]), ex
     parts = line.split()
     if len(parts) < 2:
         raise ValueError(f"no value on sample line: {line!r}")
-    return parts[0], None, float(parts[1])
+    return parts[0], None, float(parts[1]), None
+
+
+def parse_sample_line(line: str) -> tuple[str, dict[str, str] | None, float]:
+    """One exposition sample line -> ``(sample_name, labels, value)``,
+    exemplar-tolerant (an OpenMetrics `` # {...} v`` suffix is parsed
+    and dropped). Raises ValueError on non-sample lines."""
+    name, labels, value, _ex = parse_sample_line_ex(line)
+    return name, labels, value
 
 
 def sample_key(name: str, labels: dict[str, str] | None) -> str:
@@ -224,7 +260,7 @@ def parse_exposition(text: str) -> list:
                 current = name
             continue  # EOF marker and foreign comments
         try:
-            sname, labels, value = parse_sample_line(stripped)
+            sname, labels, value, ex = parse_sample_line_ex(stripped)
         except ValueError:
             continue  # tolerant of junk lines in foreign expositions
         owner = None
@@ -241,7 +277,7 @@ def parse_exposition(text: str) -> list:
         if owner is None:
             owner = sname
             ensure(owner)
-        raw[owner].append((sname, labels, value))
+        raw[owner].append((sname, labels, value, ex))
 
     for name in order:
         help_text, mtype = meta[name]
@@ -249,7 +285,7 @@ def parse_exposition(text: str) -> list:
         if mtype == "histogram":
             series: dict[tuple, dict] = {}  # label-sig (minus le) -> snap
             sig_labels: dict[tuple, dict | None] = {}
-            for sname, labels, value in samples:
+            for sname, labels, value, ex in samples:
                 rest = dict(labels or {})
                 le = rest.pop("le", None)
                 sig = tuple(sorted(rest.items()))
@@ -265,6 +301,12 @@ def parse_exposition(text: str) -> list:
                         continue
                     bound = le if le == "+Inf" else float(le)
                     snap["buckets"].append((bound, int(value)))
+                    if ex is not None and "trace_id" in ex[0]:
+                        # rebuild the snapshot's exemplars map so the
+                        # byte round-trip holds with exemplars present
+                        snap.setdefault("exemplars", {})[bound] = (
+                            ex[0]["trace_id"], ex[1]
+                        )
                 elif sname == name + "_count":
                     snap["count"] = int(value)
                 elif sname == name + "_sum":
@@ -272,11 +314,11 @@ def parse_exposition(text: str) -> list:
             fam_samples = [(sig_labels[sig], series[sig]) for sig in series]
         elif mtype == "counter":
             fam_samples = [
-                (labels, value) for _sname, labels, value in samples
+                (labels, value) for _sname, labels, value, _ex in samples
             ]
         else:
             fam_samples = [
-                (labels, value) for _sname, labels, value in samples
+                (labels, value) for _sname, labels, value, _ex in samples
             ]
         families.append((name, mtype or "untyped", help_text, fam_samples))
     return families

@@ -157,31 +157,59 @@ class Histogram:
             raise ValueError(f"duplicate bucket bounds: {buckets}")
         self.bounds = bounds
         self._counts = [0] * (len(bounds) + 1)  # last = overflow (+Inf only)
+        # one exemplar per bucket: (trace_id, observed value) of the
+        # LAST sampled observation that landed there — bounded memory
+        # (len(bounds)+1 slots), the metrics→trace link per bucket
+        self._exemplars: list[tuple[str, float] | None] = (
+            [None] * (len(bounds) + 1)
+        )
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: str | None = None) -> None:
+        """Record one observation. ``exemplar`` (optional) is the trace
+        id of the request that produced it — kept one-per-bucket, last
+        writer wins, so the exposition can link a latency bucket back
+        to a concrete sampled trace. Pass None (the default) for
+        unsampled observations: the counts still move, only the link
+        is withheld."""
         v = float(value)
         i = bisect.bisect_left(self.bounds, v)
         with self._lock:
             self._counts[i] += 1
+            if exemplar:
+                self._exemplars[i] = (str(exemplar), v)
             self._sum += v
             self._count += 1
 
     def snapshot(self) -> dict:
         """``{"buckets": [(le, cumulative), ..., ("+Inf", count)],
-        "count": n, "sum": s}`` — the exposition-ready cumulative form."""
+        "count": n, "sum": s}`` — the exposition-ready cumulative form.
+        When any exemplar was recorded the dict also carries
+        ``"exemplars": {le: (trace_id, value)}`` keyed by the bucket
+        each exemplar LANDED in (exemplars are per-bucket, not
+        cumulative — OpenMetrics requires an exemplar's value to lie
+        inside its bucket's range)."""
         with self._lock:
             counts = list(self._counts)
+            exemplars = list(self._exemplars)
             total, s = self._count, self._sum
         cum = 0
         buckets: list[tuple[float | str, int]] = []
-        for bound, c in zip(self.bounds, counts):
+        ex: dict[float | str, tuple[str, float]] = {}
+        for j, (bound, c) in enumerate(zip(self.bounds, counts)):
             cum += c
             buckets.append((bound, cum))
+            if exemplars[j] is not None:
+                ex[bound] = exemplars[j]
         buckets.append(("+Inf", total))
-        return {"buckets": buckets, "count": total, "sum": s}
+        if exemplars[-1] is not None:
+            ex["+Inf"] = exemplars[-1]
+        snap: dict = {"buckets": buckets, "count": total, "sum": s}
+        if ex:
+            snap["exemplars"] = ex
+        return snap
 
 
 class TelemetryServer:
@@ -635,7 +663,11 @@ def render_exposition(families) -> str:
       ``(labels_or_None, snapshot)`` pairs for a labeled histogram
       family (e.g. the serve queue-wait split by ``priority``); each
       pair's labels ride on every ``_bucket``/``_count``/``_sum``
-      sample of its series, with ``le`` appended last.
+      sample of its series, with ``le`` appended last. A snapshot's
+      optional ``"exemplars"`` map (``{le: (trace_id, value)}``)
+      renders as OpenMetrics exemplars on the matching ``_bucket``
+      lines — ``... # {trace_id="..."} value`` — linking the bucket to
+      a sampled trace.
 
     Every family gets ``# HELP`` and ``# TYPE`` metadata (HELP text
     escaped); ``# EOF`` terminates the exposition (a truncated scrape
@@ -651,11 +683,21 @@ def render_exposition(families) -> str:
             for labels, snap in series:
                 base = _render_labels(labels) + "," if labels else ""
                 suffix = f"{{{_render_labels(labels)}}}" if labels else ""
+                exemplars = snap.get("exemplars") or {}
                 for le, cum in snap["buckets"]:
                     le_s = le if isinstance(le, str) else _fmt(float(le))
-                    lines.append(
-                        f'{name}_bucket{{{base}le="{le_s}"}} {int(cum)}'
-                    )
+                    line = f'{name}_bucket{{{base}le="{le_s}"}} {int(cum)}'
+                    ex = exemplars.get(le)
+                    if ex is not None:
+                        # OpenMetrics exemplar: " # {labels} value" —
+                        # the trace id of a sampled observation that
+                        # landed in THIS bucket (value inside its range)
+                        tid, ev = ex
+                        line += (
+                            f' # {{trace_id="{escape_label_value(tid)}"}}'
+                            f" {_fmt(float(ev))}"
+                        )
+                    lines.append(line)
                 lines.append(f"{name}_count{suffix} {int(snap['count'])}")
                 lines.append(f"{name}_sum{suffix} {_fmt(float(snap['sum']))}")
             continue

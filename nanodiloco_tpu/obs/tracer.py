@@ -33,9 +33,66 @@ import os
 import threading
 import time
 from contextlib import contextmanager
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple
 
 from nanodiloco_tpu.obs import flightrec
+
+
+class TraceContext(NamedTuple):
+    """One hop's position in a causal trace.
+
+    ``trace_id`` names the whole request tree (32 hex chars),
+    ``span_id`` is THIS hop's own span (16 hex), ``parent_span_id`` the
+    hop that caused it (None at the root), and ``sampled`` carries the
+    head-based decision every downstream process must honour — the
+    sampler runs once, at the edge, so a trace is either whole or
+    absent, never half-collected.
+    """
+
+    trace_id: str
+    span_id: str
+    parent_span_id: str | None
+    sampled: bool
+
+    def child(self) -> "TraceContext":
+        """A fresh span id parented under this one; trace id and the
+        sampling decision ride along unchanged."""
+        return TraceContext(self.trace_id, _new_span_id(),
+                            self.span_id, self.sampled)
+
+    def to_wire(self) -> str:
+        """W3C-traceparent-style wire form
+        (``00-<trace_id>-<span_id>-<flags>``): the receiver parents its
+        spans under OUR span id. Flags: ``01`` sampled, ``00`` not."""
+        return (f"00-{self.trace_id}-{self.span_id}-"
+                f"{'01' if self.sampled else '00'}")
+
+    @classmethod
+    def from_wire(cls, wire: Any) -> "TraceContext | None":
+        """Parse an incoming ``trace_context`` string; None on anything
+        malformed (an old client or a garbage header must degrade to
+        untraced, never to a 4xx)."""
+        if not isinstance(wire, str):
+            return None
+        parts = wire.strip().split("-")
+        if len(parts) != 4:
+            return None
+        _ver, tid, sid, flags = parts
+        if len(tid) != 32 or len(sid) != 16:
+            return None
+        try:
+            int(tid, 16), int(sid, 16)
+        except ValueError:
+            return None
+        return cls(tid.lower(), sid.lower(), None, flags == "01")
+
+
+def _new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def _new_trace_id() -> str:
+    return os.urandom(16).hex()
 
 
 class SpanTracer:
@@ -54,9 +111,24 @@ class SpanTracer:
         max_events: int = 500_000,
         process_index: int = 0,
         process_name: str | None = None,
+        sample_rate: float = 1.0,
+        reservoir_per_window: int = 2,
+        reservoir_window_s: float = 60.0,
     ) -> None:
         self._clock = clock
         self._max_events = max_events
+        # head-based sampling: the edge process (the one that mints the
+        # trace id) decides once per trace; everyone downstream honours
+        # the wire flag. The decision is a pure function of the trace id
+        # so concurrent edge processes agree without coordination, plus
+        # a bounded always-on reservoir (reservoir_per_window traces per
+        # reservoir_window_s of this tracer's clock) so a production
+        # rate of 0.01 still yields a steady trickle of whole traces.
+        self.sample_rate = float(sample_rate)
+        self._reservoir_per_window = int(reservoir_per_window)
+        self._reservoir_window_s = float(reservoir_window_s)
+        self._reservoir_left = self._reservoir_per_window
+        self._reservoir_window_t0: float | None = None
         # which process of a multi-host pod this tracer records; carried
         # in the export's metadata so merge_chrome_traces can assign
         # stable pids (the train loop passes jax.process_index() — this
@@ -90,15 +162,80 @@ class SpanTracer:
             st = self._local.stack = []
         return st
 
+    # -- causal context -------------------------------------------------
+
+    def head_sample(self, trace_id: str) -> bool:
+        """The once-per-trace sampling decision. Deterministic in the
+        trace id (every edge process agrees), topped up by the bounded
+        reservoir so some traces always survive a near-zero rate."""
+        if self.sample_rate >= 1.0:
+            return True
+        if (self.sample_rate > 0.0
+                and int(trace_id[:13] or "0", 16) / float(16 ** 13)
+                < self.sample_rate):
+            return True
+        # reservoir: refill on window roll, measured on the tracer's own
+        # clock (tests inject a fake; production gets perf_counter)
+        now = self._clock()
+        with self._lock:
+            if (self._reservoir_window_t0 is None
+                    or now - self._reservoir_window_t0
+                    >= self._reservoir_window_s):
+                self._reservoir_window_t0 = now
+                self._reservoir_left = self._reservoir_per_window
+            if self._reservoir_left > 0:
+                self._reservoir_left -= 1
+                return True
+        return False
+
+    def new_trace(self) -> TraceContext:
+        """Mint a root context at the edge (the fleet router, or any
+        process a request enters first)."""
+        tid = _new_trace_id()
+        return TraceContext(tid, _new_span_id(), None,
+                            self.head_sample(tid))
+
+    def accept(self, wire: Any) -> TraceContext:
+        """Adopt an incoming wire context, or mint a fresh trace when
+        there is none: the caller always gets a usable context, and a
+        propagated sampling decision always wins over the local one."""
+        ctx = TraceContext.from_wire(wire)
+        if ctx is not None:
+            return ctx
+        return self.new_trace()
+
+    @contextmanager
+    def activate(self, ctx: TraceContext | None):
+        """Bind ``ctx`` as this thread's remote parent: ``span()`` calls
+        inside the block parent under it (depth-0 spans become children
+        of the accepted context's span id). Nesting restores the outer
+        binding on exit."""
+        prev = getattr(self._local, "ctx", None)
+        self._local.ctx = ctx
+        try:
+            yield self
+        finally:
+            self._local.ctx = prev
+
+    def active_context(self) -> TraceContext | None:
+        return getattr(self._local, "ctx", None)
+
     @contextmanager
     def span(self, name: str, **args: Any):
         """Record one span around the enclosed block. Exceptions
         propagate; the span still closes (the trace must show the round
-        that crashed, not lose it)."""
+        that crashed, not lose it). Under an activated sampled context
+        the span gains causal ids: parent = the enclosing span on this
+        thread's stack, else the accepted remote context."""
         stack = self._stack()
         depth = len(stack)
+        ctx: TraceContext | None = getattr(self._local, "ctx", None)
+        span_ctx: TraceContext | None = None
+        if ctx is not None and ctx.sampled:
+            parent = (stack[-1][1] or ctx) if stack else ctx
+            span_ctx = parent.child()
         t0 = self._clock()
-        stack.append(name)
+        stack.append((name, span_ctx if span_ctx is not None else ctx))
         try:
             yield self
         finally:
@@ -112,6 +249,12 @@ class SpanTracer:
                 "depth": depth,
                 "tid": tid,
             }
+            if span_ctx is not None:
+                args = dict(args)
+                args["trace_id"] = span_ctx.trace_id
+                args["span_id"] = span_ctx.span_id
+                if span_ctx.parent_span_id:
+                    args["parent_span_id"] = span_ctx.parent_span_id
             if args:
                 ev["args"] = args
             with self._lock:
@@ -130,7 +273,14 @@ class SpanTracer:
                 # moment. One is-None check when no recorder is installed.
                 flightrec.record_event("span", name=name, s=round(t1 - t0, 6))
 
-    def record_span(self, name: str, t0: float, t1: float, **args: Any) -> None:
+    def record_span(
+        self,
+        name: str,
+        t0: float,
+        t1: float,
+        ctx: TraceContext | None = None,
+        **args: Any,
+    ) -> None:
         """Record an ALREADY-TIMED span: ``t0``/``t1`` are values of
         THIS tracer's own clock, captured by the caller (the serve
         scheduler times request phases — queued/prefill/decode — with
@@ -139,7 +289,12 @@ class SpanTracer:
         thread). The caller must construct the tracer with the SAME
         clock it timestamps with, or the lanes won't line up. Recorded
         at depth 0, so serve phases aggregate into ``phase_totals``
-        like the train loop's spans do."""
+        like the train loop's spans do.
+
+        ``ctx`` names THIS span's place in a causal trace — the caller
+        mints it (``parent_ctx.child()``) when it forwards work, then
+        reports the span under the same ids after the fact. Unsampled
+        or absent contexts add nothing to the event."""
         if self._max_events <= 0:
             return
         tid = threading.get_ident()
@@ -150,6 +305,12 @@ class SpanTracer:
             "depth": 0,
             "tid": tid,
         }
+        if ctx is not None and ctx.sampled:
+            args = dict(args)
+            args["trace_id"] = ctx.trace_id
+            args["span_id"] = ctx.span_id
+            if ctx.parent_span_id:
+                args["parent_span_id"] = ctx.parent_span_id
         if args:
             ev["args"] = args
         with self._lock:
@@ -359,3 +520,175 @@ def merge_chrome_traces(docs: list[dict[str, Any]]) -> dict[str, Any]:
             **({"wall_start_unix": base} if base is not None else {}),
         },
     }
+
+
+# -- causal assembly: shards -> one tree -> where the latency went ------
+
+_EPS = 1e-9
+
+
+def stitch_trace(docs: list[dict[str, Any]], needle: str) -> dict[str, Any]:
+    """Assemble ONE request's causal tree from per-process trace shards.
+
+    ``needle`` is a trace id or a request id. Shards are re-anchored
+    onto a common wall clock exactly like ``merge_chrome_traces``; the
+    needle is first resolved BOTH ways (a request id pulls in every
+    trace id its spans carry and vice versa), then every matching span
+    becomes a node and nodes link by ``parent_span_id``. Spans from
+    uninstrumented/old shards carry no ids but still join by request id
+    — they surface as extra roots under a synthetic ``trace`` node, so
+    a fleet mid-rollout still yields one tree instead of an error.
+    Times are seconds, rebased so the earliest span starts at 0."""
+    merged = merge_chrome_traces(docs)
+    pname: dict[Any, str] = {}
+    xevents: list[dict[str, Any]] = []
+    for ev in merged["traceEvents"]:
+        if ev.get("ph") == "M":
+            if ev.get("name") == "process_name":
+                pname[ev.get("pid")] = (ev.get("args") or {}).get("name")
+        elif ev.get("ph") == "X":
+            xevents.append(ev)
+    trace_ids, request_ids = {needle}, {needle}
+    for ev in xevents:
+        a = ev.get("args") or {}
+        if a.get("trace_id") == needle and a.get("request_id"):
+            request_ids.add(a["request_id"])
+        if a.get("request_id") == needle and a.get("trace_id"):
+            trace_ids.add(a["trace_id"])
+    by_id: dict[str, dict] = {}
+    picked: list[dict] = []
+    for ev in xevents:
+        a = ev.get("args") or {}
+        if not (a.get("trace_id") in trace_ids
+                or a.get("request_id") in request_ids):
+            continue
+        node = {
+            "name": ev.get("name"),
+            "process": pname.get(ev.get("pid")) or f"pid{ev.get('pid')}",
+            "start_s": float(ev.get("ts") or 0.0) / 1e6,
+            "dur_s": max(0.0, float(ev.get("dur") or 0.0) / 1e6),
+            "span_id": a.get("span_id"),
+            "parent_span_id": a.get("parent_span_id"),
+            "trace_id": a.get("trace_id"),
+            "request_id": a.get("request_id"),
+            "args": {k: v for k, v in a.items()
+                     if k not in ("trace_id", "span_id", "parent_span_id")},
+            "children": [],
+        }
+        node["end_s"] = node["start_s"] + node["dur_s"]
+        picked.append(node)
+        if node["span_id"]:
+            by_id.setdefault(node["span_id"], node)
+    if not picked:
+        raise ValueError(f"no spans match {needle!r} in the given shards")
+    t_min = min(n["start_s"] for n in picked)
+    for n in picked:
+        n["start_s"] -= t_min
+        n["end_s"] -= t_min
+    roots: list[dict] = []
+    for n in sorted(picked, key=lambda n: (n["start_s"], -n["dur_s"])):
+        parent = by_id.get(n["parent_span_id"]) if n["parent_span_id"] else None
+        if parent is not None and parent is not n:
+            parent["children"].append(n)
+        else:
+            roots.append(n)
+    tid = next((n["trace_id"] for n in picked if n["trace_id"]), None)
+    if len(roots) == 1:
+        root = roots[0]
+    else:
+        # >1 root: shards joined only by request id (old emitters), or a
+        # torn trace — a synthetic node makes the slack between them an
+        # honest residual instead of an invisible drop
+        root = {
+            "name": "trace", "process": "(stitched)",
+            "span_id": None, "parent_span_id": None,
+            "trace_id": tid, "request_id": None, "args": {},
+            "start_s": min(n["start_s"] for n in roots),
+            "end_s": max(n["end_s"] for n in roots),
+            "children": roots,
+        }
+        root["dur_s"] = root["end_s"] - root["start_s"]
+    return {
+        "root": root,
+        "spans": picked,
+        "trace_id": tid,
+        "request_ids": sorted(r for r in {n["request_id"] for n in picked}
+                              if r),
+        "causal_spans": sum(1 for n in picked if n["span_id"]),
+        "request_id_joined": sum(1 for n in picked if not n["span_id"]),
+        "shards": len(docs),
+    }
+
+
+def critical_path(root: dict[str, Any]) -> list[dict[str, Any]]:
+    """The chain of segments that determined the root span's duration:
+    walk backwards from each span's end to the latest-ending child that
+    could have gated it, recurse, and book every uncovered stretch to
+    the span that owned the clock at that moment. Segment kinds:
+    ``span`` (a leaf's own work), ``self`` (a parent's own leading
+    work), ``residual`` (time inside a parent covered by NO child —
+    network, queue slack between hops, cross-shard stitch skew —
+    reported as its own segment, never dropped). Segments partition
+    ``[root.start, root.end]`` exactly, so they sum to the root
+    duration by construction."""
+    segs: list[dict[str, Any]] = []
+
+    def seg(node: dict, t0: float, t1: float, kind: str) -> None:
+        if t1 - t0 > _EPS:
+            segs.append({
+                "span": node["name"], "process": node["process"],
+                "t0_s": t0, "t1_s": t1, "seconds": t1 - t0, "kind": kind,
+                **({"outcome": node["args"]["outcome"]}
+                   if node.get("args", {}).get("outcome") else {}),
+            })
+
+    def walk(node: dict, t_hi: float) -> None:
+        t = min(node["end_s"], t_hi)
+        remaining = list(node["children"])
+        while True:
+            best, best_e = None, 0.0
+            for c in remaining:
+                ce = min(c["end_s"], t)
+                if ce - c["start_s"] <= _EPS:
+                    continue
+                if best is None or ce > best_e:
+                    best, best_e = c, ce
+            if best is None:
+                break
+            remaining.remove(best)
+            seg(node, best_e, t, "residual")
+            walk(best, best_e)
+            t = max(best["start_s"], node["start_s"])
+        seg(node, node["start_s"], t,
+            "span" if not node["children"] else "self")
+
+    walk(root, root["end_s"])
+    segs.sort(key=lambda s: s["t0_s"])
+    return segs
+
+
+def render_waterfall(stitched: dict[str, Any], width: int = 56) -> str:
+    """ASCII waterfall of a stitched trace: one row per span, bar
+    position/length proportional to when it ran inside the root span."""
+    root = stitched["root"]
+    total = max(root["end_s"] - root["start_s"], _EPS)
+    lines: list[str] = []
+
+    def row(node: dict, depth: int) -> None:
+        off = int((node["start_s"] - root["start_s"]) / total * width)
+        w = max(1, round((node["end_s"] - node["start_s"]) / total * width))
+        off = min(off, width - 1)
+        bar = " " * off + "#" * min(w, width - off)
+        label = ("  " * depth + node["name"])[:26]
+        outcome = (node.get("args") or {}).get("outcome")
+        tail = f"  [{outcome}]" if outcome else ""
+        dur_s = node["end_s"] - node["start_s"]
+        lines.append(
+            f"{label:<26s} |{bar:<{width}s}| "
+            f"{dur_s * 1e3:9.3f} ms  {node['process']}{tail}"
+        )
+        for c in sorted(node["children"], key=lambda c: c["start_s"]):
+            row(c, depth + 1)
+
+    row(root, 0)
+    return "\n".join(lines)

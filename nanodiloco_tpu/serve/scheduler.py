@@ -73,6 +73,7 @@ import numpy as np
 
 from nanodiloco_tpu.obs import flightrec
 from nanodiloco_tpu.obs.telemetry import Histogram, nearest_rank_percentile
+from nanodiloco_tpu.obs.tracer import TraceContext
 from nanodiloco_tpu.serve.block_pool import BlocksExhausted
 
 
@@ -138,6 +139,11 @@ class GenRequest:
     prefix_cache: bool = True
     speculate: bool = True
     prefill_only: bool = False
+    # causal trace context in wire form (obs/tracer.TraceContext): the
+    # router's per-attempt span id — this request's queued/prefill/
+    # decode spans parent under it, so a fleet trace stitches into one
+    # tree. None = untraced (solo clients, old routers).
+    trace_context: str | None = None
 
 
 class Ticket:
@@ -464,20 +470,40 @@ class Scheduler:
 
     # -- KV shipping (disaggregated serving; run via call_on_tick) -----------
 
-    def export_parked(self, request_id: str):
+    def export_parked(self, request_id: str,
+                      trace_context: str | None = None):
         """Ship a PARKED request's raw KV out and free its slot. Tick
         thread only (hand it over with ``call_on_tick``). Returns
         ``(raw_export, parked)`` — the backend's ``export_kv`` dict plus
         the parked record (cursor, emitted tokens, original request) —
         or ``None`` when no parked slot matches (expired, already
-        exported, or never here: the server's 404)."""
+        exported, or never here: the server's 404). ``trace_context``
+        is the router's export-leg wire context; the ``kv_export`` span
+        parents under it."""
+        t0 = self._clock()
+        ctx = None
+        if self.tracer is not None and trace_context:
+            wire = TraceContext.from_wire(trace_context)
+            ctx = wire.child() if wire is not None else None
         for s, run in enumerate(self._slots):
             if isinstance(run, _Parked) and run.request_id == request_id:
                 raw = self.backend.export_kv(s)
                 self._backend_release(s)
                 self._slots[s] = None
+                self._span("kv_export", t0, self._clock(), request_id,
+                           ctx=ctx, slot=s, outcome="ok")
                 return raw, run
+        self._span("kv_export", t0, self._clock(), request_id,
+                   ctx=ctx, outcome="missing")
         return None
+
+    def _import_ctx(self, request: GenRequest):
+        """The kv_import span's context: a child of the router's
+        import-leg wire context (rides in the shipped request spec)."""
+        if self.tracer is None or not request.trace_context:
+            return None
+        wire = TraceContext.from_wire(request.trace_context)
+        return wire.child() if wire is not None else None
 
     def admit_import(self, request: GenRequest, shipped) -> Ticket:
         """Admit a SHIPPED stream straight into a free slot, bypassing
@@ -487,11 +513,18 @@ class Scheduler:
         thread only (``call_on_tick``); the HTTP handler maps the
         raises: ``ShipMismatchError`` -> 409, ``BlocksExhausted`` /
         ``QueueFull`` -> 429, anything else -> 400."""
+        t0 = self._clock()
+        imp_ctx = self._import_ctx(request)
+        # shipped requests carry the router's correlation id; "(ship)"
+        # only when a direct caller omitted one (no ticket exists yet)
+        rid = request.request_id or "(ship)"
         slot = next(
             (s for s in range(len(self._slots)) if self._slots[s] is None),
             None,
         )
         if slot is None:
+            self._span("kv_import", t0, self._clock(), rid,
+                       ctx=imp_ctx, outcome="busy")
             raise QueueFull(
                 "no free KV import slot"
                 f"{self._saturation_detail()}"
@@ -500,9 +533,16 @@ class Scheduler:
             ticket = Ticket(self._next_rid)
             self._next_rid += 1
         now = self._clock()
-        # raises ShipMismatchError / ShipFormatError / BlocksExhausted /
-        # ValueError having allocated nothing (all-or-nothing import)
-        self.backend.import_kv(slot, request, shipped)
+        try:
+            # raises ShipMismatchError / ShipFormatError / BlocksExhausted /
+            # ValueError having allocated nothing (all-or-nothing import)
+            self.backend.import_kv(slot, request, shipped)
+        except Exception:
+            self._span("kv_import", t0, self._clock(), rid,
+                       ctx=imp_ctx, outcome="error")
+            raise
+        self._span("kv_import", t0, self._clock(), rid,
+                   ctx=imp_ctx, slot=slot, outcome="ok")
         held = getattr(self.backend, "blocks_held", None)
         deadline = (
             now + request.deadline_s
@@ -565,7 +605,8 @@ class Scheduler:
             else:
                 self._cancelled += 1
             self._span("queued", q.submitted_at, now,
-                       self._req_id(q.ticket, q.request), outcome=reason)
+                       self._req_id(q.ticket, q.request),
+                       ctx=self._ctx(q.request), outcome=reason)
             self._finish(q.ticket, q.request, [], reason,
                          q.submitted_at, None, None, now)
 
@@ -586,7 +627,8 @@ class Scheduler:
             self._backend_release(s)
             self._slots[s] = None
             self._span("prefill", run.admitted_at, now,
-                       self._req_id(run.ticket, run.request), slot=s,
+                       self._req_id(run.ticket, run.request),
+                       ctx=self._ctx(run.request), slot=s,
                        chunks=run.chunks_run, outcome=reason)
             # chunks already run billed their seconds to this request —
             # an expiry mid-prefill must not drop them (no second
@@ -641,7 +683,7 @@ class Scheduler:
                 now2 = self._clock()
                 self._span("queued", q.submitted_at, now2,
                            self._req_id(q.ticket, q.request),
-                           outcome="cancelled")
+                           ctx=self._ctx(q.request), outcome="cancelled")
                 self._finish(q.ticket, q.request, [], "cancelled",
                              q.submitted_at, None, None, now2)
                 continue
@@ -664,16 +706,21 @@ class Scheduler:
                 self._dequeue(q)
                 self._errors += 1
                 self._span("queued", q.submitted_at, t_admit, rid_str,
-                           outcome="error")
+                           ctx=self._ctx(q.request), outcome="error")
                 self._finish(q.ticket, q.request, [], "error",
                              q.submitted_at, None, None, self._clock(),
                              error=str(e))
                 continue
             self._dequeue(q)
             wait = t_admit - q.submitted_at
-            self.hist_queue_wait.observe(wait)
+            # exemplar: the sampled trace id rides into whichever bucket
+            # this observation lands in, linking the histogram back to
+            # one real request's causal tree
+            self.hist_queue_wait.observe(
+                wait, exemplar=self._trace_id(q.request))
             self._priority_hist(q.request.priority).observe(wait)
-            self._span("queued", q.submitted_at, t_admit, rid_str, slot=slot,
+            self._span("queued", q.submitted_at, t_admit, rid_str,
+                       ctx=self._ctx(q.request), slot=slot,
                        priority=q.request.priority)
             # KV blocks the admission just allocated (all-or-nothing,
             # constant until release): the block-seconds bill is
@@ -736,8 +783,10 @@ class Scheduler:
             if tok0 is not None:
                 t_first = self._clock()
                 rid_str = self._req_id(run.ticket, run.request)
-                self.hist_ttft.observe(t_first - run.submitted_at)
+                self.hist_ttft.observe(t_first - run.submitted_at,
+                                       exemplar=self._trace_id(run.request))
                 self._span("prefill", run.admitted_at, t_first, rid_str,
+                           ctx=self._ctx(run.request),
                            slot=s, prompt_tokens=len(run.request.prompt),
                            chunks=run.chunks_run)
                 with self._lock:  # stats() sorts this deque from HTTP threads
@@ -858,6 +907,7 @@ class Scheduler:
                     self._slots[s] = None
                     self._span("decode", run.first_token_at, t1,
                                self._req_id(run.ticket, run.request),
+                               ctx=self._ctx(run.request),
                                tokens=len(run.tokens), outcome=reason)
                     self._retire(run, reason, t1)
         return sum(1 for s in self._slots if s is not None)
@@ -932,11 +982,29 @@ class Scheduler:
         return request.request_id or f"req-{ticket.rid}"
 
     def _span(self, name: str, t0: float, t1: float, request_id: str,
-              **args) -> None:
+              ctx=None, **args) -> None:
         if self.tracer is not None:
             self.tracer.record_span(
-                name, t0, t1, request_id=request_id, **args
+                name, t0, t1, ctx=ctx, request_id=request_id, **args
             )
+
+    def _ctx(self, request: GenRequest) -> TraceContext | None:
+        """A fresh span context for one of this request's phase spans,
+        parented under the router's forwarded wire context. Each call
+        mints a sibling (queued/prefill/decode sit side by side under
+        the same forward span). None when untraced."""
+        if self.tracer is None or not request.trace_context:
+            return None
+        wire = TraceContext.from_wire(request.trace_context)
+        return wire.child() if wire is not None else None
+
+    def _trace_id(self, request: GenRequest) -> str | None:
+        """The SAMPLED trace id for exemplar attachment, else None —
+        unsampled traces must not leak ids into the exposition."""
+        if not request.trace_context:
+            return None
+        wire = TraceContext.from_wire(request.trace_context)
+        return wire.trace_id if wire is not None and wire.sampled else None
 
     def _backend_release(self, slot: int) -> None:
         release = getattr(self.backend, "release", None)

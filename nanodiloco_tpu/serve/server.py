@@ -70,6 +70,7 @@ from nanodiloco_tpu.obs.telemetry import (
     handle_profile_request,
     render_exposition,
 )
+from nanodiloco_tpu.obs.tracer import TraceContext
 from nanodiloco_tpu.serve import kvship
 from nanodiloco_tpu.serve.scheduler import (
     ClassShed,
@@ -381,6 +382,13 @@ class ServeServer:
         }
         if self._tokenizer is not None:
             out["text"] = self._tokenizer.decode([int(t) for t in tokens])
+        # echo the causal trace id for sampled requests — the client
+        # (or router) needs it to find this request's spans; unsampled
+        # and malformed contexts stay silent, same as the span path
+        if request.trace_context:
+            wire = TraceContext.from_wire(request.trace_context)
+            if wire is not None and wire.sampled:
+                out["trace_id"] = wire.trace_id
         return 200, out
 
     def handle_cancel(self, doc: dict) -> tuple[int, dict]:
@@ -466,6 +474,12 @@ class ServeServer:
             raise ValueError(
                 f"prefill_only must be a boolean; got {prefill_only!r}"
             )
+        trace_context = doc.get("trace_context")
+        if trace_context is not None and (
+                not isinstance(trace_context, str) or not trace_context):
+            raise ValueError(
+                "trace_context must be a non-empty string"
+            )
         deadline = doc.get("deadline_s", self._default_deadline_s)
         # reject impossible shapes at submit time (400), not in the loop
         backend = self._scheduler.backend
@@ -485,6 +499,7 @@ class ServeServer:
             prefix_cache=prefix_cache,
             speculate=speculate,
             prefill_only=prefill_only,
+            trace_context=trace_context,
         )
 
     def _request_spec(self, req: GenRequest, request_id: str) -> dict:
@@ -599,7 +614,13 @@ class ServeServer:
             return 503, {"error": "engine loop is not running",
                          "detail": self._loop_error}
         sched = self._scheduler
-        handle = sched.call_on_tick(lambda: sched.export_parked(rid))
+        # the router's export-leg trace context rides the export doc so
+        # the scheduler's kv_export span joins the causal tree
+        tctx = doc.get("trace_context")
+        tctx = tctx if isinstance(tctx, str) and tctx else None
+        handle = sched.call_on_tick(
+            lambda: sched.export_parked(rid, trace_context=tctx)
+        )
         if not handle.wait(self._swap_timeout_s):
             return 504, {"error": "export did not run within "
                                   f"{self._swap_timeout_s:.0f}s (tick "
@@ -642,7 +663,15 @@ class ServeServer:
         except kvship.ShipFormatError as e:
             return 400, {"error": str(e)}
         try:
-            request = self._parse_request(dict(shipped.request))
+            spec = dict(shipped.request)
+            # the router's import-leg trace context arrives at the TOP
+            # level of the packed payload (the spec itself is the
+            # original request, minted before any handoff existed);
+            # inject it so the decode-side spans parent under that leg
+            tctx = doc.get("trace_context")
+            if isinstance(tctx, str) and tctx and "trace_context" not in spec:
+                spec["trace_context"] = tctx
+            request = self._parse_request(spec)
         except (ValueError, TypeError) as e:
             return 400, {"error": f"bad shipped request spec: {e}"}
         sched = self._scheduler

@@ -685,6 +685,22 @@ _COMPARE_METRICS = [
     ("disagg_ttft_p95_s", True),
     ("disagg_decode_tokens_per_sec", False),
     ("kv_ship_bytes_per_request", True),
+    # per-phase TTFT waterfall (serve_bench disagg, PR 20): where the
+    # handed-off request's first-token latency went — queue on the
+    # prefill tier, prefill compute, the ship window, import admission.
+    # Gated BOTH WAYS on the latency band (_PHASE_KEYS, 1 ms floor): a
+    # slower phase is the regression the waterfall exists to localize,
+    # and a phase that collapses to ~zero means its boundary clock
+    # stopped being measured, not that the hop got free. Gated only
+    # when both summaries carry them.
+    ("disagg_phase_queue_p50_s", True),
+    ("disagg_phase_queue_p95_s", True),
+    ("disagg_phase_prefill_p50_s", True),
+    ("disagg_phase_prefill_p95_s", True),
+    ("disagg_phase_ship_p50_s", True),
+    ("disagg_phase_ship_p95_s", True),
+    ("disagg_phase_decode_admission_p50_s", True),
+    ("disagg_phase_decode_admission_p95_s", True),
 ]
 
 # share-of-wall-clock keys (already ratios): regress on an ABSOLUTE
@@ -716,6 +732,16 @@ _SLO_BURN_KEYS = {"slo_burn_seconds"}
 # rides the same both-ways band: a heavier ship bloated the wire
 # format, a wildly lighter one stopped shipping the whole cache.
 _COST_KEYS = {"device_seconds_per_token", "kv_ship_bytes_per_request"}
+
+# per-phase TTFT waterfall keys (serve_bench disagg): BOTH-ways
+# relative band like _COST_KEYS, but floored at 1 ms — a queue phase
+# idling near zero must not gate on sub-millisecond jitter, while a
+# phase that grows OR vanishes past the band still trips the gate
+_PHASE_KEYS = {
+    f"disagg_phase_{ph}_{p}_s"
+    for ph in ("queue", "prefill", "ship", "decode_admission")
+    for p in ("p50", "p95")
+}
 
 
 def load_comparable(path: str) -> dict[str, Any]:
@@ -788,6 +814,8 @@ def compare_runs(
             regressed = abs(delta) > max_latency_increase * max(abs(b), 1.0)
         elif key in _COST_KEYS:
             regressed = abs(delta) > max_latency_increase * max(abs(b), 1e-12)
+        elif key in _PHASE_KEYS:
+            regressed = abs(delta) > max_latency_increase * max(abs(b), 1e-3)
         elif key in _LATENCY_KEYS:
             regressed = delta > max_latency_increase * max(abs(b), 1e-12)
         elif lower_better:

@@ -2687,6 +2687,220 @@ def phase_disagg() -> None:
     })
 
 
+def phase_trace() -> None:
+    """Causal-tracing drill on this backend: ONE disaggregated request
+    driven through ``ChaosProxy`` wires with a span tracer on every
+    process — the ``DisaggRouter`` and each of the three replicas — and
+    a 400 ms latency fault injected on the prefill leg. The four
+    per-process shards are then stitched with ``report trace``'s own
+    machinery into one causal tree rooted at the router's route span,
+    with the replicas' queued/prefill/kv_export/kv_import/decode spans
+    hanging under the handoff leg that caused them, and the critical
+    path must account for the measured client wire latency to within
+    10% — the injected wire delay surfacing as honest UNCOVERED time
+    (self/residual segments booked to the router's handoff_prefill
+    span, which no replica span can claim) inside the prefill leg,
+    never silently dropped. On CPU this pins the
+    propagation protocol end to end; a chip run puts real kernel time
+    under the same spans."""
+    import tempfile
+
+    import jax
+
+    from nanodiloco_tpu.cli import report_trace_main
+    from nanodiloco_tpu.fleet import DisaggRouter, Replica
+    from nanodiloco_tpu.fleet.chaos import ChaosPlan, proxy_fleet
+    from nanodiloco_tpu.models import LlamaConfig, init_params
+    from nanodiloco_tpu.obs.tracer import (
+        SpanTracer,
+        critical_path,
+        stitch_trace,
+    )
+    from nanodiloco_tpu.serve import InferenceEngine, Scheduler, ServeServer
+    from nanodiloco_tpu.serve.client import http_post_json
+
+    live = chip_is_live()
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, num_hidden_layers=2,
+        max_position_embeddings=128,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    prompt = [(i * 13 + 3) % 256 for i in range(24)]
+    max_new = 16
+    names = ["pf", "d0", "d1"]
+    roles = ["prefill", "decode", "decode"]
+    servers = []
+    tracers: dict[str, SpanTracer] = {}
+    for name, role in zip(names, roles):
+        eng = InferenceEngine(params, cfg, num_slots=2, max_len=96,
+                              kv_block_size=16)
+        # SAME clock as the scheduler (time.monotonic, its default) so
+        # the retroactively recorded request phases land on the
+        # tracer's timebase; distinct names keep the stitched tree's
+        # process column readable
+        tr = SpanTracer(clock=time.monotonic,
+                        process_name=f"nanodiloco {role} {name}")
+        tracers[name] = tr
+        servers.append(ServeServer(Scheduler(eng, tracer=tr), port=0,
+                                   host="127.0.0.1",
+                                   max_new_tokens_cap=64,
+                                   role=role).start())
+    rtracer = SpanTracer(clock=time.monotonic,
+                         process_name="nanodiloco router")
+    router = None
+    proxies = []
+    try:
+        # warm DIRECT to each replica (compile the prefill/decode
+        # programs without consuming a chaos ordinal or a trace)
+        for s in servers:
+            code, _out = http_post_json(
+                f"http://127.0.0.1:{s.port}/v1/generate",
+                {"token_ids": prompt, "max_new_tokens": max_new,
+                 "temperature": 0.0},
+                timeout=600)
+            if code != 200:
+                record({"phase": "trace",
+                        "error": f"warmup failed ({code})"})
+                raise SystemExit(1)
+        # pf's generate ordinal 0 is the traced handoff's prefill leg:
+        # the injected 400 ms wire delay must show up inside the
+        # router's handoff_prefill span as residual (the replica's own
+        # spans cannot cover wire time)
+        plan = ChaosPlan.from_dict({"faults": [
+            {"kind": "latency", "target": "pf", "requests": [0],
+             "seconds": 0.4},
+        ]})
+        replicas = [Replica(n, f"http://127.0.0.1:{s.port}")
+                    for n, s in zip(names, servers)]
+        proxied, proxies = proxy_fleet(replicas, plan)
+        router = DisaggRouter(
+            proxied, port=0, host="127.0.0.1",
+            health_interval_s=0.3, probe_timeout_s=2.0,
+            handoff_timeout_s=30.0, quiet=True, tracer=rtracer,
+        ).start()
+        url = f"http://127.0.0.1:{router.port}"
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if (router.tier_capacity_names("prefill") == ["pf"]
+                    and len(router.tier_capacity_names("decode")) == 2):
+                break
+            time.sleep(0.2)
+        else:
+            record({"phase": "trace",
+                    "error": "tiers never became ready"})
+            raise SystemExit(1)
+
+        t0 = time.monotonic()
+        code, out = http_post_json(
+            url + "/v1/generate",
+            {"token_ids": prompt, "max_new_tokens": max_new,
+             "temperature": 0.0},
+            timeout=600)
+        wire_s = time.monotonic() - t0
+        if (code != 200 or out.get("disagg") != "handoff"
+                or not out.get("trace_id") or not out.get("request_id")):
+            record({"phase": "trace", "error":
+                    f"traced handoff: {code} disagg={out.get('disagg')} "
+                    f"trace_id={out.get('trace_id')!r}"})
+            raise SystemExit(1)
+
+        shards = [rtracer.to_chrome()] + [tracers[n].to_chrome()
+                                          for n in names]
+        stitched = stitch_trace(shards, out["request_id"])
+        root = stitched["root"]
+        segs = critical_path(root)
+        total = root["end_s"] - root["start_s"]
+        names_seen = set()
+
+        def _collect(node):
+            names_seen.add(node["name"])
+            for c in node["children"]:
+                _collect(c)
+
+        _collect(root)
+        procs = {n["process"] for n in stitched["spans"]}
+        residual_s = sum(s["seconds"] for s in segs
+                         if s["kind"] == "residual")
+        # the wire delay sits BEFORE the replica's first span inside
+        # the prefill leg, so critical_path books it to the leg's own
+        # leading window ("self"); inter-hop slack lands as "residual".
+        # Both are uncovered-by-any-child time on the router's span —
+        # that is where an injected wire delay must show up.
+        pf_uncovered_s = sum(s["seconds"] for s in segs
+                             if s["span"] == "handoff_prefill"
+                             and s["kind"] in ("self", "residual"))
+        ratio = total / wire_s if wire_s > 0 else 0.0
+        by_tid = stitch_trace(shards, out["trace_id"])
+        checks = {
+            # one causal tree rooted at the router's route span — no
+            # synthetic root, no request_id-joined strays
+            "rooted_at_route": root["name"] == "route"
+            and root["process"] == "nanodiloco router",
+            "all_causal": stitched["request_id_joined"] == 0
+            and stitched["causal_spans"] == len(stitched["spans"]),
+            "three_processes": len(procs) >= 3,
+            "full_tree": {"handoff_prefill", "handoff_export",
+                          "handoff_import", "queued", "prefill",
+                          "kv_export", "kv_import",
+                          "decode"} <= names_seen,
+            "trace_id_needle_agrees":
+                by_tid["root"]["name"] == "route"
+                and len(by_tid["spans"]) == len(stitched["spans"]),
+            # the critical path accounts for the measured wire latency
+            # (the client's HTTP overhead is the only part outside the
+            # route span)
+            "latency_accounted": 0.90 <= ratio <= 1.05,
+            # the injected 400 ms wire delay is visible as uncovered
+            # time on the prefill leg's own critical-path segments
+            "chaos_delay_is_residual": pf_uncovered_s >= 0.35,
+            "segments_partition": abs(
+                sum(s["seconds"] for s in segs) - total) < 1e-6,
+        }
+        injected = plan.counts()
+        fired = plan.drain_fired()
+        if not all(checks.values()):
+            record({"phase": "trace", "error": "stitch checks failed",
+                    "checks": checks, "wire_s": round(wire_s, 4),
+                    "critical_total_s": round(total, 4),
+                    "residual_s": round(residual_s, 4),
+                    "prefill_leg_uncovered_s": round(pf_uncovered_s, 4),
+                    "span_names": sorted(names_seen)})
+            raise SystemExit(1)
+        # the operator surface end to end: the same shards through the
+        # real `report trace` CLI (file loading + waterfall + critical
+        # path render); exits nonzero on a stitch failure
+        with tempfile.TemporaryDirectory() as td:
+            paths = []
+            for i, doc in enumerate(shards):
+                p = os.path.join(td, f"shard{i}.json")
+                with open(p, "w") as f:
+                    json.dump(doc, f)
+                paths.append(p)
+            report_trace_main([out["request_id"], *paths])
+    finally:
+        if router is not None:
+            router.stop()
+        for p in proxies:
+            p.stop()
+        for s in servers:
+            s.stop()
+    record({
+        "phase": "trace",
+        "backend_live": live,
+        "chaos_injected": injected,
+        "chaos_fired": len(fired),
+        "wire_s": round(wire_s, 4),
+        "critical_total_s": round(total, 4),
+        "residual_s": round(residual_s, 4),
+        "prefill_leg_uncovered_s": round(pf_uncovered_s, 4),
+        "accounted_ratio": round(ratio, 4),
+        "spans": len(stitched["spans"]),
+        "processes": len(procs),
+        "shards": stitched["shards"],
+    })
+
+
 def phase_slo_watch() -> None:
     """Fleet observability drill on this backend: train a tiny
     checkpoint, boot a 2-replica `serve` fleet behind the `fleet`
@@ -3745,6 +3959,7 @@ PHASES = {
     "fleet": phase_fleet,
     "chaos": phase_chaos,
     "disagg": phase_disagg,
+    "trace": phase_trace,
     "slo_watch": phase_slo_watch,
     "autoscale_surge": phase_autoscale_surge,
     "devtime": phase_devtime,
@@ -3798,6 +4013,7 @@ PHASE_TIMEOUT_S = {
     "fleet": 1800,
     "chaos": 900,
     "disagg": 1200,
+    "trace": 900,
     "slo_watch": 1500,
     "autoscale_surge": 1800,
     "devtime": 1200,

@@ -1342,6 +1342,21 @@ def _disagg_leg(args, cfg, params, *, tiered: bool) -> dict:
     long_ttfts = sorted(ttft(r) for r in results if r["_class"] == "long")
     all_ttfts = sorted(ttft(r) for r in results)
     disagg = fleet.get("disagg") or {}
+    # per-phase TTFT waterfall (tiered leg only: the phases exist only
+    # on handoff responses) — where a handed-off request's first-token
+    # latency went: queue on the prefill tier, prefill compute, the
+    # ship window (export + decode pick), and import admission overhead
+    phase_stats: dict = {}
+    for ph in ("queue_s", "prefill_s", "ship_s", "decode_admission_s"):
+        vals = sorted(
+            r["handoff_phases"][ph] for r in results
+            if isinstance(r.get("handoff_phases"), dict)
+            and isinstance(r["handoff_phases"].get(ph), (int, float))
+        )
+        if vals:
+            key = ph[:-2]  # strip the _s unit suffix off the phase name
+            phase_stats[f"{key}_p50_s"] = round(_pct(vals, 0.50), 6)
+            phase_stats[f"{key}_p95_s"] = round(_pct(vals, 0.95), 6)
     return {
         "replicas": len(replicas),
         "roles": roles,
@@ -1366,6 +1381,7 @@ def _disagg_leg(args, cfg, params, *, tiered: bool) -> dict:
         "fallbacks_by_reason": disagg.get("fallbacks_by_reason"),
         "ship_bytes": disagg.get("ship_bytes", 0),
         "handoff_seconds_sum": disagg.get("handoff_seconds_sum"),
+        "ttft_phases": phase_stats or None,
     }
 
 
@@ -1411,6 +1427,11 @@ def run_disagg(args, cfg, params, jax) -> None:
         "disagg_ttft_p95_s": tiered["short_ttft_p95_s"],
         "disagg_decode_tokens_per_sec": d_tps,
         "kv_ship_bytes_per_request": ship_per_req,
+        # the per-phase TTFT waterfall, flattened into gated keys: the
+        # compare gate catches a regression in WHICH hop ate the
+        # latency, not just that p95 moved
+        **{f"disagg_phase_{k}": v
+           for k, v in (tiered.get("ttft_phases") or {}).items()},
         # the monolithic control at the same device count, and the
         # headline ratio the split is FOR (>= 1 means the decode tier
         # really is shielded from long-prompt admissions)

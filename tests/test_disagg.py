@@ -309,6 +309,126 @@ def test_import_409_falls_back_without_spraying(tmp_path):
     assert reasons == {"import_failed": 1}
 
 
+# -- request_id pinned on every failure path (PR 20) --------------------------
+#
+# The request_id is the trace join key: every answer out of the
+# DisaggRouter — shed, fallback (even with a broken non-dict body from
+# the decode tier), import-retry — must carry it or the response cannot
+# be correlated with its spans.
+
+
+def test_request_id_pinned_on_disagg_shed_429(tmp_path):
+    router, fleet, _ = _router(tmp_path, ROLES)
+    fleet.reply[("pf", "/v1/generate")] = (
+        429, {"shed": True, "error": "priority 3 shed"})
+    code, out = router.handle_generate(
+        {"token_ids": [5], "max_new_tokens": 4, "stop": False})
+    assert code == 429 and out["shed"]
+    assert out["request_id"]
+
+
+def test_request_id_pinned_on_non_dict_fallback_body(tmp_path):
+    # prefill 500 degrades to the fallback, and the decode tier answers
+    # a bare string (an intermediary's error page): the router wraps it
+    # rather than returning an id-less body
+    router, fleet, _ = _router(tmp_path, ROLES)
+    fleet.reply[("pf", "/v1/generate")] = (500, {"error": "boom"})
+    fleet.reply[("d0", "/v1/generate")] = lambda doc: (502, "bad gateway")
+    fleet.reply[("d1", "/v1/generate")] = lambda doc: (502, "bad gateway")
+    code, out = router.handle_generate(
+        {"token_ids": [5], "max_new_tokens": 4, "stop": False})
+    assert code == 502
+    assert isinstance(out, dict)
+    assert out["request_id"] and out["disagg"] == "fallback"
+    assert out["error"] == "bad gateway"
+
+
+def test_fallback_reply_and_every_leg_share_the_request_id(tmp_path):
+    router, fleet, _ = _router(tmp_path, ROLES)
+    fleet.reply[("pf", "/v1/generate")] = (500, {"error": "boom"})
+    code, out = router.handle_generate(
+        {"token_ids": [5], "max_new_tokens": 4, "stop": False})
+    assert code == 200 and out["disagg"] == "fallback"
+    rid = out["request_id"]
+    assert rid
+    gens = [d for n, p, d, _ in fleet.posts if p == "/v1/generate"]
+    # the prefill leg and the fallback leg rode the SAME id
+    assert [d["request_id"] for d in gens] == [rid, rid]
+
+
+def test_handoff_phases_computed_from_boundary_clocks(tmp_path):
+    """The per-phase TTFT waterfall: queue/prefill from the prefill
+    replica's own timing dict, ship from the router's export window,
+    decode admission from the import leg minus the decode work — each
+    boundary measured by the clock that owns it."""
+    router, fleet, clock = _router(tmp_path, ROLES)
+
+    def pf_reply(doc):
+        clock.advance(0.5)
+        return (200, {"token_ids": [7], "finish_reason": "prefilled",
+                      "request_id": doc.get("request_id"),
+                      "timing": {"queued_s": 0.1, "ttft_s": 0.4}})
+
+    def exp_reply(doc):
+        clock.advance(0.2)
+        return (200, SHIP)
+
+    def imp_reply(doc):
+        clock.advance(0.3)
+        return (200, {"token_ids": [7, 8, 9], "finish_reason": "length",
+                      "timing": {"total_s": 0.25}})
+
+    fleet.reply[("pf", "/v1/generate")] = pf_reply
+    fleet.reply[("pf", "/admin/kv/export")] = exp_reply
+    fleet.reply[("d0", "/admin/kv/import")] = imp_reply
+    code, out = router.handle_generate(
+        {"token_ids": [5, 9], "max_new_tokens": 4, "stop": False})
+    assert code == 200 and out["disagg"] == "handoff"
+    ph = out["handoff_phases"]
+    assert ph["queue_s"] == pytest.approx(0.1)
+    assert ph["prefill_s"] == pytest.approx(0.3)    # ttft - queued
+    assert ph["ship_s"] == pytest.approx(0.2)       # the export window
+    assert ph["decode_admission_s"] == pytest.approx(0.05)  # leg - decode
+
+
+def test_compare_runs_gates_disagg_phase_keys_both_ways(tmp_path):
+    """A phase p95 regressing in EITHER direction trips the gate
+    (slower = a new hop tax; collapsing to ~zero = the boundary clock
+    stopped being measured), with a 1 ms floor so near-zero queue
+    phases never flap — and an old baseline without the keys stays
+    ungated."""
+    from nanodiloco_tpu.training.metrics import compare_runs
+
+    base = {"disagg_phase_ship_p95_s": 0.050}
+    assert compare_runs(base, {"disagg_phase_ship_p95_s": 0.052},
+                        max_latency_increase=0.10)["ok"]
+    out = compare_runs(base, {"disagg_phase_ship_p95_s": 0.080},
+                       max_latency_increase=0.10)
+    assert not out["ok"] and "disagg_phase_ship_p95_s" in out["regressions"]
+    out = compare_runs(base, {"disagg_phase_ship_p95_s": 0.001},
+                       max_latency_increase=0.10)
+    assert not out["ok"]
+    # the floor: deltas are judged against at least 1 ms of baseline,
+    # so a 0.05 ms wobble on a 0.5 ms queue phase is noise (a bare
+    # relative rule would call that 10% and flap)
+    assert compare_runs({"disagg_phase_queue_p50_s": 0.0005},
+                        {"disagg_phase_queue_p50_s": 0.00055},
+                        max_latency_increase=0.10)["ok"]
+    assert compare_runs({}, {"disagg_phase_ship_p95_s": 0.050})["ok"]
+
+
+def test_request_id_pinned_on_import_retry_success(tmp_path):
+    router, fleet, _ = _router(tmp_path, ROLES)
+    _wire_happy(fleet)
+    fleet.reply[("d0", "/admin/kv/import")] = (429, {"error": "busy"})
+    fleet.reply[("d1", "/admin/kv/import")] = (
+        200, {"token_ids": [7, 8], "finish_reason": "length"})
+    code, out = router.handle_generate(
+        {"token_ids": [5], "max_new_tokens": 4, "stop": False})
+    assert code == 200 and out["served_by"] == "d1"
+    assert out["request_id"]
+
+
 def test_tier_capacity_excludes_draining_and_open_breaker(tmp_path):
     """The small-fix satellite at the router: tier capacity counts
     serving + ready + breaker-closed + role-matching replicas ONLY —

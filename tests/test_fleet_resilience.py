@@ -78,7 +78,10 @@ class ScriptedFleet:
             if callable(r):
                 r = r(doc)
             code, out = r
-            return code, dict(out)
+            # the real transport (json.loads of the body) can deliver a
+            # non-dict JSON value — a bare string from an intermediary —
+            # so the script must be able to as well
+            return code, (dict(out) if isinstance(out, dict) else out)
         if path == "/v1/cancel":
             return 200, {"cancelled": True}
         if path == "/admin/drain":
@@ -501,3 +504,84 @@ def test_summarize_run_tolerates_pre_resilience_jsonl(tmp_path):
     assert not any(k.startswith("fleet_hedge") for k in out)
     assert "fleet_breaker_open_s" not in out
     assert "chaos_injected_total" not in out
+
+
+# -- request_id pinned on every failure path (PR 20) --------------------------
+#
+# The request_id is the trace join key: a response without it cannot be
+# correlated with its route/forward spans, so EVERY path out of the
+# router — hedge winner and double-loss, retry-on-other-replica,
+# terminal 429, even a replica answering with a non-dict body — must
+# carry it.
+
+
+def test_request_id_survives_retry_on_other_replica(tmp_path):
+    router, fleet, _ = _router(tmp_path)
+    fleet.generate_reply["r0"] = (503, {"error": "draining"})
+    fleet.generate_reply["r1"] = (200, {"token_ids": [1]})
+    code, out = router.handle_generate({"prompt": [1]})
+    assert code == 200
+    rid = out["request_id"]
+    assert rid
+    # both attempts forwarded the SAME id (one causal chain, two legs)
+    assert {d["request_id"] for _, d in _gen_posts(fleet)} == {rid}
+
+
+def test_request_id_pinned_on_non_dict_error_body(tmp_path):
+    # a broken replica answering a bare string must still yield a
+    # correlatable response: the router wraps it rather than returning
+    # an id-less body
+    router, fleet, _ = _router(tmp_path)
+    fleet.generate_reply["r0"] = lambda doc: (500, "boom-r0")
+    fleet.generate_reply["r1"] = lambda doc: (500, "boom-r1")
+    code, out = router.handle_generate({"prompt": [1]})
+    assert code == 500
+    assert isinstance(out, dict)
+    assert out["request_id"]
+    assert out["error"].startswith("boom-")
+    assert out["replica"] in ("r0", "r1")
+
+
+def test_request_id_pinned_on_non_dict_busy_body(tmp_path):
+    router, fleet, _ = _router(tmp_path)
+    fleet.generate_reply["r0"] = lambda doc: (429, "busy-r0")
+    fleet.generate_reply["r1"] = lambda doc: (429, "busy-r1")
+    code, out = router.handle_generate({"prompt": [1]})
+    assert code == 429
+    assert isinstance(out, dict)
+    assert out["request_id"]
+    assert out["replica"] in ("r0", "r1")
+
+
+def test_request_id_pinned_on_router_shed_429(tmp_path):
+    router, fleet, _ = _router(tmp_path)
+    router.set_admission(3)
+    code, out = router.handle_generate({"prompt": [1], "priority": 7})
+    assert code == 429
+    assert out["shed"] is True and out["request_id"]
+    assert not _gen_posts(fleet)  # shed at the front door, no forward
+
+
+def test_request_id_pinned_on_hedge_paths(tmp_path):
+    # winner: the hedge's answer carries the id (and matches both legs)
+    router, fleet, _ = _router(tmp_path, hedge_after_s=0.05)
+    stuck = threading.Event()
+    fleet.block["r0"] = stuck
+    code, out = router.handle_generate({"prompt": [1]})
+    stuck.set()
+    assert code == 200 and out["request_id"]
+    assert _wait_for(lambda: len(_gen_posts(fleet)) == 2)
+    assert ({d["request_id"] for _, d in _gen_posts(fleet)}
+            == {out["request_id"]})
+    # double loss with NON-DICT bodies: still one honest wrapped error
+    fleet.posts.clear()
+    fleet.block.clear()
+    router2, fleet2, _ = _router(tmp_path, hedge_after_s=0.05)
+    stuck2 = threading.Event()
+    fleet2.block["r0"] = stuck2
+    fleet2.generate_reply["r0"] = lambda doc: (500, "boom-r0")
+    fleet2.generate_reply["r1"] = lambda doc: (500, "boom-r1")
+    threading.Timer(0.3, stuck2.set).start()
+    code, out = router2.handle_generate({"prompt": [1]})
+    assert code == 500
+    assert isinstance(out, dict) and out["request_id"]

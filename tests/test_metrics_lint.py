@@ -212,6 +212,131 @@ def test_metric_name_references_resolve_to_real_families(scan):
     )
 
 
+# -- span-name lint -----------------------------------------------------------
+#
+# The trace vocabulary is an operator contract exactly like the metric
+# namespace: `report trace` stitches spans emitted by the ROUTER, the
+# DISAGG router, and the SERVE scheduler into one tree, and the
+# critical-path / waterfall tooling keys on the names. A hop renamed in
+# one emitter but not the others silently tears every cross-process
+# trace. Same discipline as LABEL_ALLOWLIST: additions need a README
+# row (the "Distributed tracing" section) AND an entry here.
+
+SPAN_NAME_ALLOWLIST = {
+    # fleet routing (fleet/router.py, fleet/disagg.py)
+    "route", "forward", "fallback",
+    "handoff", "handoff_prefill", "handoff_export", "handoff_import",
+    # serve request phases (serve/scheduler.py)
+    "queued", "prefill", "decode", "kv_export", "kv_import",
+    # training round phases (training/, parallel/)
+    "outer_sync", "ckpt", "data", "cost_analysis", "inner",
+    "comm_probe", "sync", "eval", "log",
+    # the synthetic root stitch_trace mints for request_id-joined shards
+    "trace",
+}
+
+# every outcome tag any span may carry — bounded so dashboards and the
+# waterfall's outcome coloring can enumerate them. Dynamic outcomes
+# (outcome=reason) are constrained at their source: the scheduler's
+# finish/drop reasons are all listed here.
+SPAN_OUTCOME_ALLOWLIST = {
+    "ok", "error", "busy", "unavailable", "shed", "missing",
+    "cancelled", "deadline", "deadline_expired", "no_ready_replica",
+    "exhausted", "fallback", "stop", "length", "prefilled",
+}
+
+_SPAN_CALL_NAMES = {"_span", "span", "trace_span", "record_span"}
+
+
+def _scan_spans():
+    """Every span-emitter call site in the package: ``[(name_or_None,
+    outcomes, file, line)]`` — name None when the first argument is not
+    a string literal (a variable; its values are someone else's lint),
+    outcomes = every string constant inside an ``outcome=`` keyword
+    (a conditional expression contributes each of its arms)."""
+    sites: list[tuple[str | None, set, str, int]] = []
+    for dirpath, _dirs, files in os.walk(PKG):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, REPO)
+            with open(path) as f:
+                tree = ast.parse(f.read())
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = (node.func.attr
+                         if isinstance(node.func, ast.Attribute)
+                         else node.func.id
+                         if isinstance(node.func, ast.Name) else None)
+                if fname not in _SPAN_CALL_NAMES:
+                    continue
+                name = None
+                if (node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    name = node.args[0].value
+                outcomes: set = set()
+                for kw in node.keywords:
+                    if kw.arg != "outcome":
+                        continue
+                    for sub in ast.walk(kw.value):
+                        if (isinstance(sub, ast.Constant)
+                                and isinstance(sub.value, str)):
+                            outcomes.add(sub.value)
+                if name is not None or outcomes:
+                    sites.append((name, outcomes, rel, node.lineno))
+    return sites
+
+
+@pytest.fixture(scope="module")
+def span_sites():
+    return _scan_spans()
+
+
+def test_span_scan_finds_the_emitters(span_sites):
+    """Sanity pin: the scan sees the known hop names from all three
+    emitters (router, disagg, serve scheduler) — if a refactor moves
+    span emission to an idiom the scan can't parse, this fails before
+    the vocabulary checks silently pass on nothing."""
+    names = {n for n, _o, _f, _l in span_sites if n}
+    for expected in ("route", "forward", "fallback", "handoff_prefill",
+                     "handoff_export", "handoff_import", "queued",
+                     "prefill", "decode", "kv_export", "kv_import"):
+        assert expected in names, f"span scan lost sight of {expected!r}"
+
+
+def test_span_names_come_from_the_allowlist(span_sites):
+    """One hop vocabulary across every emitter: a span name outside the
+    allowlist is either a typo'd rename (which tears `report trace`'s
+    cross-process stitch) or a new hop that needs a reviewed allowlist
+    entry + README row."""
+    bad = [(n, f, l) for n, _o, f, l in span_sites
+           if n is not None and n not in SPAN_NAME_ALLOWLIST]
+    assert not bad, (
+        f"span names outside SPAN_NAME_ALLOWLIST: {sorted(set(bad))} — "
+        "hop names are a cross-emitter contract; extending the "
+        "allowlist is a reviewed decision"
+    )
+
+
+def test_span_outcomes_come_from_the_allowlist(span_sites):
+    """Outcome tags are enumerable: every string an ``outcome=`` kwarg
+    can produce (each arm of a conditional counts) must be in the
+    bounded allowlist, so waterfall rendering and outcome dashboards
+    never meet a tag they can't classify."""
+    bad = []
+    for name, outcomes, rel, line in span_sites:
+        rogue = outcomes - SPAN_OUTCOME_ALLOWLIST
+        if rogue:
+            bad.append((name, sorted(rogue), rel, line))
+    assert not bad, (
+        f"span outcome tags outside SPAN_OUTCOME_ALLOWLIST: {bad}"
+    )
+
+
 def test_every_family_documented_in_readme(scan):
     """README's metrics tables are the operator contract: every defined
     family name must appear there. A new family without a table row

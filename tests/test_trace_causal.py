@@ -1,0 +1,527 @@
+"""Causal distributed tracing (obs/tracer + the exemplar plumbing):
+TraceContext wire format, head sampling, span parenting under an
+activated context, cross-process stitch + critical path + waterfall,
+the `report trace` CLI, and OpenMetrics exemplars surviving the
+render/parse byte contract.
+
+Deterministic and model-free (tier-1): every tracer runs on a fake
+clock; the "shards" are real ``to_chrome()`` exports from three
+in-process tracers standing in for the router and the two tiers."""
+
+import json
+
+import pytest
+
+from nanodiloco_tpu.obs.tracer import (
+    SpanTracer,
+    TraceContext,
+    critical_path,
+    render_waterfall,
+    stitch_trace,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- TraceContext: wire format ------------------------------------------------
+
+
+def test_trace_context_wire_round_trip():
+    tr = SpanTracer(clock=FakeClock())
+    ctx = tr.new_trace()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    assert ctx.parent_span_id is None and ctx.sampled
+    wire = ctx.to_wire()
+    assert wire == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+    back = TraceContext.from_wire(wire)
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled
+    # the receiver does not know OUR parent — only that we caused it
+    assert back.parent_span_id is None
+    # an unsampled decision rides the flags
+    off = TraceContext(ctx.trace_id, ctx.span_id, None, False)
+    assert off.to_wire().endswith("-00")
+    assert TraceContext.from_wire(off.to_wire()).sampled is False
+
+
+@pytest.mark.parametrize("bad", [
+    None,
+    42,
+    "",
+    "garbage",
+    "00-abc-def-01",                       # ids too short
+    "00-" + "g" * 32 + "-" + "0" * 16 + "-01",   # non-hex trace id
+    "00-" + "0" * 32 + "-" + "0" * 15 + "-01",   # span id wrong length
+    "00-" + "0" * 32 + "-" + "0" * 16,           # missing flags
+])
+def test_trace_context_malformed_wire_degrades_to_none(bad):
+    # an old client or garbage header must degrade to untraced, not 4xx
+    assert TraceContext.from_wire(bad) is None
+
+
+def test_child_links_parent_and_keeps_the_decision():
+    ctx = TraceContext("ab" * 16, "cd" * 8, None, True)
+    c = ctx.child()
+    assert c.trace_id == ctx.trace_id
+    assert c.parent_span_id == ctx.span_id
+    assert c.span_id != ctx.span_id and len(c.span_id) == 16
+    assert c.sampled
+    off = TraceContext("ab" * 16, "cd" * 8, None, False).child()
+    assert off.sampled is False
+
+
+def test_accept_adopts_the_wire_or_mints_fresh():
+    tr = SpanTracer(clock=FakeClock())
+    ctx = TraceContext("ab" * 16, "cd" * 8, None, False)
+    got = tr.accept(ctx.to_wire())
+    # the propagated decision wins over the local sampler
+    assert got.trace_id == ctx.trace_id and got.sampled is False
+    minted = tr.accept(None)
+    assert len(minted.trace_id) == 32 and minted.sampled
+
+
+# -- head sampling ------------------------------------------------------------
+
+
+def test_head_sample_deterministic_in_the_trace_id():
+    # reservoir off: the decision is a pure function of the trace id,
+    # so concurrent edge processes agree without coordination
+    tr = SpanTracer(clock=FakeClock(), sample_rate=0.5,
+                    reservoir_per_window=0)
+    low, high = "0" * 32, "f" * 32
+    assert tr.head_sample(low) is True
+    assert tr.head_sample(high) is False
+    assert [tr.head_sample(high) for _ in range(5)] == [False] * 5
+    assert SpanTracer(clock=FakeClock(), sample_rate=1.0).head_sample(high)
+
+
+def test_head_sample_reservoir_tops_up_a_zero_rate():
+    clk = FakeClock()
+    tr = SpanTracer(clock=clk, sample_rate=0.0, reservoir_per_window=2,
+                    reservoir_window_s=60.0)
+    tid = "f" * 32
+    assert tr.head_sample(tid) and tr.head_sample(tid)   # reservoir
+    assert tr.head_sample(tid) is False                  # drained
+    clk.advance(60.0)                                    # window rolls
+    assert tr.head_sample(tid) is True
+
+
+# -- span parenting under an activated context --------------------------------
+
+
+def test_span_parents_under_activated_context_then_local_stack():
+    clk = FakeClock()
+    tr = SpanTracer(clock=clk)
+    ctx = TraceContext("ab" * 16, "cd" * 8, None, True)
+    with tr.activate(ctx):
+        with tr.span("outer"):
+            clk.advance(1.0)
+            with tr.span("inner"):
+                clk.advance(0.5)
+    inner, outer = tr.events
+    # depth-0 span parents under the accepted remote context; the
+    # nested span parents under the enclosing LOCAL span
+    assert outer["args"]["trace_id"] == ctx.trace_id
+    assert outer["args"]["parent_span_id"] == ctx.span_id
+    assert inner["args"]["parent_span_id"] == outer["args"]["span_id"]
+    assert inner["args"]["trace_id"] == ctx.trace_id
+
+
+def test_unsampled_context_adds_no_ids():
+    tr = SpanTracer(clock=FakeClock())
+    off = TraceContext("ab" * 16, "cd" * 8, None, False)
+    with tr.activate(off):
+        with tr.span("outer"):
+            pass
+    tr.record_span("queued", 0.0, 0.1, ctx=off, request_id="r1")
+    assert "trace_id" not in (tr.events[0].get("args") or {})
+    assert "trace_id" not in tr.events[1]["args"]
+    # the request_id join key still rides (old-shard fallback path)
+    assert tr.events[1]["args"]["request_id"] == "r1"
+
+
+def test_record_span_carries_the_given_context():
+    tr = SpanTracer(clock=FakeClock())
+    ctx = TraceContext("ab" * 16, "cd" * 8, None, True).child()
+    tr.record_span("kv_export", 0.0, 0.2, ctx=ctx, request_id="r1",
+                   outcome="ok")
+    a = tr.events[0]["args"]
+    assert a["trace_id"] == ctx.trace_id
+    assert a["span_id"] == ctx.span_id
+    assert a["parent_span_id"] == "cd" * 8
+    assert a["outcome"] == "ok"
+
+
+# -- stitch + critical path ---------------------------------------------------
+
+
+RID = "req-42"
+
+
+def _disagg_shards(fallback=False):
+    """Three real tracer exports modelling one disaggregated request:
+    the router's route/handoff spans, the prefill tier's queued/prefill/
+    kv_export, the decode tier's kv_import/decode — every cross-process
+    edge crossing a real ``to_wire()``/``from_wire()`` hop, exactly as
+    the fleet does it. All three share wall_start_unix so the injected
+    clocks line up exactly (clock-skew alignment is merge's own test)."""
+    rtr = SpanTracer(clock=FakeClock(), process_name="router")
+    route = rtr.new_trace()
+    pf_ctx, exp_ctx, imp_ctx = route.child(), route.child(), route.child()
+    if fallback:
+        rtr.record_span("handoff_prefill", 0.01, 0.10, ctx=pf_ctx,
+                        request_id=RID, outcome="error")
+        fb_ctx = route.child()
+        rtr.record_span("fallback", 0.12, 0.95, ctx=fb_ctx,
+                        request_id=RID, outcome="ok")
+        rtr.record_span("route", 0.0, 1.0, ctx=route, request_id=RID,
+                        outcome="fallback")
+    else:
+        rtr.record_span("handoff_prefill", 0.01, 0.40, ctx=pf_ctx,
+                        request_id=RID, outcome="ok")
+        rtr.record_span("handoff_export", 0.40, 0.50, ctx=exp_ctx,
+                        request_id=RID, outcome="ok")
+        rtr.record_span("handoff_import", 0.52, 0.97, ctx=imp_ctx,
+                        request_id=RID, outcome="ok")
+        rtr.record_span("route", 0.0, 1.0, ctx=route, request_id=RID,
+                        outcome="ok")
+    rdoc = rtr.to_chrome()
+    rdoc["otherData"]["wall_start_unix"] = 100.0
+
+    ptr = SpanTracer(clock=FakeClock(), process_name="prefill")
+    if not fallback:
+        base = TraceContext.from_wire(pf_ctx.to_wire())
+        ptr.record_span("queued", 0.02, 0.05, ctx=base.child(),
+                        request_id=RID)
+        ptr.record_span("prefill", 0.05, 0.38, ctx=base.child(),
+                        request_id=RID)
+        ebase = TraceContext.from_wire(exp_ctx.to_wire())
+        ptr.record_span("kv_export", 0.42, 0.48, ctx=ebase.child(),
+                        request_id=RID, outcome="ok")
+    pdoc = ptr.to_chrome()
+    pdoc["otherData"]["wall_start_unix"] = 100.0
+
+    dtr = SpanTracer(clock=FakeClock(), process_name="decode")
+    leg = fb_ctx if fallback else imp_ctx
+    ibase = TraceContext.from_wire(leg.to_wire())
+    if not fallback:
+        dtr.record_span("kv_import", 0.55, 0.60, ctx=ibase.child(),
+                        request_id=RID, outcome="ok")
+    dtr.record_span("decode", 0.60 if not fallback else 0.2, 0.95,
+                    ctx=ibase.child(), request_id=RID)
+    ddoc = dtr.to_chrome()
+    ddoc["otherData"]["wall_start_unix"] = 100.0
+    return route.trace_id, [rdoc, pdoc, ddoc]
+
+
+def _names(node):
+    return {node["name"], *(n for c in node["children"] for n in _names(c))}
+
+
+@pytest.mark.parametrize("needle_kind", ["request_id", "trace_id"])
+def test_stitch_reconstructs_the_disagg_tree(needle_kind):
+    tid, docs = _disagg_shards()
+    stitched = stitch_trace(docs, RID if needle_kind == "request_id"
+                            else tid)
+    root = stitched["root"]
+    # ONE causal tree: the router's route span at the root, each
+    # handoff leg a child, and the replicas' own spans under the leg
+    # that caused them — reconstructed purely from parent links
+    assert root["name"] == "route" and root["process"] == "router"
+    assert {c["name"] for c in root["children"]} == {
+        "handoff_prefill", "handoff_export", "handoff_import"}
+    by_name = {c["name"]: c for c in root["children"]}
+    assert ({c["name"] for c in by_name["handoff_prefill"]["children"]}
+            == {"queued", "prefill"})
+    assert ({c["name"] for c in by_name["handoff_import"]["children"]}
+            == {"kv_import", "decode"})
+    kvx = by_name["handoff_export"]["children"]
+    assert [c["name"] for c in kvx] == ["kv_export"]
+    assert kvx[0]["process"] == "prefill"
+    assert stitched["trace_id"] == tid
+    assert stitched["request_ids"] == [RID]
+    assert stitched["shards"] == 3
+    assert stitched["causal_spans"] == 9
+    assert stitched["request_id_joined"] == 0
+
+
+def test_critical_path_partitions_the_root_exactly():
+    _, docs = _disagg_shards()
+    stitched = stitch_trace(docs, RID)
+    segs = critical_path(stitched["root"])
+    root = stitched["root"]
+    total = root["end_s"] - root["start_s"]
+    assert sum(s["seconds"] for s in segs) == pytest.approx(total)
+    # contiguous partition of [root.start, root.end]: no gap, no overlap
+    assert segs[0]["t0_s"] == pytest.approx(root["start_s"])
+    assert segs[-1]["t1_s"] == pytest.approx(root["end_s"])
+    for a, b in zip(segs, segs[1:]):
+        assert a["t1_s"] == pytest.approx(b["t0_s"])
+    # the un-attributed remainder (wire time between hops) is reported
+    # as honest residual segments, never silently dropped
+    kinds = {s["kind"] for s in segs}
+    assert "residual" in kinds and "span" in kinds
+    # the real work shows up attributed to the process that did it
+    assert any(s["span"] == "prefill" and s["process"] == "prefill"
+               for s in segs)
+    assert any(s["span"] == "decode" and s["process"] == "decode"
+               for s in segs)
+
+
+def test_stitch_fallback_variant_keeps_outcome_tags():
+    tid, docs = _disagg_shards(fallback=True)
+    stitched = stitch_trace(docs, RID)
+    root = stitched["root"]
+    assert root["args"]["outcome"] == "fallback"
+    by_name = {c["name"]: c for c in root["children"]}
+    assert by_name["handoff_prefill"]["args"]["outcome"] == "error"
+    # the fallback decode ran under the fallback leg's context
+    assert ([c["name"] for c in by_name["fallback"]["children"]]
+            == ["decode"])
+    text = render_waterfall(stitched)
+    assert "[fallback]" in text and "[error]" in text
+    # the failed leg still shows on the critical-path walk's timeline
+    segs = critical_path(root)
+    assert sum(s["seconds"] for s in segs) == pytest.approx(1.0)
+    assert any(s.get("outcome") == "fallback" for s in segs)
+
+
+def test_old_shards_join_by_request_id_under_a_synthetic_root():
+    # a fleet mid-rollout: one causal shard, one old emitter whose
+    # spans carry only the request_id — still one tree, the slack
+    # between the two roots an honest residual instead of an error
+    tid, docs = _disagg_shards()
+    old = SpanTracer(clock=FakeClock(), process_name="old-replica")
+    old.record_span("decode", 1.2, 1.5, request_id=RID)
+    odoc = old.to_chrome()
+    odoc["otherData"]["wall_start_unix"] = 100.0
+    stitched = stitch_trace([*docs, odoc], RID)
+    root = stitched["root"]
+    assert root["name"] == "trace" and root["process"] == "(stitched)"
+    assert {c["name"] for c in root["children"]} == {"route", "decode"}
+    assert stitched["request_id_joined"] == 1
+    assert stitched["causal_spans"] == 9
+    segs = critical_path(root)
+    assert sum(s["seconds"] for s in segs) == pytest.approx(
+        root["end_s"] - root["start_s"])
+
+
+def test_stitch_unknown_needle_raises():
+    _, docs = _disagg_shards()
+    with pytest.raises(ValueError, match="no spans match"):
+        stitch_trace(docs, "nope-never-seen")
+
+
+def test_render_waterfall_rows_and_processes():
+    _, docs = _disagg_shards()
+    text = render_waterfall(stitch_trace(docs, RID))
+    lines = text.splitlines()
+    assert len(lines) == 9          # one row per span
+    assert lines[0].startswith("route")
+    for proc in ("router", "prefill", "decode"):
+        assert any(proc in l for l in lines)
+    assert all("|" in l and "ms" in l for l in lines)
+
+
+# -- report trace CLI ---------------------------------------------------------
+
+
+def _write_shards(tmp_path):
+    tid, docs = _disagg_shards()
+    paths = []
+    for i, doc in enumerate(docs):
+        p = str(tmp_path / f"shard{i}.json")
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        paths.append(p)
+    return tid, paths
+
+
+def test_report_trace_cli_waterfall_and_critical_path(tmp_path, capsys):
+    from nanodiloco_tpu.cli import report_main
+
+    _, paths = _write_shards(tmp_path)
+    report_main(["trace", RID, *paths])
+    out = capsys.readouterr().out
+    assert "route" in out and "critical path" in out
+    assert "(residual)" in out
+    assert "@prefill" in out and "@decode" in out
+
+
+def test_report_trace_cli_json(tmp_path, capsys):
+    from nanodiloco_tpu.cli import report_main
+
+    tid, paths = _write_shards(tmp_path)
+    report_main(["trace", tid, "--json", *paths])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["root"]["name"] == "route"
+    assert doc["trace_id"] == tid
+    total = doc["root"]["end_s"] - doc["root"]["start_s"]
+    assert sum(s["seconds"] for s in doc["critical_path"]) == pytest.approx(
+        total)
+
+
+def test_report_trace_cli_unknown_needle_exits_nonzero(tmp_path, capsys):
+    from nanodiloco_tpu.cli import report_main
+
+    _, paths = _write_shards(tmp_path)
+    with pytest.raises(SystemExit):
+        report_main(["trace", "missing-id", *paths])
+    assert "error:" in capsys.readouterr().out
+
+
+# -- OpenMetrics exemplars ----------------------------------------------------
+
+
+def test_histogram_exemplar_lands_in_its_bucket_and_renders():
+    from nanodiloco_tpu.obs.telemetry import Histogram, render_exposition
+
+    h = Histogram(buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="a" * 32)
+    h.observe(0.5)                       # unsampled: count moves, no link
+    h.observe(0.7, exemplar="b" * 32)    # same bucket: last writer wins
+    h.observe(50.0, exemplar="c" * 32)   # lands in +Inf
+    snap = h.snapshot()
+    assert snap["exemplars"] == {
+        0.1: ("a" * 32, 0.05),
+        1.0: ("b" * 32, 0.7),
+        "+Inf": ("c" * 32, 50.0),
+    }
+    text = render_exposition([("ttft_seconds", "histogram", "h", snap)])
+    lines = text.splitlines()
+    # OpenMetrics exemplar syntax on the bucket the observation landed
+    # in — the exemplar VALUE lies inside its bucket's range
+    assert ('ttft_seconds_bucket{le="0.1"} 1 '
+            '# {trace_id="' + "a" * 32 + '"} 0.05' in lines)
+    assert any(l.startswith('ttft_seconds_bucket{le="+Inf"} 4 # ')
+               for l in lines)
+
+
+def test_exemplars_survive_the_parse_render_byte_contract():
+    from nanodiloco_tpu.obs.collector import parse_exposition
+    from nanodiloco_tpu.obs.telemetry import Histogram, render_exposition
+
+    h = Histogram(buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="a" * 32)
+    h.observe(2.5, exemplar="b" * 32)
+    text = render_exposition(
+        [("ttft_seconds", "histogram", "h", h.snapshot())])
+    # the round trip is BYTE-exact with exemplars present
+    assert render_exposition(parse_exposition(text)) == text
+    (_n, _t, _h, samples), = parse_exposition(text)
+    (_labels, snap), = samples
+    assert snap["exemplars"][0.1] == ("a" * 32, 0.05)
+    assert snap["exemplars"]["+Inf"] == ("b" * 32, 2.5)
+
+
+def test_parse_sample_line_tolerates_and_splits_exemplars():
+    from nanodiloco_tpu.obs.collector import (
+        parse_sample_line,
+        parse_sample_line_ex,
+    )
+
+    line = ('x_bucket{le="0.1"} 3 # {trace_id="' + "a" * 32 + '"} 0.07')
+    name, labels, value, ex = parse_sample_line_ex(line)
+    assert (name, labels, value) == ("x_bucket", {"le": "0.1"}, 3.0)
+    assert ex == ({"trace_id": "a" * 32}, 0.07)
+    # the 3-tuple surface keeps working for old callers
+    assert parse_sample_line(line) == ("x_bucket", {"le": "0.1"}, 3.0)
+    # a " # " INSIDE a quoted label value is not an exemplar separator
+    tricky = 'y{msg="a # b"} 1'
+    assert parse_sample_line_ex(tricky) == (
+        "y", {"msg": "a # b"}, 1.0, None)
+
+
+def test_scheduler_attaches_exemplars_and_kv_spans():
+    """The serve side end-to-end: a request arriving with a sampled
+    wire context parents its queued/prefill/decode spans under it and
+    stamps the trace id as the TTFT/queue-wait exemplar; kv_export and
+    kv_import emit their own spans under the arriving leg's context."""
+    from test_serve_scheduler import FakeBackend
+    from test_serve_scheduler import FakeClock as SchedClock
+
+    from nanodiloco_tpu.serve.scheduler import GenRequest, Scheduler
+
+    clock = SchedClock()
+    tracer = SpanTracer(clock=clock)
+    backend = FakeBackend(1, {1: [10, 11]})
+    sched = Scheduler(backend, max_queue=4, clock=clock, tracer=tracer)
+    leg = TraceContext("ab" * 16, "cd" * 8, None, True)
+    t = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=1,
+                                request_id="req-x",
+                                trace_context=leg.to_wire()))
+    for _ in range(6):
+        clock.advance(0.25)
+        sched.tick()
+    assert t.done()
+    by_name = {e["name"]: e for e in tracer.events}
+    for name in ("queued", "prefill", "decode"):
+        a = by_name[name]["args"]
+        assert a["trace_id"] == leg.trace_id
+        # siblings under the arriving leg's span, one child id each
+        assert a["parent_span_id"] == leg.span_id
+        assert a["request_id"] == "req-x"
+    # the exemplar rode into the landing bucket of both histograms
+    for hist in (sched.hist_ttft, sched.hist_queue_wait):
+        exs = hist.snapshot().get("exemplars") or {}
+        assert [tid for tid, _v in exs.values()] == [leg.trace_id]
+    # an unsampled context withholds the link but still counts
+    off = TraceContext("ef" * 16, "cd" * 8, None, False)
+    backend.scripts[2] = [20, 21]
+    t2 = sched.submit(GenRequest(prompt=(5,), max_new_tokens=2, seed=2,
+                                 trace_context=off.to_wire()))
+    for _ in range(6):
+        clock.advance(0.25)
+        sched.tick()
+    assert t2.done()
+    assert sched.hist_ttft.snapshot()["count"] == 2
+    assert len(sched.hist_ttft.snapshot()["exemplars"]) == 1
+
+
+def test_serve_reply_echoes_trace_id_over_the_wire():
+    """A sampled client context comes back as ``trace_id`` in the 200
+    body (the client's handle to its own trace); an unsampled context
+    and a malformed one stay silent — and malformed is 200, never 4xx."""
+    from test_serve_scheduler import FakeBackend
+    from test_serve_scheduler import FakeClock as SchedClock
+
+    from nanodiloco_tpu.serve import ServeServer, http_post_json
+    from nanodiloco_tpu.serve.scheduler import Scheduler
+
+    clock = SchedClock()
+    backend = FakeBackend(1, {1: [10, 11], 2: [20, 21], 3: [30, 31]})
+    sched = Scheduler(backend, max_queue=4, clock=clock,
+                      tracer=SpanTracer(clock=clock))
+    server = ServeServer(sched, port=0, host="127.0.0.1").start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        leg = TraceContext("ab" * 16, "cd" * 8, None, True)
+        code, out = http_post_json(base + "/v1/generate", {
+            "token_ids": [5], "max_new_tokens": 2, "seed": 1,
+            "stop": False, "trace_context": leg.to_wire(),
+        })
+        assert code == 200 and out["token_ids"] == [10, 11]
+        assert out["trace_id"] == leg.trace_id
+        off = TraceContext("ef" * 16, "cd" * 8, None, False)
+        code, out = http_post_json(base + "/v1/generate", {
+            "token_ids": [5], "max_new_tokens": 2, "seed": 2,
+            "stop": False, "trace_context": off.to_wire(),
+        })
+        assert code == 200 and "trace_id" not in out
+        code, out = http_post_json(base + "/v1/generate", {
+            "token_ids": [5], "max_new_tokens": 2, "seed": 3,
+            "stop": False, "trace_context": "not-a-w3c-traceparent",
+        })
+        assert code == 200 and "trace_id" not in out
+    finally:
+        server.stop()
